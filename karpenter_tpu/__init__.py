@@ -1,0 +1,25 @@
+"""karpenter_tpu — a TPU-native cluster node-provisioning framework.
+
+A ground-up rebuild of the capabilities of Karpenter (reference:
+/root/reference, aws/karpenter v0.5.x era): watch unschedulable pods, group
+them by scheduling constraints, bin-pack them onto candidate instance types
+and zones, launch + bind capacity, and manage node lifecycle — with the
+provisioning solver reformulated as batched tensor math on TPU
+(JAX / pjit / lax.scan) instead of the reference's sequential greedy
+First-Fit-Decreasing loop (reference: pkg/controllers/provisioning/binpacking).
+
+Layout:
+  api/            typed spec model: Provisioner, Constraints, Requirements,
+                  Taints, Limits + validation/defaulting (ref pkg/apis/provisioning/v1alpha5)
+  ops/            tensor kernels: spec encoding, FFD pack kernel, batched
+                  scoring + LP relaxation, topology-spread masks
+  models/         solver models: greedy fallback, TPU batched solver,
+                  differentiable assignment model (the flagship)
+  parallel/       device mesh + sharding for multi-chip solves
+  controllers/    control plane: selection, provisioning batcher, scheduler,
+                  termination, node lifecycle, counter, metrics
+  cloudprovider/  CloudProvider/InstanceType/Offering interfaces, fake provider
+  utils/          resource arithmetic, clock, rate-limited queues
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,151 @@
+"""Pod model — the slice of a kube Pod the provisioning path consumes.
+
+Ref: the reference operates on v1.Pod via helpers in pkg/utils/pod and
+v1alpha5.Requirements.PodRequirements (requirements.go:58-76). We model only
+the fields those paths read: requests, nodeSelector, node affinity, tolerations,
+topology-spread constraints, ownership, and scheduling status.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.requirements import IN, Requirement, Requirements
+from karpenter_tpu.api.resources import (
+    ResourceList,
+    add_resources,
+    max_resources,
+    parse_resource_list,
+)
+from karpenter_tpu.api.taints import Toleration
+
+_uid_counter = itertools.count(1)
+
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = DO_NOT_SCHEDULE
+    # Simplified selector: pods match iff their labels contain all these pairs.
+    match_labels: Dict[str, str] = field(default_factory=dict)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.match_labels.items())
+
+    def group_key(self) -> Tuple:
+        """Constraints with equal key are spread together
+        (ref: scheduling/topology.go:57-75 hashes the constraint)."""
+        return (
+            self.max_skew,
+            self.topology_key,
+            self.when_unsatisfiable,
+            tuple(sorted(self.match_labels.items())),
+        )
+
+
+@dataclass
+class PreferredTerm:
+    weight: int
+    requirements: List[Requirement]
+
+
+@dataclass
+class PodSpec:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+    # Effective resource requests (already folded across containers).
+    requests: ResourceList = field(default_factory=dict)
+
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # Required node affinity: OR over terms, AND within a term.
+    required_terms: List[List[Requirement]] = field(default_factory=list)
+    preferred_terms: List[PreferredTerm] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+
+    # Ownership / lifecycle.
+    owner_kind: Optional[str] = None  # "DaemonSet", "Node", "ReplicaSet", ...
+    priority_class_name: str = ""
+    phase: str = PHASE_PENDING
+    node_name: Optional[str] = None
+    unschedulable: bool = False  # PodScheduled=False reason=Unschedulable
+    deletion_timestamp: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"pod-uid-{next(_uid_counter)}"
+        if self.requests:
+            self.requests = parse_resource_list(self.requests)
+        # Every pod consumes one pod slot.
+        self.requests.setdefault(wellknown.RESOURCE_PODS, 1.0)
+
+    # --- predicates (ref: pkg/utils/pod/scheduling.go) ----------------------
+
+    def is_scheduled(self) -> bool:
+        return self.node_name is not None
+
+    def is_terminal(self) -> bool:
+        return self.phase in (PHASE_SUCCEEDED, PHASE_FAILED)
+
+    def is_terminating(self) -> bool:
+        return self.deletion_timestamp is not None
+
+    def is_owned_by_daemonset(self) -> bool:
+        return self.owner_kind == "DaemonSet"
+
+    def is_owned_by_node(self) -> bool:
+        return self.owner_kind == "Node"
+
+    def failed_to_schedule(self) -> bool:
+        return self.unschedulable
+
+    def is_provisionable(self) -> bool:
+        """Candidate for provisioning: unschedulable, unbound, not daemon/static
+        (ref: selection/controller.go isProvisionable:104)."""
+        return (
+            self.failed_to_schedule()
+            and not self.is_scheduled()
+            and not self.is_owned_by_daemonset()
+            and not self.is_owned_by_node()
+            and not self.is_terminal()
+            and not self.is_terminating()
+        )
+
+    # --- scheduling requirements (ref: requirements.go PodRequirements:58-76)
+
+    def scheduling_requirements(self) -> Requirements:
+        """nodeSelector + the heaviest preferred term + the first required term.
+
+        The reference deliberately collapses affinity OR-terms to the first
+        term and preferences to the single heaviest — relaxation on retry is
+        handled separately (selection/preferences.go).
+        """
+        requirements: List[Requirement] = [
+            Requirement.in_(key, [value])
+            for key, value in sorted(self.node_selector.items())
+        ]
+        if self.preferred_terms:
+            heaviest = max(self.preferred_terms, key=lambda term: term.weight)
+            requirements.extend(heaviest.requirements)
+        if self.required_terms:
+            requirements.extend(self.required_terms[0])
+        return Requirements(requirements)
+
+    def total_requests(self) -> ResourceList:
+        return dict(self.requests)

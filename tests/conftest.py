@@ -1,0 +1,13 @@
+"""Test environment: force an 8-device virtual CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware (the driver separately dry-runs the
+real multichip path via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+# Must happen before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,323 @@
+"""API-layer tests: quantities, requirement algebra, taints, constraints,
+validation — mirrors the reference's v1alpha5 suite (ref:
+pkg/apis/provisioning/v1alpha5/suite_test.go:42-154) plus the Consolidate and
+compatibility corner cases called out in requirements.go:81-133."""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec, PreferredTerm
+from karpenter_tpu.api.provisioner import (
+    Constraints,
+    Limits,
+    PodIncompatibleError,
+    Provisioner,
+    ProvisionerSpec,
+)
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.resources import (
+    add_resources,
+    fits_within,
+    parse_quantity,
+    subtract_resources,
+)
+from karpenter_tpu.api.taints import (
+    Taint,
+    Toleration,
+    OP_EXISTS,
+    taints_for_pod,
+    taints_tolerate_pod,
+)
+from karpenter_tpu.api.validation import ValidationError, validate_provisioner
+
+
+class TestQuantities:
+    def test_plain_numbers(self):
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity(1.5) == 1.5
+        assert parse_quantity("0.5") == 0.5
+
+    def test_millicores(self):
+        assert parse_quantity("100m") == pytest.approx(0.1)
+        assert parse_quantity("1500m") == pytest.approx(1.5)
+
+    def test_binary_suffixes(self):
+        assert parse_quantity("512Mi") == 512 * 1024**2
+        assert parse_quantity("2Gi") == 2 * 1024**3
+        assert parse_quantity("1Ki") == 1024
+
+    def test_decimal_suffixes(self):
+        assert parse_quantity("1k") == 1000.0
+        assert parse_quantity("2G") == 2e9
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+
+    def test_arithmetic(self):
+        a = {"cpu": 1.0, "memory": 100.0}
+        b = {"cpu": 2.0, "pods": 1.0}
+        assert add_resources(a, b) == {"cpu": 3.0, "memory": 100.0, "pods": 1.0}
+        assert subtract_resources(add_resources(a, b), b) == {
+            "cpu": 1.0,
+            "memory": 100.0,
+            "pods": 0.0,
+        }
+
+    def test_fits_within(self):
+        assert fits_within({"cpu": 1.0}, {"cpu": 1.0, "memory": 5.0})
+        assert not fits_within({"cpu": 2.0}, {"cpu": 1.0})
+        # Zero requests fit anywhere, even against absent capacity.
+        assert fits_within({"gpu": 0.0}, {})
+
+
+class TestRequirements:
+    def test_in_intersection(self):
+        reqs = Requirements(
+            [
+                Requirement.in_("zone", ["a", "b", "c"]),
+                Requirement.in_("zone", ["b", "c", "d"]),
+            ]
+        )
+        assert reqs.allowed("zone").finite_values() == {"b", "c"}
+
+    def test_not_in_subtraction(self):
+        reqs = Requirements(
+            [
+                Requirement.in_("zone", ["a", "b"]),
+                Requirement.not_in("zone", ["b"]),
+            ]
+        )
+        assert reqs.allowed("zone").finite_values() == {"a"}
+
+    def test_unconstrained_key_is_complement(self):
+        reqs = Requirements([Requirement.not_in("zone", ["a"])])
+        keyset = reqs.allowed("zone")
+        assert keyset.complement
+        assert keyset.contains("b")
+        assert not keyset.contains("a")
+
+    def test_conflict_is_empty_not_dropped(self):
+        # Ref: requirements.go Consolidate preserves conflicting (empty) sets.
+        reqs = Requirements(
+            [Requirement.in_("zone", ["a"]), Requirement.in_("zone", ["b"])]
+        )
+        assert reqs.allowed("zone").is_empty()
+        consolidated = reqs.consolidate()
+        assert consolidated.allowed("zone").is_empty()
+        assert len(consolidated) == 1  # the conflict survives consolidation
+
+    def test_consolidate_merges_per_key(self):
+        reqs = Requirements(
+            [
+                Requirement.in_("zone", ["a", "b"]),
+                Requirement.in_("arch", ["amd64"]),
+                Requirement.not_in("zone", ["a"]),
+            ]
+        )
+        consolidated = reqs.consolidate()
+        assert len(consolidated) == 2
+        assert consolidated.allowed("zone").finite_values() == {"b"}
+        assert consolidated.allowed("arch").finite_values() == {"amd64"}
+
+    def test_compatibility(self):
+        a = Requirements([Requirement.in_("zone", ["a", "b"])])
+        b = Requirements([Requirement.in_("zone", ["b", "c"])])
+        c = Requirements([Requirement.in_("zone", ["c"])])
+        assert a.compatible_with(b)
+        assert not a.compatible_with(c)
+        # Unconstrained is compatible with anything.
+        assert Requirements().compatible_with(c)
+
+    def test_labels_to_requirements(self):
+        reqs = Requirements.from_labels({"team": "infra"})
+        assert reqs.allowed("team").finite_values() == {"infra"}
+
+    def test_satisfied_by_labels(self):
+        reqs = Requirements([Requirement.in_("zone", ["a"])])
+        assert reqs.satisfied_by_labels({"zone": "a"})
+        assert not reqs.satisfied_by_labels({"zone": "b"})
+        assert not reqs.satisfied_by_labels({})  # finite set requires presence
+        not_in = Requirements([Requirement.not_in("zone", ["a"])])
+        assert not_in.satisfied_by_labels({})  # complement tolerates absence
+
+    def test_well_known_accessors(self):
+        reqs = Requirements(
+            [
+                Requirement.in_(wellknown.ZONE_LABEL, ["us-east-1a"]),
+                Requirement.in_(wellknown.CAPACITY_TYPE_LABEL, ["spot"]),
+                Requirement.in_("custom", ["x"]),
+            ]
+        )
+        assert reqs.zones() == {"us-east-1a"}
+        assert reqs.capacity_types() == {"spot"}
+        assert reqs.instance_types() is None  # unconstrained
+        assert len(reqs.well_known()) == 2
+
+    def test_canonical_key_grouping(self):
+        a = Requirements(
+            [Requirement.in_("zone", ["a", "b"]), Requirement.in_("arch", ["amd64"])]
+        )
+        b = Requirements(
+            [Requirement.in_("arch", ["amd64"]), Requirement.in_("zone", ["b", "a"])]
+        )
+        assert a.canonical_key() == b.canonical_key()
+
+
+class TestTaints:
+    def test_tolerates(self):
+        taints = [Taint(key="team", value="infra")]
+        assert not taints_tolerate_pod(taints, [])
+        assert taints_tolerate_pod(
+            taints, [Toleration(key="team", value="infra", effect="NoSchedule")]
+        )
+        assert taints_tolerate_pod(taints, [Toleration(key="team", operator=OP_EXISTS)])
+        assert taints_tolerate_pod(taints, [Toleration(operator=OP_EXISTS)])
+        assert not taints_tolerate_pod(taints, [Toleration(key="team", value="other")])
+
+    def test_prefer_no_schedule_never_blocks(self):
+        taints = [Taint(key="soft", effect="PreferNoSchedule")]
+        assert taints_tolerate_pod(taints, [])
+
+    def test_taints_for_pod_imprints_equal_tolerations(self):
+        tolerations = [
+            Toleration(key="dedicated", value="ml", effect="NoSchedule"),
+            Toleration(key="any", operator=OP_EXISTS),  # Exists: no imprint
+            Toleration(key="noeffect", value="x"),  # no effect: no imprint
+        ]
+        taints = taints_for_pod([], tolerations)
+        assert taints == [Taint(key="dedicated", value="ml", effect="NoSchedule")]
+
+    def test_taints_for_pod_no_duplicates(self):
+        existing = [Taint(key="dedicated", value="other", effect="NoSchedule")]
+        tolerations = [Toleration(key="dedicated", value="ml", effect="NoSchedule")]
+        assert taints_for_pod(existing, tolerations) == existing
+
+
+class TestPodSpec:
+    def test_pod_slot_implied(self):
+        pod = PodSpec(name="p", requests={"cpu": "1"})
+        assert pod.requests[wellknown.RESOURCE_PODS] == 1.0
+
+    def test_scheduling_requirements_fold(self):
+        pod = PodSpec(
+            name="p",
+            node_selector={"zone": "a"},
+            preferred_terms=[
+                PreferredTerm(weight=1, requirements=[Requirement.in_("arch", ["arm64"])]),
+                PreferredTerm(weight=10, requirements=[Requirement.in_("arch", ["amd64"])]),
+            ],
+            required_terms=[
+                [Requirement.in_("os", ["linux"])],
+                [Requirement.in_("os", ["windows"])],  # dropped: only first term
+            ],
+        )
+        reqs = pod.scheduling_requirements()
+        assert reqs.allowed("zone").finite_values() == {"a"}
+        assert reqs.allowed("arch").finite_values() == {"amd64"}  # heaviest wins
+        assert reqs.allowed("os").finite_values() == {"linux"}
+
+    def test_provisionable(self):
+        pod = PodSpec(name="p", unschedulable=True)
+        assert pod.is_provisionable()
+        assert not PodSpec(name="p2").is_provisionable()
+        assert not PodSpec(
+            name="p3", unschedulable=True, owner_kind="DaemonSet"
+        ).is_provisionable()
+        assert not PodSpec(
+            name="p4", unschedulable=True, node_name="n1"
+        ).is_provisionable()
+
+
+class TestConstraints:
+    def test_validate_pod_taints(self):
+        constraints = Constraints(taints=[Taint(key="team", value="infra")])
+        with pytest.raises(PodIncompatibleError):
+            constraints.validate_pod(PodSpec(name="p"))
+        constraints.validate_pod(
+            PodSpec(name="p", tolerations=[Toleration(key="team", value="infra")])
+        )
+
+    def test_validate_pod_requirements(self):
+        constraints = Constraints(
+            requirements=Requirements([Requirement.in_(wellknown.ZONE_LABEL, ["a"])])
+        )
+        constraints.validate_pod(PodSpec(name="ok"))
+        with pytest.raises(PodIncompatibleError):
+            constraints.validate_pod(
+                PodSpec(name="bad", node_selector={wellknown.ZONE_LABEL: "b"})
+            )
+
+    def test_labels_act_as_requirements(self):
+        constraints = Constraints(labels={"team": "infra"})
+        with pytest.raises(PodIncompatibleError):
+            constraints.validate_pod(PodSpec(name="p", node_selector={"team": "web"}))
+
+    def test_tighten_is_well_known_only(self):
+        constraints = Constraints(
+            requirements=Requirements(
+                [Requirement.in_(wellknown.ZONE_LABEL, ["a", "b"])]
+            )
+        )
+        pod = PodSpec(name="p", node_selector={wellknown.ZONE_LABEL: "a", "custom": "x"})
+        tightened = constraints.tighten(pod)
+        assert tightened.requirements.allowed(wellknown.ZONE_LABEL).finite_values() == {"a"}
+        assert tightened.requirements.allowed("custom").is_any()  # filtered out
+
+
+class TestLimits:
+    def test_exceeded_by(self):
+        limits = Limits(resources={"cpu": "100"})
+        assert limits.exceeded_by({"cpu": 50.0}) is None
+        assert limits.exceeded_by({"cpu": 100.0}) is not None
+        assert limits.exceeded_by({}) is None
+
+
+class TestValidation:
+    def _provisioner(self, **kwargs) -> Provisioner:
+        return Provisioner(name="default", spec=ProvisionerSpec(**kwargs))
+
+    def test_valid_provisioner(self):
+        validate_provisioner(self._provisioner())
+
+    def test_negative_ttl(self):
+        with pytest.raises(ValidationError):
+            validate_provisioner(self._provisioner(ttl_seconds_after_empty=-1))
+
+    def test_restricted_label_domain(self):
+        with pytest.raises(ValidationError):
+            validate_provisioner(
+                self._provisioner(
+                    constraints=Constraints(labels={"karpenter.sh/custom": "x"})
+                )
+            )
+
+    def test_well_known_requirement_keys_only(self):
+        with pytest.raises(ValidationError):
+            validate_provisioner(
+                self._provisioner(
+                    constraints=Constraints(
+                        requirements=Requirements([Requirement.in_("custom", ["x"])])
+                    )
+                )
+            )
+
+    def test_bad_operator(self):
+        with pytest.raises(ValidationError):
+            validate_provisioner(
+                self._provisioner(
+                    constraints=Constraints(
+                        requirements=Requirements(
+                            [Requirement(key=wellknown.ZONE_LABEL, operator="Exists", values=())]
+                        )
+                    )
+                )
+            )
+
+    def test_bad_taint_effect(self):
+        with pytest.raises(ValidationError):
+            validate_provisioner(
+                self._provisioner(
+                    constraints=Constraints(taints=[Taint(key="k", effect="Nope")])
+                )
+            )
