@@ -10,16 +10,11 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
-from karpenter_tpu.api.requirements import IN, Requirement, Requirements
-from karpenter_tpu.api.resources import (
-    ResourceList,
-    add_resources,
-    max_resources,
-    parse_resource_list,
-)
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.resources import ResourceList, parse_resource_list
 from karpenter_tpu.api.taints import Toleration
 
 _uid_counter = itertools.count(1)
@@ -90,8 +85,8 @@ class PodSpec:
     def __post_init__(self):
         if not self.uid:
             self.uid = f"pod-uid-{next(_uid_counter)}"
-        if self.requests:
-            self.requests = parse_resource_list(self.requests)
+        # Always copy: never alias (and mutate) a caller-supplied dict.
+        self.requests = parse_resource_list(self.requests)
         # Every pod consumes one pod slot.
         self.requests.setdefault(wellknown.RESOURCE_PODS, 1.0)
 

@@ -14,9 +14,8 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Optional
 
-from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import PodSpec
-from karpenter_tpu.api.requirements import Requirements
+from karpenter_tpu.api.requirements import SUPPORTED_OPERATORS, Requirements
 from karpenter_tpu.api.resources import ResourceList, parse_resource_list
 from karpenter_tpu.api.taints import Taint, taints_tolerate_pod
 
@@ -65,13 +64,25 @@ class Constraints:
 
     def validate_pod(self, pod: PodSpec) -> None:
         """Raise PodIncompatibleError unless the pod tolerates our taints and
-        its scheduling requirements intersect ours (ref: constraints.go:43-63)."""
+        its scheduling requirements intersect ours (ref: constraints.go:43-63).
+
+        Pods using operators outside In/NotIn are rejected here as
+        incompatible rather than crashing the evaluator — the reference
+        filters them earlier at selection (selection/controller.go:130-141),
+        and the selection controller does too; this is the backstop.
+        """
         if not taints_tolerate_pod(self.taints, pod.tolerations):
             raise PodIncompatibleError(
                 f"pod {pod.namespace}/{pod.name} does not tolerate provisioner taints"
             )
-        ours = self.effective_requirements()
         theirs = pod.scheduling_requirements()
+        for requirement in theirs:
+            if requirement.operator not in SUPPORTED_OPERATORS:
+                raise PodIncompatibleError(
+                    f"pod {pod.namespace}/{pod.name} uses unsupported operator "
+                    f"{requirement.operator!r}"
+                )
+        ours = self.effective_requirements()
         if not ours.compatible_with(theirs):
             raise PodIncompatibleError(
                 f"pod {pod.namespace}/{pod.name} requirements incompatible with provisioner"

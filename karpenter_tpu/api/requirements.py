@@ -14,8 +14,8 @@ intersection/compatibility exact without enumerating a universe.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, FrozenSet, Iterable, List, Mapping, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import FrozenSet, Iterable, List, Mapping, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
 

@@ -32,6 +32,7 @@ VALIDATE_HOOK: Optional[Callable[[Provisioner], None]] = None
 
 _QUALIFIED_NAME_RE = re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
 _LABEL_VALUE_RE = re.compile(r"^([A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?)?$")
+_DNS_LABEL_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 _VALID_EFFECTS = {EFFECT_NO_SCHEDULE, EFFECT_PREFER_NO_SCHEDULE, EFFECT_NO_EXECUTE}
 
 
@@ -43,6 +44,13 @@ def _validate_label_key(key: str, errors: List[str], where: str) -> None:
     name = key.rsplit("/", 1)[-1]
     if not name or not _QUALIFIED_NAME_RE.match(name) or len(name) > 63:
         errors.append(f"{where}: invalid label key {key!r}")
+    # The optional prefix must be a DNS subdomain (kube IsQualifiedName).
+    domain = _label_key_domain(key)
+    if domain and (
+        len(domain) > 253
+        or not all(_DNS_LABEL_RE.match(part) for part in domain.split("."))
+    ):
+        errors.append(f"{where}: invalid label key domain {domain!r}")
 
 
 def default_provisioner(provisioner: Provisioner) -> None:

@@ -1,0 +1,145 @@
+"""Cloud-provider abstraction.
+
+Ref: pkg/cloudprovider/types.go:29-75 — CloudProvider, InstanceType and
+Offering. We extend Offering with a price so the solver can optimize projected
+$/hr (the reference delegates price choice to EC2 Fleet's lowest-price
+allocation strategy; surfacing it lets the TPU solver make the cost tradeoff
+jointly with packing).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Sequence
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Constraints, Provisioner
+from karpenter_tpu.api.resources import ResourceList, parse_resource_list
+
+ARCH_AMD64 = "amd64"
+ARCH_ARM64 = "arm64"
+OS_LINUX = "linux"
+
+
+@dataclass(frozen=True)
+class Offering:
+    """One purchasable (zone, capacity-type) combination for an instance type."""
+
+    zone: str
+    capacity_type: str = wellknown.CAPACITY_TYPE_ON_DEMAND
+    price: float = 0.0  # $/hr; 0.0 = unknown
+
+
+@dataclass
+class InstanceType:
+    """Ref: cloudprovider.InstanceType interface (types.go:44-63)."""
+
+    name: str
+    capacity: ResourceList
+    overhead: ResourceList = field(default_factory=dict)
+    architecture: str = ARCH_AMD64
+    operating_systems: FrozenSet[str] = frozenset({OS_LINUX})
+    offerings: List[Offering] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.capacity = parse_resource_list(self.capacity)
+        self.overhead = parse_resource_list(self.overhead)
+
+    def zones(self) -> FrozenSet[str]:
+        return frozenset(offering.zone for offering in self.offerings)
+
+    def capacity_types(self) -> FrozenSet[str]:
+        return frozenset(offering.capacity_type for offering in self.offerings)
+
+    def get(self, resource: str) -> float:
+        return self.capacity.get(resource, 0.0)
+
+    def min_price(
+        self,
+        zones: Optional[Iterable[str]] = None,
+        capacity_types: Optional[Iterable[str]] = None,
+    ) -> float:
+        """Cheapest offering price within the allowed zones/capacity types."""
+        zones = None if zones is None else set(zones)
+        capacity_types = None if capacity_types is None else set(capacity_types)
+        prices = [
+            o.price
+            for o in self.offerings
+            if (zones is None or o.zone in zones)
+            and (capacity_types is None or o.capacity_type in capacity_types)
+        ]
+        return min(prices) if prices else float("inf")
+
+
+@dataclass
+class NodeSpec:
+    """A launched (or to-be-launched) node as the control plane sees it."""
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List = field(default_factory=list)
+    capacity: ResourceList = field(default_factory=dict)
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    provider_id: str = ""
+    ready: bool = False
+    unschedulable: bool = False
+    finalizers: List[str] = field(default_factory=list)
+    created_at: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    # Last time the kubelet reported status; None = never joined.
+    status_reported_at: Optional[float] = None
+
+
+class CloudProviderError(Exception):
+    pass
+
+
+class InsufficientCapacityError(CloudProviderError):
+    """The provider could not fulfill an offering (ref: aws/errors.go
+    InsufficientInstanceCapacity). Carries the failed offering so callers can
+    blackout-cache it."""
+
+    def __init__(self, instance_type: str, zone: str, capacity_type: str):
+        super().__init__(
+            f"insufficient capacity for {instance_type} ({capacity_type}) in {zone}"
+        )
+        self.instance_type = instance_type
+        self.zone = zone
+        self.capacity_type = capacity_type
+
+
+class CloudProvider(abc.ABC):
+    """Ref: pkg/cloudprovider/types.go:29-42. `create` is synchronous per node
+    packing here (the reference's async channel-per-node is replaced by the
+    controller's own worker pool)."""
+
+    @abc.abstractmethod
+    def create(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        quantity: int,
+        callback: Callable[[NodeSpec], None],
+    ) -> List[Exception]:
+        """Launch `quantity` nodes satisfying constraints, choosing among the
+        offered instance_types; invoke callback per launched node. Returns
+        per-node errors (empty = full success)."""
+
+    @abc.abstractmethod
+    def delete(self, node: NodeSpec) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_instance_types(self, constraints: Optional[Constraints] = None) -> List[InstanceType]:
+        ...
+
+    def default(self, provisioner: Provisioner) -> None:
+        """Vendor defaulting hook (ref: types.go Default)."""
+
+    def validate(self, provisioner: Provisioner) -> None:
+        """Vendor validation hook (ref: types.go Validate)."""

@@ -1,0 +1,2 @@
+"""Tensor kernels and solver primitives: spec encoding, greedy FFD baseline,
+JAX pack kernels, batched scoring + LP relaxation, topology masks."""

@@ -1,0 +1,214 @@
+"""Densify pod / instance-type specs into the arrays every kernel consumes.
+
+The tensor layout (karpenter_tpu.api.wellknown.RESOURCE_DIMS) uses millicores
+and MiB so float32 stays exact across realistic magnitudes (float32 integers
+are exact to 2^24: 16M millicores / 16 TiB in MiB).
+
+Pods with identical request vectors are collapsed into *groups*: real batches
+contain a handful of distinct shapes (deployments replicate pods), so the
+solver works on [G] groups instead of [P] pods — the same trick that makes the
+greedy baseline O(nodes×types×G) instead of the reference's
+O(nodes×types×P) inner loop (ref: binpacking/packable.go:113-132).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider import InstanceType
+
+
+def resource_vector(resources: Mapping[str, float]) -> np.ndarray:
+    """ResourceList -> dense [R] float32 vector in kernel units."""
+    vec = np.zeros(wellknown.NUM_RESOURCE_DIMS, dtype=np.float32)
+    for name, value in resources.items():
+        index = wellknown.RESOURCE_DIM_INDEX.get(name)
+        if index is None:
+            continue  # ephemeral-storage etc. — not packed dimensions
+        if name == wellknown.RESOURCE_CPU:
+            value = value * wellknown.CPU_SCALE
+        elif name == wellknown.RESOURCE_MEMORY:
+            value = value * wellknown.MEMORY_SCALE
+        vec[index] = value
+    return vec
+
+
+@dataclass
+class PodGroups:
+    """Pods collapsed by identical request vector, sorted FFD-style
+    (desc cpu, then desc memory — ref: binpacking/packer.go:96-104,
+    with the remaining dims as deterministic tiebreak)."""
+
+    vectors: np.ndarray  # [G, R] float32
+    counts: np.ndarray  # [G] int32
+    members: List[List[PodSpec]]  # pods per group, original objects
+
+    @property
+    def num_groups(self) -> int:
+        return int(self.vectors.shape[0])
+
+    @property
+    def num_pods(self) -> int:
+        return int(self.counts.sum())
+
+
+def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
+    buckets: Dict[Tuple, List[PodSpec]] = {}
+    vectors: Dict[Tuple, np.ndarray] = {}
+    for pod in pods:
+        vec = resource_vector(pod.requests)
+        key = tuple(vec.tolist())
+        buckets.setdefault(key, []).append(pod)
+        vectors[key] = vec
+    cpu = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
+    mem = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_MEMORY]
+    # Desc by cpu, then memory, then the full vector for determinism.
+    keys = sorted(
+        buckets.keys(), key=lambda k: (-k[cpu], -k[mem], tuple(-x for x in k))
+    )
+    return PodGroups(
+        vectors=np.stack([vectors[k] for k in keys])
+        if keys
+        else np.zeros((0, wellknown.NUM_RESOURCE_DIMS), np.float32),
+        counts=np.array([len(buckets[k]) for k in keys], dtype=np.int32),
+        members=[buckets[k] for k in keys],
+    )
+
+
+@dataclass
+class InstanceFleet:
+    """Candidate instance types densified for the kernels, already filtered to
+    the constraint envelope and sorted ascending (ref: packable.go:76-91)."""
+
+    instance_types: List[InstanceType]
+    capacity: np.ndarray  # [T, R] usable capacity (total - overhead - daemons)
+    total: np.ndarray  # [T, R] raw capacity (node allocatable before daemons)
+    prices: np.ndarray  # [T] cheapest feasible offering $/hr
+
+    @property
+    def num_types(self) -> int:
+        return len(self.instance_types)
+
+
+_ACCEL_INDEXES = [
+    wellknown.RESOURCE_DIM_INDEX[r]
+    for r in wellknown.ACCELERATOR_RESOURCES
+    if r in wellknown.RESOURCE_DIM_INDEX
+]
+_POD_ENI_INDEX = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_AWS_POD_ENI]
+
+
+def _passes_constraint_filters(
+    instance_type: InstanceType, constraints: Constraints
+) -> bool:
+    """Zone/type/arch/OS/capacity-type envelope filters
+    (ref: packable.go:177-218)."""
+    requirements = constraints.effective_requirements()
+    checks = [
+        (wellknown.INSTANCE_TYPE_LABEL, {instance_type.name}),
+        (wellknown.ARCH_LABEL, {instance_type.architecture}),
+        (wellknown.OS_LABEL, set(instance_type.operating_systems)),
+        (wellknown.ZONE_LABEL, set(instance_type.zones())),
+        (wellknown.CAPACITY_TYPE_LABEL, set(instance_type.capacity_types())),
+    ]
+    for key, offered in checks:
+        allowed = requirements.allowed(key)
+        if not any(allowed.contains(value) for value in offered):
+            return False
+    return True
+
+
+def _passes_accelerator_filters(
+    capacity_vec: np.ndarray, pods_need: np.ndarray
+) -> bool:
+    """Accelerators must match demand in both directions: required -> present,
+    absent demand -> absent hardware (anti-waste; ref: packable.go:220-248).
+    Pod-ENI is one-directional: only required -> present (ref: :250-262)."""
+    for index in _ACCEL_INDEXES:
+        if pods_need[index] > 0 and capacity_vec[index] == 0:
+            return False
+        if pods_need[index] == 0 and capacity_vec[index] > 0:
+            return False
+    if pods_need[_POD_ENI_INDEX] > 0 and capacity_vec[_POD_ENI_INDEX] == 0:
+        return False
+    return True
+
+
+def _greedy_fill(remaining: np.ndarray, groups: PodGroups) -> Optional[np.ndarray]:
+    """Pack daemons-style: every pod of every group must fit, else None."""
+    remaining = remaining.copy()
+    for g in range(groups.num_groups):
+        need = groups.vectors[g] * groups.counts[g]
+        remaining -= need
+        if (remaining < 0).any():
+            return None
+    return remaining
+
+
+def build_fleet(
+    instance_types: Sequence[InstanceType],
+    constraints: Constraints,
+    pods: Sequence[PodSpec],
+    daemons: Sequence[PodSpec] = (),
+) -> InstanceFleet:
+    """Filter + densify instance types for one schedule's constraints
+    (ref: PackablesFor packable.go:45-93): constraint envelope filters,
+    accelerator anti-waste, kubelet overhead reservation, daemonset overhead
+    packing, then ascending sort by (accelerators, cpu, memory)."""
+    pods_need = (
+        np.max([resource_vector(p.requests) for p in pods], axis=0)
+        if pods
+        else np.zeros(wellknown.NUM_RESOURCE_DIMS, np.float32)
+    )
+    daemon_groups = group_pods(list(daemons))
+
+    allowed_zones = constraints.effective_requirements().allowed(wellknown.ZONE_LABEL)
+    allowed_capacity = constraints.effective_requirements().allowed(
+        wellknown.CAPACITY_TYPE_LABEL
+    )
+
+    kept: List[Tuple[InstanceType, np.ndarray, np.ndarray, float]] = []
+    for instance_type in instance_types:
+        if not _passes_constraint_filters(instance_type, constraints):
+            continue
+        total = resource_vector(instance_type.capacity)
+        if not _passes_accelerator_filters(total, pods_need):
+            continue
+        usable = total - resource_vector(instance_type.overhead)
+        if (usable < 0).any():
+            continue  # overhead exceeds capacity (ref: packable.go:64-68)
+        usable = _greedy_fill(usable, daemon_groups)
+        if usable is None:
+            continue  # daemons don't fit (ref: packable.go:69-73)
+        price = instance_type.min_price(
+            zones=[z for z in instance_type.zones() if allowed_zones.contains(z)],
+            capacity_types=[
+                c for c in instance_type.capacity_types() if allowed_capacity.contains(c)
+            ],
+        )
+        kept.append((instance_type, usable, total, price))
+
+    cpu = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
+    mem = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_MEMORY]
+    kept.sort(
+        key=lambda item: (
+            tuple(item[2][i] for i in _ACCEL_INDEXES),
+            item[2][cpu],
+            item[2][mem],
+        )
+    )
+    if not kept:
+        empty = np.zeros((0, wellknown.NUM_RESOURCE_DIMS), np.float32)
+        return InstanceFleet([], empty, empty.copy(), np.zeros((0,), np.float32))
+    return InstanceFleet(
+        instance_types=[item[0] for item in kept],
+        capacity=np.stack([item[1] for item in kept]),
+        total=np.stack([item[2] for item in kept]),
+        prices=np.array([item[3] for item in kept], dtype=np.float32),
+    )

@@ -1,0 +1,79 @@
+"""Shared test fixtures: instance-type catalogs and pod builders, modeled on
+the reference's fake cloud provider fixtures (ref: pkg/cloudprovider/fake/
+cloudprovider.go:36-116 and instancetype.go:69-80)."""
+
+from typing import List, Optional
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.cloudprovider import InstanceType, Offering
+
+ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
+
+
+def offerings(price: float, zones=ZONES, spot_discount: float = 0.7) -> List[Offering]:
+    out = []
+    for zone in zones:
+        out.append(Offering(zone=zone, capacity_type="on-demand", price=price))
+        out.append(Offering(zone=zone, capacity_type="spot", price=price * spot_discount))
+    return out
+
+
+def cpu_instance(name: str, cpu: float, mem_gib: float, pods: int = 110,
+                 price: Optional[float] = None, zones=ZONES, arch="amd64") -> InstanceType:
+    return InstanceType(
+        name=name,
+        capacity={"cpu": cpu, "memory": f"{mem_gib}Gi", "pods": pods},
+        architecture=arch,
+        offerings=offerings(price if price is not None else cpu * 0.05, zones=zones),
+    )
+
+
+def gpu_instance(name: str, cpu: float, mem_gib: float, gpus: int,
+                 price: Optional[float] = None) -> InstanceType:
+    return InstanceType(
+        name=name,
+        capacity={
+            "cpu": cpu,
+            "memory": f"{mem_gib}Gi",
+            "pods": 110,
+            wellknown.RESOURCE_NVIDIA_GPU: gpus,
+        },
+        offerings=offerings(price if price is not None else cpu * 0.15),
+    )
+
+
+def size_ladder(n: int) -> List[InstanceType]:
+    """n instance types with linearly growing capacity and price
+    (ref: fake.InstanceTypes(n) generates a linear ladder)."""
+    return [
+        cpu_instance(f"ladder-{i + 1}", cpu=2 * (i + 1), mem_gib=4 * (i + 1),
+                     price=0.05 * (i + 1))
+        for i in range(n)
+    ]
+
+
+def default_catalog() -> List[InstanceType]:
+    return [
+        cpu_instance("default-instance-type", cpu=16, mem_gib=64, price=0.8),
+        cpu_instance("small-instance-type", cpu=2, mem_gib=4, price=0.1),
+        gpu_instance("gpu-instance-type", cpu=16, mem_gib=64, gpus=2, price=2.4),
+        cpu_instance("arm-instance-type", cpu=16, mem_gib=64, price=0.7, arch="arm64"),
+    ]
+
+
+_counter = [0]
+
+
+def pod(cpu="1", memory="512Mi", name=None, **kwargs) -> PodSpec:
+    _counter[0] += 1
+    return PodSpec(
+        name=name or f"pod-{_counter[0]}",
+        requests={"cpu": cpu, "memory": memory},
+        unschedulable=True,
+        **kwargs,
+    )
+
+
+def pods(n: int, cpu="1", memory="512Mi", **kwargs) -> List[PodSpec]:
+    return [pod(cpu=cpu, memory=memory, **kwargs) for _ in range(n)]

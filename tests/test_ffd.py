@@ -1,0 +1,242 @@
+"""Greedy FFD baseline tests — semantics mirrored from the reference packer
+suite plus a randomized cross-check against an independent per-pod greedy
+implementation (the grouped packer must be exact, not approximate)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.cloudprovider import InstanceType, Offering
+from karpenter_tpu.ops.encode import build_fleet, group_pods, resource_vector
+from karpenter_tpu.ops import ffd
+
+from tests import fixtures
+
+
+def no_constraints() -> Constraints:
+    return Constraints()
+
+
+class TestEncode:
+    def test_resource_vector_units(self):
+        vec = resource_vector({"cpu": 1.5, "memory": 2 * 1024**3, "pods": 1.0})
+        assert vec[wellknown.RESOURCE_DIM_INDEX["cpu"]] == 1500.0  # millicores
+        assert vec[wellknown.RESOURCE_DIM_INDEX["memory"]] == 2048.0  # MiB
+        assert vec[wellknown.RESOURCE_DIM_INDEX["pods"]] == 1.0
+
+    def test_group_pods_sorted_desc(self):
+        pods = (
+            fixtures.pods(3, cpu="1")
+            + fixtures.pods(2, cpu="4")
+            + fixtures.pods(4, cpu="2")
+        )
+        groups = group_pods(pods)
+        cpu = wellknown.RESOURCE_DIM_INDEX["cpu"]
+        assert list(groups.vectors[:, cpu]) == [4000.0, 2000.0, 1000.0]
+        assert list(groups.counts) == [2, 4, 3]
+        assert groups.num_pods == 9
+
+    def test_fleet_sorted_ascending(self):
+        fleet = build_fleet(
+            fixtures.size_ladder(5)[::-1], no_constraints(), fixtures.pods(1)
+        )
+        assert [it.name for it in fleet.instance_types] == [
+            f"ladder-{i}" for i in range(1, 6)
+        ]
+
+    def test_fleet_filters_zone(self):
+        constraints = Constraints(
+            requirements=Requirements(
+                [Requirement.in_(wellknown.ZONE_LABEL, ["nowhere"])]
+            )
+        )
+        fleet = build_fleet(fixtures.size_ladder(3), constraints, fixtures.pods(1))
+        assert fleet.num_types == 0
+
+    def test_fleet_gpu_anti_waste(self):
+        catalog = fixtures.default_catalog()
+        # CPU-only pods: gpu + arm types excluded (arch default amd64 is only
+        # excluded by requirements; arm stays unless constrained).
+        fleet = build_fleet(catalog, no_constraints(), fixtures.pods(1))
+        names = {it.name for it in fleet.instance_types}
+        assert "gpu-instance-type" not in names
+        assert "default-instance-type" in names
+        # GPU pod: only the gpu type remains.
+        gpu_pod = fixtures.pod()
+        gpu_pod.requests[wellknown.RESOURCE_NVIDIA_GPU] = 1.0
+        fleet = build_fleet(catalog, no_constraints(), [gpu_pod])
+        assert [it.name for it in fleet.instance_types] == ["gpu-instance-type"]
+
+    def test_fleet_daemon_overhead_reserved(self):
+        small = fixtures.cpu_instance("small", cpu=2, mem_gib=4)
+        daemons = fixtures.pods(1, cpu="1800m")
+        fleet = build_fleet([small], no_constraints(), fixtures.pods(1), daemons)
+        cpu = wellknown.RESOURCE_DIM_INDEX["cpu"]
+        assert fleet.num_types == 1
+        assert fleet.capacity[0][cpu] == pytest.approx(200.0)
+        # Daemons that don't fit exclude the type entirely.
+        fleet = build_fleet(
+            [small], no_constraints(), fixtures.pods(1), fixtures.pods(1, cpu="3")
+        )
+        assert fleet.num_types == 0
+
+    def test_kubelet_overhead_reserved(self):
+        it = InstanceType(
+            name="overheady",
+            capacity={"cpu": 4, "memory": "8Gi", "pods": 10},
+            overhead={"cpu": 1, "memory": "1Gi"},
+            offerings=fixtures.offerings(0.1),
+        )
+        fleet = build_fleet([it], no_constraints(), fixtures.pods(1))
+        cpu = wellknown.RESOURCE_DIM_INDEX["cpu"]
+        assert fleet.capacity[0][cpu] == pytest.approx(3000.0)
+
+
+class TestPack:
+    def test_homogeneous_pods_single_type(self):
+        # 100 pods of 1cpu/512Mi onto 16cpu/64Gi nodes: cpu-bound at 16/node
+        # -> 7 nodes (6x16 + 1x4), all merged into one packing by options-hash.
+        result = ffd.pack(
+            fixtures.pods(100),
+            [fixtures.cpu_instance("only", cpu=16, mem_gib=64)],
+            no_constraints(),
+        )
+        assert not result.unschedulable
+        assert result.node_count == 7
+        assert sum(len(n) for p in result.packings for n in p.pods_per_node) == 100
+
+    def test_prefers_smallest_type_achieving_bound(self):
+        # 3 pods x 1cpu. ladder-2 (4cpu) fits 3; ladder-5 (10cpu) also fits 3.
+        # The smallest achieving the largest-type bound must win.
+        result = ffd.pack(fixtures.pods(3), fixtures.size_ladder(5), no_constraints())
+        assert result.node_count == 1
+        assert result.packings[0].instance_type_options[0].name == "ladder-2"
+
+    def test_instance_options_are_consecutive_larger(self):
+        result = ffd.pack(fixtures.pods(3), fixtures.size_ladder(30), no_constraints())
+        options = result.packings[0].instance_type_options
+        assert len(options) == ffd.MAX_INSTANCE_TYPES
+        assert options[0].name == "ladder-2"
+        assert options[-1].name == "ladder-21"
+
+    def test_oversized_pod_set_aside(self):
+        giant = fixtures.pod(cpu="64")
+        result = ffd.pack(
+            [giant] + fixtures.pods(2),
+            [fixtures.cpu_instance("small", cpu=4, mem_gib=8)],
+            no_constraints(),
+        )
+        assert result.unschedulable == [giant]
+        assert result.node_count == 1
+
+    def test_no_instance_types_all_unschedulable(self):
+        result = ffd.pack(fixtures.pods(5), [], no_constraints())
+        assert len(result.unschedulable) == 5
+        assert result.packings == []
+
+    def test_mixed_sizes_ffd_pairs(self):
+        # 2.2cpu-capacity nodes; pods 1.5 + 0.5 pair up per node.
+        pods = fixtures.pods(4, cpu="1500m") + fixtures.pods(4, cpu="500m")
+        result = ffd.pack(
+            pods,
+            [fixtures.cpu_instance("two", cpu=2.2, mem_gib=8)],
+            no_constraints(),
+        )
+        assert result.node_count == 4
+        for packing in result.packings:
+            for node_pods in packing.pods_per_node:
+                total = sum(p.requests["cpu"] for p in node_pods)
+                assert total == pytest.approx(2.0)
+
+    def test_exact_fit_early_exit_quirk(self):
+        # Reference quirk (packable.go:147-157): fits() uses Cmp >= 0, so when
+        # remaining capacity EXACTLY equals the smallest pod, packing stops
+        # early and the exact-fit pod is NOT packed. On 2cpu nodes a 1.5 pod
+        # leaves 0.5 remaining == smallest pod -> each 1.5 pod rides alone.
+        pods = fixtures.pods(4, cpu="1500m") + fixtures.pods(4, cpu="500m")
+        result = ffd.pack(
+            pods,
+            [fixtures.cpu_instance("two", cpu=2, mem_gib=8)],
+            no_constraints(),
+        )
+        assert result.node_count == 5  # 4 lone 1.5-pods + 1 node of 4x0.5
+
+    def test_pod_slot_limit(self):
+        result = ffd.pack(
+            fixtures.pods(10, cpu="100m", memory="64Mi"),
+            [fixtures.cpu_instance("tiny-slots", cpu=16, mem_gib=64, pods=4)],
+            no_constraints(),
+        )
+        assert result.node_count == 3  # 4 + 4 + 2 pods
+
+    def test_projected_cost(self):
+        result = ffd.pack(
+            fixtures.pods(100),
+            [fixtures.cpu_instance("only", cpu=16, mem_gib=64, price=1.0)],
+            no_constraints(),
+        )
+        # 7 nodes x cheapest offering (spot = 0.7).
+        assert result.projected_cost() == pytest.approx(7 * 0.7)
+
+
+def per_pod_reference_pack(capacity, total, pod_vectors):
+    """Independent per-pod greedy oracle mirroring packable.go:113-132."""
+    remaining = capacity.astype(np.float64).copy()
+    packed = []
+    unpacked = []
+    n = len(pod_vectors)
+    i = 0
+    while i < n:
+        vec = pod_vectors[i]
+        if np.all(remaining - vec >= -1e-9):
+            remaining -= vec
+            packed.append(i)
+            i += 1
+            continue
+        smallest = pod_vectors[-1]
+        if np.any((total > 0) & (remaining <= smallest + 1e-9)):
+            unpacked.extend(range(i, n))
+            break
+        if not packed:
+            return [], list(range(n))
+        unpacked.append(i)
+        i += 1
+    return packed, unpacked
+
+
+class TestGroupedMatchesPerPod:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fill_node_matches_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        num_shapes = rng.integers(1, 6)
+        shapes = []
+        for _ in range(num_shapes):
+            cpu = float(rng.integers(1, 9) * 250)
+            mem = float(rng.integers(1, 17) * 256)
+            shapes.append((cpu, mem, int(rng.integers(1, 30))))
+        pods = []
+        for cpu, mem, count in shapes:
+            pods += fixtures.pods(count, cpu=f"{int(cpu)}m", memory=f"{int(mem)}Mi")
+        groups = group_pods(pods)
+        it = fixtures.cpu_instance("node", cpu=8, mem_gib=16, pods=40)
+        fleet = build_fleet([it], no_constraints(), pods)
+
+        packed_counts = ffd.fill_node(
+            fleet.capacity[0], fleet.total[0], groups.vectors, groups.counts
+        )
+
+        # Expand groups into the per-pod sorted order the oracle expects.
+        pod_vectors = np.repeat(groups.vectors, groups.counts, axis=0)
+        oracle_packed, _ = per_pod_reference_pack(
+            fleet.capacity[0], fleet.total[0], pod_vectors
+        )
+        assert int(packed_counts.sum()) == len(oracle_packed)
+        # Group-level identity: the oracle's packed indices map to the same
+        # per-group counts.
+        boundaries = np.cumsum(groups.counts)
+        oracle_by_group = np.zeros(groups.num_groups, dtype=np.int64)
+        for idx in oracle_packed:
+            oracle_by_group[np.searchsorted(boundaries, idx, side="right")] += 1
+        assert list(packed_counts) == list(oracle_by_group)
