@@ -1,0 +1,119 @@
+"""Benchmark: the north-star config — 50k pending pods × 400 instance types
+(BASELINE.json config 5 scale) solved by the TPU kernel, versus the host
+greedy FFD baseline (the reference algorithm, ref:
+pkg/controllers/provisioning/binpacking/packer.go:82-189).
+
+Prints ONE JSON line:
+  metric       solve latency p50 for 50k pods x 400 types on the accelerator
+  value/unit   milliseconds
+  vs_baseline  host-greedy-latency / tpu-latency (speedup; >1 = faster)
+plus extra keys: p99_ms, baseline_ms, cost_ratio (TPU cost solver $/hr vs
+greedy $/hr; <1 = cheaper), pods, types.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def make_workload(num_pods=50_000, num_types=400, seed=0):
+    from karpenter_tpu.api.pods import PodSpec
+    from karpenter_tpu.cloudprovider import InstanceType, Offering
+
+    rng = np.random.default_rng(seed)
+    # 16 pod shapes, zipf-ish popularity — a consolidation-replay-like mix.
+    shapes = []
+    for _ in range(16):
+        cpu = int(rng.integers(1, 17)) * 250
+        mem = int(rng.integers(1, 33)) * 256
+        shapes.append((cpu, mem))
+    weights = 1.0 / np.arange(1, len(shapes) + 1)
+    weights /= weights.sum()
+    pods = []
+    shape_counts = (weights * num_pods).astype(int)
+    shape_counts[0] += num_pods - shape_counts.sum()
+    for (cpu, mem), count in zip(shapes, shape_counts):
+        for i in range(count):
+            pods.append(
+                PodSpec(
+                    name=f"pod-{cpu}m-{mem}Mi-{i}",
+                    requests={"cpu": f"{cpu}m", "memory": f"{mem}Mi"},
+                    unschedulable=True,
+                )
+            )
+
+    # 400 types: families with distinct cpu:mem ratios, sizes, and a mild
+    # superlinear price curve on the largest sizes (spot-market shape).
+    catalog = []
+    zones = ("z-1a", "z-1b", "z-1c")
+    families = [("c", 2.0, 0.17), ("m", 4.0, 0.192), ("r", 8.0, 0.252), ("x", 16.0, 0.333)]
+    sizes = [1, 2, 3, 4, 6, 8, 12, 16, 24, 32]
+    idx = 0
+    while len(catalog) < num_types:
+        fam, mem_per_cpu, base = families[idx % len(families)]
+        size = sizes[(idx // len(families)) % len(sizes)]
+        gen = idx // (len(families) * len(sizes))
+        cpu = 2 * size
+        price = base * size * (1.0 + 0.05 * (size >= 16)) * (1.0 + 0.03 * gen)
+        catalog.append(
+            InstanceType(
+                name=f"{fam}{gen}.{size}x",
+                capacity={"cpu": cpu, "memory": f"{int(cpu * mem_per_cpu)}Gi", "pods": 110},
+                offerings=[
+                    Offering(zone=z, capacity_type=ct, price=price * (0.65 if ct == "spot" else 1.0))
+                    for z in zones
+                    for ct in ("on-demand", "spot")
+                ],
+            )
+        )
+        idx += 1
+    return pods, catalog
+
+
+def main():
+    from karpenter_tpu.api.provisioner import Constraints
+    from karpenter_tpu.models.solver import CostSolver, GreedySolver, TPUSolver
+
+    pods, catalog = make_workload()
+    constraints = Constraints()
+
+    tpu_solver = TPUSolver(mode="cost", quirk=False)
+    # Warmup: trigger compilation for the bucketed shapes.
+    tpu_solver.solve(pods, catalog, constraints)
+
+    latencies = []
+    for _ in range(10):
+        start = time.perf_counter()
+        tpu_result = tpu_solver.solve(pods, catalog, constraints)
+        latencies.append((time.perf_counter() - start) * 1e3)
+    p50 = float(np.percentile(latencies, 50))
+    p99 = float(np.percentile(latencies, 99))
+
+    start = time.perf_counter()
+    greedy_result = GreedySolver().solve(pods, catalog, constraints)
+    baseline_ms = (time.perf_counter() - start) * 1e3
+
+    cost_result = CostSolver().solve(pods, catalog, constraints)
+    greedy_cost = greedy_result.projected_cost()
+    cost_ratio = cost_result.projected_cost() / greedy_cost if greedy_cost else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "solve_latency_p50_50k_pods_400_types",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / p50, 3) if p50 else 0.0,
+                "p99_ms": round(p99, 3),
+                "baseline_ms": round(baseline_ms, 3),
+                "cost_ratio": round(cost_ratio, 4),
+                "pods": len(pods),
+                "types": len(catalog),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,11 @@ class PodSpec:
     preferred_terms: List[PreferredTerm] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    # Inter-pod (anti-)affinity is unsupported by the provisioning path
+    # (ref: selection/controller.go:117-123 rejects it); modeled only so
+    # selection can reject such pods.
+    pod_affinity_terms: List[dict] = field(default_factory=list)
+    pod_anti_affinity_terms: List[dict] = field(default_factory=list)
 
     # Ownership / lifecycle.
     owner_kind: Optional[str] = None  # "DaemonSet", "Node", "ReplicaSet", ...

@@ -1,0 +1,263 @@
+"""Fake cloud provider: instant nodes, canned catalogs, fault injection.
+
+Ref: pkg/cloudprovider/fake/cloudprovider.go (instant fake nodes honoring
+requested zone/capacity-type; canned instance-type catalog) and
+pkg/cloudprovider/aws/fake/ec2api.go (InsufficientCapacityPools to exercise
+ICE blackout fallback). Used by tests and by the runtime when no real cloud
+is configured.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Constraints, Provisioner
+from karpenter_tpu.cloudprovider import (
+    CloudProvider,
+    InstanceType,
+    InsufficientCapacityError,
+    NodeSpec,
+    Offering,
+)
+
+ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
+
+_node_counter = itertools.count(1)
+
+# ICE blackout TTL (ref: aws/instancetypes.go:37 — 45s).
+UNAVAILABLE_OFFERING_TTL = 45.0
+
+
+def _offerings(price: float, zones=ZONES) -> List[Offering]:
+    return [
+        Offering(zone=zone, capacity_type=ct, price=price * (0.6 if ct == "spot" else 1.0))
+        for zone in zones
+        for ct in (wellknown.CAPACITY_TYPE_ON_DEMAND, wellknown.CAPACITY_TYPE_SPOT)
+    ]
+
+
+def default_instance_types() -> List[InstanceType]:
+    """Canned catalog mirroring the reference's fake fixtures
+    (ref: fake/cloudprovider.go:36-116): default, small, gpu, arm."""
+    return [
+        InstanceType(
+            name="default-instance-type",
+            capacity={"cpu": 16, "memory": "64Gi", "pods": 110},
+            offerings=_offerings(0.8),
+        ),
+        InstanceType(
+            name="small-instance-type",
+            capacity={"cpu": 2, "memory": "4Gi", "pods": 110},
+            offerings=_offerings(0.1),
+        ),
+        InstanceType(
+            name="nvidia-gpu-instance-type",
+            capacity={
+                "cpu": 16,
+                "memory": "64Gi",
+                "pods": 110,
+                wellknown.RESOURCE_NVIDIA_GPU: 2,
+            },
+            offerings=_offerings(2.4),
+        ),
+        InstanceType(
+            name="amd-gpu-instance-type",
+            capacity={
+                "cpu": 16,
+                "memory": "64Gi",
+                "pods": 110,
+                wellknown.RESOURCE_AMD_GPU: 2,
+            },
+            offerings=_offerings(2.0),
+        ),
+        InstanceType(
+            name="tpu-instance-type",
+            capacity={
+                "cpu": 96,
+                "memory": "192Gi",
+                "pods": 110,
+                wellknown.RESOURCE_GOOGLE_TPU: 4,
+            },
+            offerings=_offerings(4.8),
+        ),
+        InstanceType(
+            name="arm-instance-type",
+            capacity={"cpu": 16, "memory": "64Gi", "pods": 110},
+            architecture="arm64",
+            offerings=_offerings(0.7),
+        ),
+        InstanceType(
+            name="pod-eni-instance-type",
+            capacity={
+                "cpu": 4,
+                "memory": "16Gi",
+                "pods": 110,
+                wellknown.RESOURCE_AWS_POD_ENI: 38,
+            },
+            offerings=_offerings(0.3),
+        ),
+    ]
+
+
+def instance_type_ladder(n: int) -> List[InstanceType]:
+    """Linear size ladder for benchmarks (ref: fake/instancetype.go:69-80)."""
+    return [
+        InstanceType(
+            name=f"fake-ladder-{i + 1}",
+            capacity={"cpu": 2 * (i + 1), "memory": f"{4 * (i + 1)}Gi", "pods": 110},
+            offerings=_offerings(0.05 * (i + 1)),
+        )
+        for i in range(n)
+    ]
+
+
+class FakeCloudProvider(CloudProvider):
+    """Instant node launches honoring the tightened constraints; records all
+    launch calls; injectable insufficient-capacity pools."""
+
+    def __init__(
+        self,
+        instance_types: Optional[List[InstanceType]] = None,
+        clock=None,
+    ):
+        self._instance_types = (
+            list(instance_types) if instance_types is not None else default_instance_types()
+        )
+        self.clock = clock
+        self.create_calls: List[Tuple[Constraints, List[str], int]] = []
+        self.deleted_nodes: List[str] = []
+        # (instance_type, zone, capacity_type) triples that fail with ICE
+        # (ref: aws/fake/ec2api.go InsufficientCapacityPools:54).
+        self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
+        # Offering blackout cache (ref: aws/instancetypes.go:174-183).
+        self._unavailable: Dict[Tuple[str, str, str], float] = {}
+        self._lock = threading.Lock()
+
+    # --- helpers ------------------------------------------------------------
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else time.time()
+
+    def set_instance_types(self, instance_types: List[InstanceType]) -> None:
+        self._instance_types = list(instance_types)
+
+    def cache_unavailable(self, instance_type: str, zone: str, capacity_type: str):
+        with self._lock:
+            self._unavailable[(instance_type, zone, capacity_type)] = (
+                self._now() + UNAVAILABLE_OFFERING_TTL
+            )
+
+    def _offering_available(self, name: str, offering: Offering) -> bool:
+        key = (name, offering.zone, offering.capacity_type)
+        with self._lock:
+            expiry = self._unavailable.get(key)
+            if expiry is None:
+                return True
+            if self._now() >= expiry:
+                del self._unavailable[key]
+                return True
+            return False
+
+    # --- CloudProvider ------------------------------------------------------
+
+    def get_instance_types(self, constraints: Optional[Constraints] = None) -> List[InstanceType]:
+        """Catalog with blacked-out offerings filtered (ref: instancetypes.go
+        Get:61-104 subtracts the unavailable-offerings cache)."""
+        out = []
+        for it in self._instance_types:
+            offerings = [
+                o for o in it.offerings if self._offering_available(it.name, o)
+            ]
+            if not offerings:
+                continue
+            out.append(
+                InstanceType(
+                    name=it.name,
+                    capacity=dict(it.capacity),
+                    overhead=dict(it.overhead),
+                    architecture=it.architecture,
+                    operating_systems=it.operating_systems,
+                    offerings=offerings,
+                )
+            )
+        return out
+
+    def create(
+        self,
+        constraints: Constraints,
+        instance_types: Sequence[InstanceType],
+        quantity: int,
+        callback: Callable[[NodeSpec], None],
+    ) -> List[Exception]:
+        self.create_calls.append(
+            (constraints, [it.name for it in instance_types], quantity)
+        )
+        errors: List[Exception] = []
+        requirements = constraints.effective_requirements()
+        allowed_zones = requirements.allowed(wellknown.ZONE_LABEL)
+        allowed_capacity = requirements.allowed(wellknown.CAPACITY_TYPE_LABEL)
+        for _ in range(quantity):
+            launched = False
+            last_error: Optional[Exception] = None
+            # Lowest-price-first across offered types, honoring constraints —
+            # the fleet-API behavior the reference delegates to EC2.
+            candidates = []
+            for it in instance_types:
+                for offering in it.offerings:
+                    if not allowed_zones.contains(offering.zone):
+                        continue
+                    if not allowed_capacity.contains(offering.capacity_type):
+                        continue
+                    candidates.append((offering.price, it, offering))
+            candidates.sort(key=lambda c: c[0])
+            for _, it, offering in candidates:
+                pool = (it.name, offering.zone, offering.capacity_type)
+                if pool in self.insufficient_capacity_pools:
+                    last_error = InsufficientCapacityError(*pool)
+                    self.cache_unavailable(*pool)
+                    continue
+                node = NodeSpec(
+                    name=f"fake-node-{next(_node_counter)}",
+                    labels={
+                        wellknown.INSTANCE_TYPE_LABEL: it.name,
+                        wellknown.ZONE_LABEL: offering.zone,
+                        wellknown.CAPACITY_TYPE_LABEL: offering.capacity_type,
+                        wellknown.ARCH_LABEL: it.architecture,
+                        wellknown.OS_LABEL: sorted(it.operating_systems)[0],
+                    },
+                    capacity=dict(it.capacity),
+                    instance_type=it.name,
+                    zone=offering.zone,
+                    capacity_type=offering.capacity_type,
+                    provider_id=f"fake:///{it.name}/{offering.zone}",
+                )
+                callback(node)
+                launched = True
+                break
+            if not launched:
+                errors.append(
+                    last_error
+                    or RuntimeError("no offering satisfies constraints")
+                )
+        return errors
+
+    def delete(self, node: NodeSpec) -> None:
+        self.deleted_nodes.append(node.name)
+
+    def default(self, provisioner: Provisioner) -> None:
+        """Default capacity-type to on-demand if unconstrained
+        (vendor-defaulting parity with aws/apis/v1alpha1/provider_defaults.go)."""
+        requirements = provisioner.spec.constraints.requirements
+        if requirements.capacity_types() is None:
+            from karpenter_tpu.api.requirements import Requirement
+
+            provisioner.spec.constraints.requirements = requirements.add(
+                Requirement.in_(
+                    wellknown.CAPACITY_TYPE_LABEL,
+                    [wellknown.CAPACITY_TYPE_ON_DEMAND, wellknown.CAPACITY_TYPE_SPOT],
+                )
+            )

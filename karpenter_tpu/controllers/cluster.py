@@ -1,0 +1,221 @@
+"""In-memory cluster state store — the envtest replacement.
+
+Ref: pkg/test/environment.go boots a real apiserver via envtest; controllers
+talk to it through a client. Here the same role is played by a thread-safe
+in-process store with the handful of verbs the controllers use (get / list /
+create / delete / bind / patch-like mutation under lock) plus watch-style
+callbacks so the runtime can trigger reconciles on changes. All state the
+framework needs survives in this store (SURVEY.md §5 checkpoint/resume: "all
+state is in the Kubernetes API"); controllers stay stateless-restartable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.utils.clock import Clock
+
+PodKey = Tuple[str, str]  # (namespace, name)
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class Cluster:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._lock = threading.RLock()
+        self._pods: Dict[PodKey, PodSpec] = {}
+        self._nodes: Dict[str, NodeSpec] = {}
+        self._provisioners: Dict[str, Provisioner] = {}
+        self._daemonsets: Dict[str, PodSpec] = {}  # name -> pod template
+        self._pdbs: Dict[str, Tuple[Dict[str, str], int]] = {}  # selector, minAvailable
+        self._watchers: List[Callable[[str, object], None]] = []
+
+    # --- watch plumbing ----------------------------------------------------
+
+    def watch(self, callback: Callable[[str, object], None]) -> None:
+        """callback(kind, obj) on every mutation; kind in
+        {pod, node, provisioner, daemonset}."""
+        self._watchers.append(callback)
+
+    def _notify(self, kind: str, obj) -> None:
+        for callback in list(self._watchers):
+            callback(kind, obj)
+
+    # --- pods --------------------------------------------------------------
+
+    def apply_pod(self, pod: PodSpec) -> PodSpec:
+        with self._lock:
+            self._pods[(pod.namespace, pod.name)] = pod
+        self._notify("pod", pod)
+        return pod
+
+    def get_pod(self, namespace: str, name: str) -> PodSpec:
+        with self._lock:
+            try:
+                return self._pods[(namespace, name)]
+            except KeyError:
+                raise NotFoundError(f"pod {namespace}/{name}")
+
+    def try_get_pod(self, namespace: str, name: str) -> Optional[PodSpec]:
+        with self._lock:
+            return self._pods.get((namespace, name))
+
+    def list_pods(
+        self,
+        node_name: Optional[str] = None,
+        predicate: Optional[Callable[[PodSpec], bool]] = None,
+    ) -> List[PodSpec]:
+        """node_name uses the same role as the reference's spec.nodeName field
+        index (ref: manager.go:60-66)."""
+        with self._lock:
+            pods = list(self._pods.values())
+        if node_name is not None:
+            pods = [p for p in pods if p.node_name == node_name]
+        if predicate is not None:
+            pods = [p for p in pods if predicate(p)]
+        return pods
+
+    def bind_pod(self, pod: PodSpec, node: NodeSpec) -> None:
+        with self._lock:
+            stored = self._pods.get((pod.namespace, pod.name))
+            if stored is None:
+                raise NotFoundError(f"pod {pod.namespace}/{pod.name}")
+            stored.node_name = node.name
+            stored.unschedulable = False
+        self._notify("pod", stored)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+        if pod is not None:
+            self._notify("pod", pod)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """Eviction-API analogue: honors PDBs (429-equivalent refusal)
+        (ref: termination/eviction.go:90-109)."""
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                return
+            if not self._pdb_allows(pod):
+                from karpenter_tpu.controllers.errors import PDBViolationError
+
+                raise PDBViolationError(f"pod {namespace}/{name} blocked by PDB")
+            pod.deletion_timestamp = self.clock.now()
+        self._notify("pod", pod)
+
+    # --- pod disruption budgets (simplified) --------------------------------
+
+    def apply_pdb(self, name: str, match_labels: Dict[str, str], min_available: int):
+        with self._lock:
+            self._pdbs[name] = (dict(match_labels), min_available)
+
+    def _pdb_allows(self, pod: PodSpec) -> bool:
+        for match_labels, min_available in self._pdbs.values():
+            if all(pod.labels.get(k) == v for k, v in match_labels.items()):
+                with self._lock:
+                    healthy = [
+                        p
+                        for p in self._pods.values()
+                        if p.deletion_timestamp is None
+                        and all(p.labels.get(k) == v for k, v in match_labels.items())
+                    ]
+                if len(healthy) - 1 < min_available:
+                    return False
+        return True
+
+    # --- nodes -------------------------------------------------------------
+
+    def create_node(self, node: NodeSpec) -> NodeSpec:
+        with self._lock:
+            if not node.created_at:
+                node.created_at = self.clock.now()
+            self._nodes[node.name] = node
+        self._notify("node", node)
+        return node
+
+    def get_node(self, name: str) -> NodeSpec:
+        with self._lock:
+            try:
+                return self._nodes[name]
+            except KeyError:
+                raise NotFoundError(f"node {name}")
+
+    def try_get_node(self, name: str) -> Optional[NodeSpec]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def list_nodes(
+        self, predicate: Optional[Callable[[NodeSpec], bool]] = None
+    ) -> List[NodeSpec]:
+        with self._lock:
+            nodes = list(self._nodes.values())
+        if predicate is not None:
+            nodes = [n for n in nodes if predicate(n)]
+        return nodes
+
+    def update_node(self, node: NodeSpec) -> None:
+        self._notify("node", node)
+
+    def delete_node(self, name: str) -> None:
+        """Marks deletion; the object lingers while finalizers remain
+        (ref: the apiserver finalizer protocol driving termination §3.4)."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                return
+            if node.deletion_timestamp is None:
+                node.deletion_timestamp = self.clock.now()
+            if not node.finalizers:
+                self._nodes.pop(name, None)
+        self._notify("node", node)
+
+    def remove_finalizer(self, node: NodeSpec, finalizer: str) -> None:
+        with self._lock:
+            if finalizer in node.finalizers:
+                node.finalizers.remove(finalizer)
+            if node.deletion_timestamp is not None and not node.finalizers:
+                self._nodes.pop(node.name, None)
+        self._notify("node", node)
+
+    # --- provisioners ------------------------------------------------------
+
+    def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:
+        with self._lock:
+            self._provisioners[provisioner.name] = provisioner
+        self._notify("provisioner", provisioner)
+        return provisioner
+
+    def try_get_provisioner(self, name: str) -> Optional[Provisioner]:
+        with self._lock:
+            return self._provisioners.get(name)
+
+    def list_provisioners(self) -> List[Provisioner]:
+        with self._lock:
+            return sorted(self._provisioners.values(), key=lambda p: p.name)
+
+    def delete_provisioner(self, name: str) -> None:
+        with self._lock:
+            provisioner = self._provisioners.pop(name, None)
+        if provisioner is not None:
+            provisioner.deletion_timestamp = self.clock.now()
+            self._notify("provisioner", provisioner)
+
+    # --- daemonsets ---------------------------------------------------------
+
+    def apply_daemonset(self, name: str, pod_template: PodSpec) -> None:
+        with self._lock:
+            self._daemonsets[name] = pod_template
+        self._notify("daemonset", pod_template)
+
+    def list_daemonset_templates(self) -> List[PodSpec]:
+        with self._lock:
+            return list(self._daemonsets.values())

@@ -1,0 +1,71 @@
+"""Metrics controller: periodic gauges for capacity and pod phases.
+
+Ref: pkg/controllers/metrics/{controller,nodes,pods}.go — polls every 10s per
+Provisioner and publishes node counts by {provisioner}×{zone|arch|instance
+-type} plus pod-phase counts.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.utils.metrics import REGISTRY
+
+POLL_SECONDS = 10.0  # ref: metrics/controller.go:69
+
+NODE_COUNT_BY_ZONE = REGISTRY.gauge(
+    "nodes_by_zone", "Node count per provisioner and zone", ["provisioner", "zone"]
+)
+NODE_COUNT_BY_ARCH = REGISTRY.gauge(
+    "nodes_by_arch", "Node count per provisioner and architecture", ["provisioner", "arch"]
+)
+NODE_COUNT_BY_INSTANCE_TYPE = REGISTRY.gauge(
+    "nodes_by_instance_type",
+    "Node count per provisioner and instance type",
+    ["provisioner", "instance_type"],
+)
+POD_COUNT_BY_PHASE = REGISTRY.gauge(
+    "pods_by_phase", "Pod count per provisioner and phase", ["provisioner", "phase"]
+)
+
+
+class MetricsController:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile(self, provisioner_name: str) -> float:
+        # Clear this provisioner's series first so vanished zones/types/phases
+        # don't keep reporting their last value forever.
+        for gauge in (
+            NODE_COUNT_BY_ZONE,
+            NODE_COUNT_BY_ARCH,
+            NODE_COUNT_BY_INSTANCE_TYPE,
+            POD_COUNT_BY_PHASE,
+        ):
+            gauge.remove_where(lambda key: key and key[0] == provisioner_name)
+        nodes = self.cluster.list_nodes(
+            predicate=lambda n: n.labels.get(wellknown.PROVISIONER_NAME_LABEL)
+            == provisioner_name
+        )
+        by_zone: Counter = Counter(n.zone for n in nodes if n.zone)
+        by_arch: Counter = Counter(
+            n.labels.get(wellknown.ARCH_LABEL, "") for n in nodes
+        )
+        by_type: Counter = Counter(n.instance_type for n in nodes if n.instance_type)
+        for zone, count in by_zone.items():
+            NODE_COUNT_BY_ZONE.set(count, provisioner_name, zone)
+        for arch, count in by_arch.items():
+            if arch:
+                NODE_COUNT_BY_ARCH.set(count, provisioner_name, arch)
+        for instance_type, count in by_type.items():
+            NODE_COUNT_BY_INSTANCE_TYPE.set(count, provisioner_name, instance_type)
+
+        node_names = {n.name for n in nodes}
+        phases: Counter = Counter(
+            p.phase for p in self.cluster.list_pods() if p.node_name in node_names
+        )
+        for phase, count in phases.items():
+            POD_COUNT_BY_PHASE.set(count, provisioner_name, phase)
+        return POLL_SECONDS

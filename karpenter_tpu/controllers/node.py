@@ -1,0 +1,156 @@
+"""Node lifecycle: readiness, liveness, expiration, emptiness, finalizer.
+
+Ref: pkg/controllers/node/*.go — an umbrella reconciler runs five
+sub-reconcilers over every karpenter-managed node and requeues at the soonest
+of their requested times (ref: utils/result/result.go Min combinator).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.controllers.cluster import Cluster
+
+LIVENESS_TIMEOUT_SECONDS = 15 * 60  # ref: node/liveness.go:31
+
+
+def _min_requeue(*results: Optional[float]) -> Optional[float]:
+    values = [r for r in results if r is not None]
+    return min(values) if values else None
+
+
+class Readiness:
+    """Strip the not-ready taint once the kubelet reports Ready
+    (ref: node/readiness.go:27-41)."""
+
+    def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
+        if not node.ready:
+            return None
+        before = len(node.taints)
+        node.taints = [
+            t for t in node.taints if t.key != wellknown.NOT_READY_TAINT_KEY
+        ]
+        if len(node.taints) != before:
+            cluster.update_node(node)
+        return None
+
+    # taint list uses Taint dataclass; imported for type parity
+    _ = Taint
+
+
+class Liveness:
+    """Delete nodes whose kubelet never reported within the timeout — the
+    runaway-scale guard (ref: node/liveness.go:31-52, designs/limits.md)."""
+
+    def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
+        if node.status_reported_at is not None:
+            return None
+        age = cluster.clock.now() - node.created_at
+        if age >= LIVENESS_TIMEOUT_SECONDS:
+            cluster.delete_node(node.name)
+            return None
+        return LIVENESS_TIMEOUT_SECONDS - age
+
+
+class Expiration:
+    """Delete nodes older than ttlSecondsUntilExpired — the node-upgrade /
+    chaos mechanism (ref: node/expiration.go:37-52)."""
+
+    def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
+        ttl = provisioner.spec.ttl_seconds_until_expired
+        if ttl is None:
+            return None
+        age = cluster.clock.now() - node.created_at
+        if age >= ttl:
+            cluster.delete_node(node.name)
+            return None
+        return ttl - age
+
+
+class Emptiness:
+    """Stamp/clear the emptiness timestamp; delete past ttlSecondsAfterEmpty
+    (ref: node/emptiness.go:38-99)."""
+
+    def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
+        ttl = provisioner.spec.ttl_seconds_after_empty
+        if ttl is None:
+            return None
+        if not node.ready:
+            return None
+        if not self._is_empty(cluster, node):
+            if wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in node.annotations:
+                del node.annotations[wellknown.EMPTINESS_TIMESTAMP_ANNOTATION]
+                cluster.update_node(node)
+            return None
+        stamp = node.annotations.get(wellknown.EMPTINESS_TIMESTAMP_ANNOTATION)
+        now = cluster.clock.now()
+        if stamp is None:
+            node.annotations[wellknown.EMPTINESS_TIMESTAMP_ANNOTATION] = str(now)
+            cluster.update_node(node)
+            return ttl
+        elapsed = now - float(stamp)
+        if elapsed >= ttl:
+            cluster.delete_node(node.name)
+            return None
+        return ttl - elapsed
+
+    @staticmethod
+    def _is_empty(cluster: Cluster, node: NodeSpec) -> bool:
+        """Empty = no pods besides daemons/static pods
+        (ref: emptiness.go isEmpty:84)."""
+        for pod in cluster.list_pods(node_name=node.name):
+            if pod.is_terminal() or pod.is_terminating():
+                continue
+            if pod.is_owned_by_daemonset() or pod.is_owned_by_node():
+                continue
+            return False
+        return True
+
+
+class Finalizer:
+    """Re-add the termination finalizer to nodes that lost or never had it
+    (ref: node/finalizer.go:28-40)."""
+
+    def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
+        if node.deletion_timestamp is not None:
+            return None
+        if wellknown.TERMINATION_FINALIZER not in node.finalizers:
+            node.finalizers.append(wellknown.TERMINATION_FINALIZER)
+            cluster.update_node(node)
+        return None
+
+
+class NodeController:
+    """Umbrella reconciler (ref: node/controller.go:61-115): only
+    karpenter-labeled nodes, skip deleting ones, run sub-reconcilers, requeue
+    at the soonest requested time."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.reconcilers = [
+            Readiness(),
+            Liveness(),
+            Expiration(),
+            Emptiness(),
+            Finalizer(),
+        ]
+
+    def reconcile(self, name: str) -> Optional[float]:
+        node = self.cluster.try_get_node(name)
+        if node is None or node.deletion_timestamp is not None:
+            return None
+        provisioner_name = node.labels.get(wellknown.PROVISIONER_NAME_LABEL)
+        if provisioner_name is None:
+            return None  # not ours
+        provisioner = self.cluster.try_get_provisioner(provisioner_name)
+        if provisioner is None:
+            return None
+        results = []
+        for reconciler in self.reconcilers:
+            results.append(reconciler.reconcile(self.cluster, provisioner, node))
+            if self.cluster.try_get_node(name) is None:
+                return None  # a sub-reconciler deleted the node
+        return _min_requeue(*results)

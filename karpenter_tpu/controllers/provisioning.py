@@ -1,0 +1,284 @@
+"""Provisioning: the per-Provisioner batching worker and its controller.
+
+Ref: pkg/controllers/provisioning/{controller,provisioner}.go. The controller
+reconciles Provisioner objects — refreshing requirements from live instance
+types, hash-comparing the spec, and hot-swapping the running worker. The
+worker batches incoming pods (1s idle / 10s max window, 2000-pod cap),
+schedules, solves, enforces limits, launches capacity, and binds pods.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.validation import default_provisioner, validate_provisioner
+from karpenter_tpu.cloudprovider import CloudProvider, NodeSpec
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.scheduling import Scheduler
+from karpenter_tpu.models.solver import GreedySolver, Solver
+from karpenter_tpu.ops.ffd import PackResult
+
+# Batching envelope (ref: provisioner.go:42-47).
+MAX_PODS_PER_BATCH = 2000
+BATCH_IDLE_SECONDS = 1.0
+BATCH_MAX_SECONDS = 10.0
+
+
+def global_requirements(instance_types) -> Requirements:
+    """Union of what the fleet actually offers, as In-requirements
+    (ref: provisioning/controller.go:138-159 refreshes zones/types/arch/os/
+    capacity-type from live instance types every reconcile)."""
+    zones, names, archs, oses, capacity_types = set(), set(), set(), set(), set()
+    for it in instance_types:
+        zones |= set(it.zones())
+        names.add(it.name)
+        archs.add(it.architecture)
+        oses |= set(it.operating_systems)
+        capacity_types |= set(it.capacity_types())
+    return Requirements(
+        [
+            Requirement.in_(wellknown.ZONE_LABEL, sorted(zones)),
+            Requirement.in_(wellknown.INSTANCE_TYPE_LABEL, sorted(names)),
+            Requirement.in_(wellknown.ARCH_LABEL, sorted(archs)),
+            Requirement.in_(wellknown.OS_LABEL, sorted(oses)),
+            Requirement.in_(wellknown.CAPACITY_TYPE_LABEL, sorted(capacity_types)),
+        ]
+    )
+
+
+def spec_hash(provisioner: Provisioner) -> int:
+    """Stable hash of the solver-relevant spec
+    (ref: controller.go:111-125 uses hashstructure)."""
+    spec = provisioner.spec
+    constraints = spec.constraints
+    return hash(
+        (
+            tuple(sorted(constraints.labels.items())),
+            tuple(constraints.taints),
+            constraints.requirements.canonical_key(),
+            repr(sorted((constraints.provider or {}).items())),
+            spec.ttl_seconds_after_empty,
+            spec.ttl_seconds_until_expired,
+            tuple(sorted(spec.limits.resources.items())) if spec.limits else None,
+        )
+    )
+
+
+@dataclass
+class ProvisionStats:
+    scheduled_pods: int = 0
+    launched_nodes: int = 0
+    unschedulable_pods: int = 0
+    launch_errors: List[Exception] = field(default_factory=list)
+
+
+class ProvisionerWorker:
+    """One batching loop per Provisioner (ref: provisioner.go:49-100 runs a
+    goroutine; here `add` enqueues and `provision` drains — the runtime's
+    thread loop calls provision on the batch window, tests call it directly)."""
+
+    def __init__(
+        self,
+        provisioner: Provisioner,
+        cluster: Cluster,
+        cloud: CloudProvider,
+        solver: Optional[Solver] = None,
+    ):
+        self.provisioner = provisioner
+        self.cluster = cluster
+        self.cloud = cloud
+        self.solver = solver or GreedySolver()
+        self.scheduler = Scheduler(cluster)
+        self._pending: List[PodSpec] = []
+        self._pending_uids: set = set()
+        self._lock = threading.Lock()
+        self._first_add: Optional[float] = None
+        self._last_add: Optional[float] = None
+        self._node_seq = 0
+
+    # --- batching (ref: provisioner.go:137-163) -----------------------------
+
+    def add(self, pod: PodSpec) -> bool:
+        with self._lock:
+            if len(self._pending) >= MAX_PODS_PER_BATCH:
+                return False
+            if pod.uid not in self._pending_uids:
+                self._pending.append(pod)
+                self._pending_uids.add(pod.uid)
+            now = self.cluster.clock.now()
+            if self._first_add is None:
+                self._first_add = now
+            self._last_add = now
+            return True
+
+    def batch_ready(self) -> bool:
+        """Window closed: 1s since last add or 10s since first, or full."""
+        with self._lock:
+            if not self._pending:
+                return False
+            if len(self._pending) >= MAX_PODS_PER_BATCH:
+                return True
+            now = self.cluster.clock.now()
+            return (
+                now - self._last_add >= BATCH_IDLE_SECONDS
+                or now - self._first_add >= BATCH_MAX_SECONDS
+            )
+
+    def _drain(self) -> List[PodSpec]:
+        with self._lock:
+            batch, self._pending = self._pending, []
+            self._pending_uids = set()
+            self._first_add = self._last_add = None
+        return batch
+
+    # --- the provisioning pass (ref: provisioner.go:102-135) ----------------
+
+    def provision(self) -> ProvisionStats:
+        stats = ProvisionStats()
+        batch = self._drain()
+        # Re-fetch and drop pods bound/terminated since batching
+        # (ref: provisioner.go:169-185).
+        pods = []
+        for pod in batch:
+            live = self.cluster.try_get_pod(pod.namespace, pod.name)
+            if live is None or not live.is_provisionable():
+                continue
+            pods.append(live)
+        if not pods:
+            return stats
+
+        daemons = [
+            template
+            for template in self.cluster.list_daemonset_templates()
+            if self._daemon_schedules_here(template)
+        ]
+        for schedule in self.scheduler.solve(self.provisioner, pods):
+            instance_types = self.cloud.get_instance_types(schedule.constraints)
+            result = self.solver.solve(
+                schedule.pods, instance_types, schedule.constraints, daemons
+            )
+            stats.unschedulable_pods += len(result.unschedulable)
+            self._launch(schedule.constraints, result, stats)
+        if stats.launched_nodes:
+            live = self.cluster.try_get_provisioner(self.provisioner.name)
+            if live is not None:
+                live.status.last_scale_time = self.cluster.clock.now()
+        return stats
+
+    def _daemon_schedules_here(self, template: PodSpec) -> bool:
+        try:
+            self.provisioner.spec.constraints.validate_pod(template)
+            return True
+        except Exception:
+            return False
+
+    def _launch(self, constraints, result: PackResult, stats: ProvisionStats):
+        for packing in result.packings:
+            # Re-GET the provisioner before every launch: abort if it was
+            # deleted mid-pass, and enforce limits against fresh status
+            # (ref: provisioner.go:187-195).
+            live = self.cluster.try_get_provisioner(self.provisioner.name)
+            if live is None or live.deletion_timestamp is not None:
+                stats.unschedulable_pods += len(packing.pods)
+                continue
+            if live.spec.limits is not None:
+                reason = live.spec.limits.exceeded_by(live.status.resources)
+                if reason is not None:
+                    stats.unschedulable_pods += len(packing.pods)
+                    continue
+            node_pods = iter(packing.pods_per_node)
+
+            def bind_callback(node: NodeSpec, _pods_iter=node_pods):
+                pods = next(_pods_iter, [])
+                self._register_and_bind(node, pods)
+                stats.launched_nodes += 1
+                stats.scheduled_pods += len(pods)
+
+            errors = self.cloud.create(
+                constraints,
+                packing.instance_type_options,
+                packing.node_quantity,
+                bind_callback,
+            )
+            stats.launch_errors.extend(errors)
+
+    def _register_and_bind(self, node: NodeSpec, pods: Sequence[PodSpec]):
+        """Create the node object (not-ready taint + termination finalizer +
+        constraint labels) then bind its pods (ref: provisioner.go:209-250)."""
+        node.labels.setdefault(wellknown.PROVISIONER_NAME_LABEL, self.provisioner.name)
+        for key, value in self.provisioner.spec.constraints.labels.items():
+            node.labels.setdefault(key, value)
+        node.taints = list(self.provisioner.spec.constraints.taints) + [
+            Taint(key=wellknown.NOT_READY_TAINT_KEY, effect="NoSchedule")
+        ]
+        if wellknown.TERMINATION_FINALIZER not in node.finalizers:
+            node.finalizers.append(wellknown.TERMINATION_FINALIZER)
+        self.cluster.create_node(node)
+        for pod in pods:
+            self.cluster.bind_pod(pod, node)
+
+
+class ProvisioningController:
+    """Reconciles Provisioner objects and manages workers
+    (ref: provisioning/controller.go:64-125). Requeues every 5 minutes in the
+    runtime to pick up instance-type drift."""
+
+    REQUEUE_SECONDS = 300.0
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        cloud: CloudProvider,
+        solver: Optional[Solver] = None,
+    ):
+        self.cluster = cluster
+        self.cloud = cloud
+        self.solver = solver
+        self.workers: Dict[str, ProvisionerWorker] = {}
+        self._hashes: Dict[str, int] = {}
+
+    def reconcile(self, name: str) -> None:
+        provisioner = self.cluster.try_get_provisioner(name)
+        if provisioner is None or provisioner.deletion_timestamp is not None:
+            self.workers.pop(name, None)
+            self._hashes.pop(name, None)
+            return
+        self.apply(provisioner)
+
+    def apply(self, provisioner: Provisioner) -> None:
+        default_provisioner(provisioner)
+        validate_provisioner(provisioner)
+        # Constrain a WORKING COPY to what the fleet offers
+        # (ref: controller.go:91-108). The stored spec stays pristine: each
+        # reconcile re-derives the intersection from it, so offerings that
+        # come back after an ICE blackout (or newly added types/zones) widen
+        # the envelope again instead of being ratcheted away.
+        instance_types = self.cloud.get_instance_types()
+        requirements = (
+            provisioner.spec.constraints.requirements.merge(
+                global_requirements(instance_types)
+            )
+            .merge(Requirements.from_labels(provisioner.spec.constraints.labels))
+            .consolidate()
+        )
+        effective = copy.deepcopy(provisioner)
+        effective.spec.constraints.requirements = requirements
+        new_hash = spec_hash(effective)
+        if self._hashes.get(provisioner.name) != new_hash:
+            self._hashes[provisioner.name] = new_hash
+            self.workers[provisioner.name] = ProvisionerWorker(
+                effective, self.cluster, self.cloud, self.solver
+            )
+        else:
+            self.workers[provisioner.name].provisioner = effective
+
+    def worker(self, name: str) -> Optional[ProvisionerWorker]:
+        return self.workers.get(name)

@@ -1,0 +1,188 @@
+"""Scheduler: split a pod batch into groups of isomorphic constraints, with
+topology-spread decisions injected as node selectors first.
+
+Ref: pkg/controllers/provisioning/scheduling/{scheduler,topology,
+topologygroup}.go. The output Schedules feed the solver one at a time — all
+pods in a Schedule are satisfiable by the same tightened constraint set, which
+is what lets the solver treat them as one dense tensor problem.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import DO_NOT_SCHEDULE, PodSpec, TopologySpreadConstraint
+from karpenter_tpu.api.provisioner import Constraints, PodIncompatibleError, Provisioner
+from karpenter_tpu.controllers.cluster import Cluster
+
+SUPPORTED_TOPOLOGY_KEYS = (wellknown.HOSTNAME_LABEL, wellknown.ZONE_LABEL)
+
+_domain_counter = itertools.count(1)
+
+
+@dataclass
+class Schedule:
+    """Pods satisfiable by one tightened constraint set
+    (ref: scheduler.go:54-58)."""
+
+    constraints: Constraints
+    pods: List[PodSpec] = field(default_factory=list)
+
+
+class TopologyGroup:
+    """Greedy spread counter (ref: topologygroup.go:24-68)."""
+
+    def __init__(self, constraint: TopologySpreadConstraint):
+        self.constraint = constraint
+        self.counts: Dict[str, int] = {}
+
+    def register(self, *domains: str) -> None:
+        for domain in domains:
+            self.counts.setdefault(domain, 0)
+
+    def increment(self, domain: str) -> None:
+        if domain in self.counts:
+            self.counts[domain] += 1
+
+    def next_domain(self, allowed: Optional[Sequence[str]] = None) -> Optional[str]:
+        """argmin-count domain (mutating: increments the winner)."""
+        candidates = [
+            d for d in self.counts if allowed is None or d in allowed
+        ]
+        if not candidates:
+            return None
+        winner = min(candidates, key=lambda d: (self.counts[d], d))
+        self.counts[winner] += 1
+        return winner
+
+
+class Topology:
+    """Injects topology-spread decisions as node selectors
+    (ref: topology.go:40-140). Only hostname and zone keys are supported —
+    selection rejects the rest before pods get here."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def inject(self, constraints: Constraints, pods: Sequence[PodSpec]) -> None:
+        for group_key, group_pods in self._topology_groups(pods).items():
+            constraint = group_pods[0][0]
+            group = TopologyGroup(constraint)
+            members = [pod for _, pod in group_pods]
+            if constraint.topology_key == wellknown.HOSTNAME_LABEL:
+                self._compute_hostname(group, members)
+            else:
+                self._compute_zonal(group, constraints, members)
+            for pod in members:
+                domain = group.next_domain(
+                    self._allowed_domains_for_pod(pod, group)
+                )
+                if domain is not None:
+                    pod.node_selector[constraint.topology_key] = domain
+
+    def _topology_groups(self, pods: Sequence[PodSpec]):
+        """Group (constraint, pod) pairs by equivalent spread constraint
+        (ref: topology.go:57-75)."""
+        groups: Dict[Tuple, List[Tuple[TopologySpreadConstraint, PodSpec]]] = {}
+        for pod in pods:
+            for constraint in pod.topology_spread:
+                if constraint.topology_key not in SUPPORTED_TOPOLOGY_KEYS:
+                    continue
+                groups.setdefault(constraint.group_key(), []).append(
+                    (constraint, pod)
+                )
+        return groups
+
+    def _compute_hostname(self, group: TopologyGroup, pods: List[PodSpec]) -> None:
+        """Fabricate ceil(pods/maxSkew) fresh hostname domains
+        (ref: topology.go:95-105 — hostname domains don't exist until nodes
+        launch, so the scheduler invents distinct buckets)."""
+        num_domains = -(-len(pods) // max(group.constraint.max_skew, 1))
+        for _ in range(num_domains):
+            group.register(f"host-domain-{next(_domain_counter)}")
+
+    def _compute_zonal(
+        self, group: TopologyGroup, constraints: Constraints, pods: List[PodSpec]
+    ) -> None:
+        """Register allowed zones and count existing matching pods per zone
+        from live cluster state (ref: topology.go:112-140)."""
+        allowed = constraints.effective_requirements().allowed(wellknown.ZONE_LABEL)
+        zones = set()
+        for node in self.cluster.list_nodes():
+            if node.zone and allowed.contains(node.zone):
+                zones.add(node.zone)
+        # Zones can also come from the constraint envelope even before any
+        # node exists there.
+        finite = allowed.finite_values()
+        if finite:
+            zones |= set(finite)
+        group.register(*sorted(zones))
+        for pod in self.cluster.list_pods(
+            predicate=lambda p: p.node_name is not None
+            and group.constraint.matches(p.labels)
+        ):
+            node = self.cluster.try_get_node(pod.node_name)
+            if node is not None and node.zone:
+                group.increment(node.zone)
+
+    def _allowed_domains_for_pod(self, pod: PodSpec, group: TopologyGroup):
+        """A pod with its own zone/hostname selector restricts its domains."""
+        key = group.constraint.topology_key
+        selected = pod.node_selector.get(key)
+        if selected is not None:
+            return [selected]
+        allowed = pod.scheduling_requirements().allowed(key)
+        if allowed.is_any():
+            return None
+        return [d for d in group.counts if allowed.contains(d)]
+
+
+class Scheduler:
+    """Ref: scheduling/scheduler.go:67-126."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.topology = Topology(cluster)
+
+    def solve(
+        self, provisioner: Provisioner, pods: Sequence[PodSpec]
+    ) -> List[Schedule]:
+        constraints = provisioner.spec.constraints
+        # Topology decisions are injected into per-pass SHADOW copies, never
+        # the live pod: a fabricated zone/hostname selector must not survive a
+        # failed launch, or retries stay pinned to a blacked-out domain (the
+        # reference works on scheduler-local pod copies too).
+        work = [(pod, self._scheduling_copy(pod)) for pod in pods]
+        self.topology.inject(constraints, [shadow for _, shadow in work])
+        schedules: Dict[Tuple, Schedule] = {}
+        ordered: List[Schedule] = []
+        for pod, shadow in work:
+            try:
+                constraints.validate_pod(shadow)
+            except PodIncompatibleError:
+                continue  # logged-and-skipped in the reference (scheduler.go:96)
+            tightened = constraints.tighten(shadow)
+            accelerators = frozenset(
+                name
+                for name in wellknown.ACCELERATOR_RESOURCES
+                if pod.requests.get(name, 0) > 0
+            )
+            key = (tightened.requirements.canonical_key(), accelerators)
+            schedule = schedules.get(key)
+            if schedule is None:
+                schedule = Schedule(constraints=tightened)
+                schedules[key] = schedule
+                ordered.append(schedule)
+            schedule.pods.append(pod)
+        return ordered
+
+    @staticmethod
+    def _scheduling_copy(pod: PodSpec) -> PodSpec:
+        import copy as _copy
+
+        shadow = _copy.copy(pod)
+        shadow.node_selector = dict(pod.node_selector)
+        return shadow

@@ -1,0 +1,117 @@
+"""Selection: route unschedulable pods to provisioners.
+
+Ref: pkg/controllers/selection/{controller,preferences}.go — watches all pods
+(MaxConcurrentReconciles 10,000 in the reference; our runtime fans out over a
+thread pool), filters provisionable ones, rejects unsupported scheduling
+features, relaxes preferences on retry, and hands the pod to the first
+matching provisioner in alphabetical order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import PodIncompatibleError
+from karpenter_tpu.api.requirements import SUPPORTED_OPERATORS
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.scheduling import SUPPORTED_TOPOLOGY_KEYS
+
+
+class UnsupportedPodError(Exception):
+    """The pod uses features the provisioning path doesn't support
+    (ref: selection/controller.go validate:108-159)."""
+
+
+class Preferences:
+    """Iterative relaxation for pods that keep failing to schedule
+    (ref: selection/preferences.go:50-106): first drop the heaviest preferred
+    term, then drop leading required OR-terms so later alternatives get
+    tried. Pods are live objects in our store, so relaxation mutates the pod
+    instead of maintaining the reference's UID-keyed TTL cache."""
+
+    def relax(self, pod: PodSpec) -> bool:
+        if pod.preferred_terms:
+            heaviest = max(pod.preferred_terms, key=lambda term: term.weight)
+            pod.preferred_terms.remove(heaviest)
+            return True
+        if len(pod.required_terms) > 1:
+            pod.required_terms.pop(0)
+            return True
+        return False
+
+
+class SelectionController:
+    """Ref: selection/controller.go:55-102."""
+
+    REQUEUE_SECONDS = 1.0  # re-verify after handing off (ref: :77)
+
+    def __init__(self, cluster: Cluster, provisioning: ProvisioningController):
+        self.cluster = cluster
+        self.provisioning = provisioning
+        self.preferences = Preferences()
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        pod = self.cluster.try_get_pod(namespace, name)
+        if pod is None or not pod.is_provisionable():
+            return None
+        try:
+            self._validate(pod)
+        except UnsupportedPodError:
+            return None  # ignored; kube-scheduler owns it (ref: :70-75)
+
+        matched, enqueued = self._select_and_enqueue(pod)
+        if enqueued:
+            return self.REQUEUE_SECONDS
+        if matched:
+            # A provisioner tolerates the pod but its batch is full — retry
+            # without corrupting the pod's preferences (relaxation is only
+            # for genuine incompatibility; ref: preferences.go:50-63).
+            return self.REQUEUE_SECONDS
+        # No provisioner matched: relax and retry if anything was relaxable.
+        if self.preferences.relax(pod):
+            return self.REQUEUE_SECONDS
+        return None
+
+    def _validate(self, pod: PodSpec) -> None:
+        if pod.pod_affinity_terms:
+            raise UnsupportedPodError("pod affinity is not supported")
+        if pod.pod_anti_affinity_terms:
+            raise UnsupportedPodError("pod anti-affinity is not supported")
+        for constraint in pod.topology_spread:
+            if constraint.topology_key not in SUPPORTED_TOPOLOGY_KEYS:
+                raise UnsupportedPodError(
+                    f"topology key {constraint.topology_key!r} is not supported"
+                )
+        for terms in [
+            *[term.requirements for term in pod.preferred_terms],
+            *pod.required_terms,
+        ]:
+            for requirement in terms:
+                if requirement.operator not in SUPPORTED_OPERATORS:
+                    raise UnsupportedPodError(
+                        f"operator {requirement.operator!r} is not supported"
+                    )
+
+    def _select_and_enqueue(self, pod: PodSpec):
+        """First matching provisioner in alphabetical order wins
+        (ref: selectProvisioner:80-102). Returns (matched, enqueued)."""
+        for provisioner in self.cluster.list_provisioners():
+            if provisioner.deletion_timestamp is not None:
+                continue
+            worker = self.provisioning.worker(provisioner.name)
+            if worker is None:
+                continue
+            try:
+                # Validate against the worker's EFFECTIVE constraints (fleet
+                # -refreshed requirements), matching the reference where
+                # selection reads the provisioning controller's in-memory
+                # provisioners (ref: selectProvisioner:80-102) — the stored
+                # spec is pristine and intentionally wider.
+                worker.provisioner.spec.constraints.validate_pod(pod)
+            except PodIncompatibleError:
+                continue
+            return True, worker.add(pod)
+        return False, False

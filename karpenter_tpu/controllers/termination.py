@@ -1,0 +1,128 @@
+"""Termination: finalizer-driven graceful node deletion.
+
+Ref: pkg/controllers/termination/{controller,terminate,eviction}.go — a node
+with a deletionTimestamp and the karpenter termination finalizer is cordoned,
+drained (respecting do-not-evict, PDBs, and critical-pod ordering), then
+deleted at the cloud provider before the finalizer is removed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.cloudprovider import CloudProvider, NodeSpec
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.errors import PDBViolationError
+from karpenter_tpu.utils.workqueue import BackoffQueue
+
+CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
+
+
+class EvictionQueue:
+    """Async rate-limited eviction worker (ref: termination/eviction.go:45-109):
+    set-deduped, exponential backoff 100ms -> 10s, PDB violations retry."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.queue = BackoffQueue(base_delay=0.1, max_delay=10.0, clock=cluster.clock)
+
+    def add(self, pods: List[PodSpec]) -> None:
+        for pod in pods:
+            self.queue.add((pod.namespace, pod.name))
+
+    def drain_once(self) -> int:
+        """Pump the queue once (the runtime loops this; tests call directly)."""
+
+        def evict(key) -> bool:
+            namespace, name = key
+            pod = self.cluster.try_get_pod(namespace, name)
+            if pod is None:
+                return True
+            try:
+                self.cluster.evict_pod(namespace, name)
+                return True
+            except PDBViolationError:
+                return False  # 429-equivalent: retry with backoff
+
+        return self.queue.process(evict)
+
+
+class Terminator:
+    """Ref: termination/terminate.go."""
+
+    def __init__(self, cluster: Cluster, cloud: CloudProvider, evictions: EvictionQueue):
+        self.cluster = cluster
+        self.cloud = cloud
+        self.evictions = evictions
+
+    def cordon(self, node: NodeSpec) -> None:
+        """ref: terminate.go:42-55."""
+        if not node.unschedulable:
+            node.unschedulable = True
+            self.cluster.update_node(node)
+
+    def drain(self, node: NodeSpec) -> bool:
+        """Returns True when fully drained (ref: terminate.go:58-82)."""
+        pods = self.cluster.list_pods(node_name=node.name)
+        # Refuse to drain while any pod carries do-not-evict
+        # (ref: terminate.go:67-72).
+        for pod in pods:
+            if wellknown.DO_NOT_EVICT_ANNOTATION in pod.annotations:
+                return False
+        evictable = self._evictable(pods)
+        if not evictable:
+            return True
+        # Evict non-critical pods before critical ones
+        # (ref: terminate.go:127-147).
+        non_critical = [
+            p for p in evictable
+            if p.priority_class_name not in CRITICAL_PRIORITY_CLASSES
+        ]
+        self.evictions.add(non_critical if non_critical else evictable)
+        return False
+
+    def _evictable(self, pods: List[PodSpec]) -> List[PodSpec]:
+        """Skip terminating ("stuck") and node-owned/daemon pods that tolerate
+        the unschedulable state (ref: terminate.go:111-125)."""
+        out = []
+        for pod in pods:
+            if pod.is_terminating() or pod.is_terminal():
+                continue
+            if pod.is_owned_by_node() or pod.is_owned_by_daemonset():
+                continue
+            out.append(pod)
+        return out
+
+    def terminate(self, node: NodeSpec) -> None:
+        """Cloud delete then strip the finalizer (ref: terminate.go:84-100)."""
+        self.cloud.delete(node)
+        self.cluster.remove_finalizer(node, wellknown.TERMINATION_FINALIZER)
+
+
+class TerminationController:
+    """Ref: termination/controller.go:60-97. Requeues (returning a delay)
+    while draining."""
+
+    REQUEUE_SECONDS = 1.0
+
+    def __init__(self, cluster: Cluster, cloud: CloudProvider):
+        self.cluster = cluster
+        self.evictions = EvictionQueue(cluster)
+        self.terminator = Terminator(cluster, cloud, self.evictions)
+
+    def reconcile(self, name: str) -> Optional[float]:
+        node = self.cluster.try_get_node(name)
+        if node is None:
+            return None
+        if node.deletion_timestamp is None:
+            return None
+        if wellknown.TERMINATION_FINALIZER not in node.finalizers:
+            return None
+        self.terminator.cordon(node)
+        if not self.terminator.drain(node):
+            self.evictions.drain_once()
+            return self.REQUEUE_SECONDS
+        self.terminator.terminate(node)
+        return None

@@ -1,0 +1,5 @@
+"""Solver models: the greedy host fallback and the TPU batched solver."""
+
+from karpenter_tpu.models.solver import GreedySolver, TPUSolver, Solver
+
+__all__ = ["GreedySolver", "TPUSolver", "Solver"]

@@ -1,0 +1,248 @@
+"""Solver models — the pluggable "solver boundary" of the framework.
+
+Ref: the north star's `pkg/cloudprovider/solver` plugin analogue (SURVEY.md
+§2.7): the provisioning controller calls a Solver; TPUSolver runs the batched
+JAX FFD kernel, CostSolver layers the price-aware strategies on top and keeps
+the cheapest feasible packing, GreedySolver is the in-process fallback used
+when no accelerator is available (and the correctness/cost oracle in tests
+and benchmarks).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider import InstanceType
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops.encode import InstanceFleet, PodGroups, build_fleet, group_pods
+from karpenter_tpu.ops.pack_kernel import bucket_size, pack_kernel, pad_to
+from karpenter_tpu.ops.score_kernel import lp_relax_solve, round_assignment
+
+
+class Solver(abc.ABC):
+    """solve(pods, ...) -> PackResult. Pods must already share one schedule's
+    constraints (the scheduler groups them; ref: scheduling/scheduler.go:67)."""
+
+    @abc.abstractmethod
+    def solve(
+        self,
+        pods: Sequence[PodSpec],
+        instance_types: Sequence[InstanceType],
+        constraints: Constraints,
+        daemons: Sequence[PodSpec] = (),
+    ) -> ffd.PackResult:
+        ...
+
+
+class GreedySolver(Solver):
+    """Host-side grouped FFD — reference-faithful fallback."""
+
+    def solve(self, pods, instance_types, constraints, daemons=()):
+        return ffd.pack(pods, instance_types, constraints, daemons)
+
+
+def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool):
+    g_pad = bucket_size(groups.num_groups)
+    t_pad = bucket_size(fleet.num_types)
+    return pack_kernel(
+        pad_to(groups.vectors, g_pad),
+        pad_to(groups.counts.astype(np.int32), g_pad),
+        pad_to(fleet.capacity, t_pad),
+        pad_to(fleet.total, t_pad),
+        pad_to(np.ones(fleet.num_types, bool), t_pad),
+        pad_to(fleet.prices, t_pad),
+        quirk=quirk,
+        mode=mode,
+    )
+
+
+def _decode_rounds(
+    round_list: List[Tuple[int, np.ndarray, int]],
+    unschedulable_counts: np.ndarray,
+    groups: PodGroups,
+    fleet: InstanceFleet,
+) -> ffd.PackResult:
+    """Turn (type, fill, replication) rounds into Packing objects, merging by
+    instance-option tuple (ref: packer.go:126-135 hashes options only)."""
+    cursors = [0] * groups.num_groups
+    by_options = {}
+    packings: List[ffd.Packing] = []
+    for t, fill, repl in round_list:
+        options = fleet.instance_types[t : t + ffd.MAX_INSTANCE_TYPES]
+        nodes = []
+        for _ in range(repl):
+            node_pods = []
+            for g in np.nonzero(fill > 0)[0]:
+                n = int(fill[g])
+                node_pods.extend(groups.members[g][cursors[g] : cursors[g] + n])
+                cursors[g] += n
+            nodes.append(node_pods)
+        key = tuple(it.name for it in options)
+        existing = by_options.get(key)
+        if existing is not None:
+            existing.node_quantity += repl
+            existing.pods_per_node.extend(nodes)
+        else:
+            packing = ffd.Packing(
+                pods_per_node=nodes,
+                instance_type_options=list(options),
+                node_quantity=repl,
+            )
+            by_options[key] = packing
+            packings.append(packing)
+
+    unschedulable: List[PodSpec] = []
+    for g in np.nonzero(unschedulable_counts > 0)[0]:
+        n = int(unschedulable_counts[g])
+        unschedulable.extend(groups.members[g][cursors[g] : cursors[g] + n])
+        cursors[g] += n
+    return ffd.PackResult(packings=packings, unschedulable=unschedulable)
+
+
+def _kernel_rounds_to_list(rounds, num_groups: int):
+    num_rounds = int(rounds.num_rounds)
+    return [
+        (
+            int(np.asarray(rounds.round_type)[r]),
+            np.asarray(rounds.round_fill)[r, :num_groups],
+            int(np.asarray(rounds.round_repl)[r]),
+        )
+        for r in range(num_rounds)
+    ]
+
+
+class TPUSolver(Solver):
+    """Batched solve on accelerator via ops.pack_kernel.
+
+    mode="ffd" reproduces the reference packing (quirk=True bit-for-bit);
+    mode="cost" picks price-efficient types each round. Shapes are bucketed to
+    powers of two so repeat solves hit the jit cache.
+    """
+
+    def __init__(self, mode: str = "ffd", quirk: bool = False):
+        self.mode = mode
+        self.quirk = quirk
+
+    def solve(self, pods, instance_types, constraints, daemons=()):
+        groups = group_pods(list(pods))
+        fleet = build_fleet(instance_types, constraints, pods, daemons)
+        return self.solve_encoded(groups, fleet)
+
+    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
+        if fleet.num_types == 0 or groups.num_groups == 0:
+            return ffd.pack_groups(fleet, groups)
+        rounds = _run_kernel(groups, fleet, self.mode, self.quirk)
+        if bool(rounds.overflow):
+            # Defensive: static round budget exhausted — fall back to host FFD
+            # rather than return a partial packing.
+            return ffd.pack_groups(fleet, groups)
+        return _decode_rounds(
+            _kernel_rounds_to_list(rounds, groups.num_groups),
+            np.asarray(rounds.unschedulable)[: groups.num_groups],
+            groups,
+            fleet,
+        )
+
+
+class CostSolver(Solver):
+    """The flagship: runs pure-greedy FFD, cost-greedy, and the LP-relaxation
+    plan on TPU, returns the cheapest feasible packing. Because greedy is
+    always among the candidates, projected $/hr can only match or beat the
+    baseline."""
+
+    def __init__(self, lp_steps: int = 300):
+        self.lp_steps = lp_steps
+
+    def solve(self, pods, instance_types, constraints, daemons=()):
+        groups = group_pods(list(pods))
+        fleet = build_fleet(instance_types, constraints, pods, daemons)
+        if fleet.num_types == 0 or groups.num_groups == 0:
+            return ffd.pack_groups(fleet, groups)
+
+        candidates: List[ffd.PackResult] = []
+        for mode in ("ffd", "cost"):
+            rounds = _run_kernel(groups, fleet, mode, False)
+            if not bool(rounds.overflow):
+                candidates.append(
+                    _decode_rounds(
+                        _kernel_rounds_to_list(rounds, groups.num_groups),
+                        np.asarray(rounds.unschedulable)[: groups.num_groups],
+                        groups,
+                        fleet,
+                    )
+                )
+        lp_result = self._solve_lp(groups, fleet)
+        if lp_result is not None:
+            candidates.append(lp_result)
+        if not candidates:
+            return ffd.pack_groups(fleet, groups)
+
+        # A candidate that leaves more pods unschedulable never wins on price.
+        best = min(
+            candidates,
+            key=lambda r: (len(r.unschedulable), r.projected_cost(), r.node_count),
+        )
+        return best
+
+    def _solve_lp(
+        self, groups: PodGroups, fleet: InstanceFleet
+    ) -> Optional[ffd.PackResult]:
+        g_pad = bucket_size(groups.num_groups)
+        t_pad = bucket_size(fleet.num_types)
+        vectors = pad_to(groups.vectors, g_pad)
+        counts = pad_to(groups.counts.astype(np.int32), g_pad)
+        capacity = pad_to(fleet.capacity, t_pad)
+        valid = pad_to(np.ones(fleet.num_types, bool), t_pad)
+        prices = pad_to(fleet.prices, t_pad)
+
+        feasible = np.asarray(
+            vectors[:, None, :] <= capacity[None, :, :] + 1e-6
+        ).all(axis=-1) & valid[None, :]
+        feasible_any = feasible.any(axis=1)
+        unschedulable_counts = np.where(feasible_any, 0, counts)[: groups.num_groups]
+        solvable_counts = np.where(feasible_any, counts, 0)
+
+        if solvable_counts.sum() == 0:
+            return None
+
+        lp = lp_relax_solve(
+            vectors,
+            solvable_counts,
+            capacity,
+            valid,
+            prices,
+            steps=self.lp_steps,
+        )
+        assignment = round_assignment(np.asarray(lp.assignment), solvable_counts)
+
+        # Realize the plan: per type, greedily fill nodes (pure greedy, no
+        # quirk) with that type's assigned pods.
+        round_list: List[Tuple[int, np.ndarray, int]] = []
+        num_groups = groups.num_groups
+        for t in range(fleet.num_types):
+            counts_t = assignment[:num_groups, t].astype(np.int64).copy()
+            guard = 0
+            while counts_t.sum() > 0:
+                fill = ffd.fill_node(
+                    fleet.capacity[t],
+                    fleet.total[t],
+                    groups.vectors,
+                    counts_t,
+                    quirk=False,
+                )
+                if fill.sum() == 0:
+                    # Should not happen (feasibility pre-checked); bail out.
+                    return None
+                repl_per_group = np.where(fill > 0, counts_t // np.maximum(fill, 1), np.iinfo(np.int64).max)
+                repl = max(1, int(repl_per_group.min()))
+                round_list.append((t, fill.copy(), repl))
+                counts_t -= repl * fill
+                guard += 1
+                if guard > 4 * num_groups + 16:
+                    return None
+        return _decode_rounds(round_list, unschedulable_counts, groups, fleet)

@@ -81,12 +81,15 @@ def fill_node(
     total: np.ndarray,
     vectors: np.ndarray,
     counts: np.ndarray,
+    quirk: bool = True,
 ) -> np.ndarray:
     """Greedily fill one node. Returns packed count per group.
 
     `capacity` is the usable ledger (total - overhead - daemons); `total` is
     the raw instance capacity used by the early-exit check, matching
-    packable.go fits() comparing against p.total.
+    packable.go fits() comparing against p.total. quirk=False disables the
+    reference's fits() early exit (pure greedy — used by the cost paths,
+    which don't need bit-parity and pack strictly better).
     """
     num_groups = vectors.shape[0]
     packed = np.zeros(num_groups, dtype=np.int64)
@@ -114,7 +117,7 @@ def fill_node(
                 return np.zeros(num_groups, dtype=np.int64)  # largest pod set aside
             # Early exit when essentially full w.r.t. the smallest pod:
             # reserved + smallest >= total on any tracked dim (fits(), :147-157).
-            if np.any((total > 0) & (remaining <= smallest + 1e-9)):
+            if quirk and np.any((total > 0) & (remaining <= smallest + 1e-9)):
                 break
     return packed
 

@@ -1,0 +1,246 @@
+"""Batched bin-packing kernel on TPU (JAX).
+
+Reformulates the reference's sequential FFD loop
+(ref: pkg/controllers/provisioning/binpacking/packer.go:82-189) as static-shape
+tensor rounds:
+
+  * pods are pre-collapsed into G groups of identical request vectors
+    (ops.encode.group_pods); G is small (tens) even for 50k-pod batches.
+  * one *round* fills a candidate node of every instance type at once —
+    a lax.scan over groups, vmapped over the T types.
+  * the chosen node fill is **replicated** k = min_{g: p_g>0} floor(c_g / p_g)
+    times in one step. Replication is exact for greedy FFD: every one of those
+    k nodes would have received an identical fill (the capacity ledger resets
+    per node and group counts stay >= the fill). This collapses the reference's
+    O(#nodes) sequential loop — 50k pods of one shape solve in one round.
+  * rounds run under lax.while_loop with preallocated output buffers, so the
+    whole solve is one XLA computation with static shapes (no recompiles
+    across batches after bucketing).
+
+Two selection modes:
+  * mode="ffd": parity with the reference — the largest type sets the
+    max-pods bound, the smallest type achieving it wins, and with quirk=True
+    the fits()-early-exit quirk (packable.go:147-157, Cmp >= 0 rejecting exact
+    fits) is reproduced bit-for-bit for cross-checking.
+  * mode="cost": price-aware — each round picks the type minimizing
+    $/(weighted work packed); used by the cost solver to beat greedy $/hr.
+
+All shapes padded: G -> groups (counts 0), T -> types (valid_types mask).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-4
+_INF = jnp.inf
+
+
+class PackRounds(NamedTuple):
+    """Kernel output: up to MR rounds of (type, per-group fill, replication)."""
+
+    round_type: jnp.ndarray  # [MR] int32 — chosen instance-type index
+    round_fill: jnp.ndarray  # [MR, G] int32 — pods of each group per node
+    round_repl: jnp.ndarray  # [MR] int32 — identical nodes this round
+    num_rounds: jnp.ndarray  # [] int32
+    unschedulable: jnp.ndarray  # [G] int32 — pods set aside per group
+    overflow: jnp.ndarray  # [] bool — round budget exhausted (never expected)
+
+
+def max_rounds(num_groups: int) -> int:
+    # Every two rounds exhaust at least one group (replication drops the
+    # binding group below its fill), so 2G+8 is a safe static budget.
+    return 2 * num_groups + 8
+
+
+def _fill_one_node(capacity, total, vectors, counts, *, quirk: bool):
+    """Greedy-fill one node of one type. Returns packed count per group.
+
+    Mirrors packable.go:113-132: groups scanned largest→smallest; a first
+    active group that can't place one pod aborts the whole fill (the caller
+    interprets an all-zero fill as "largest pod fits nowhere" for this type);
+    with quirk=True, a failed placement stops the scan early once remaining
+    capacity falls to/below the smallest active pod on any tracked dimension.
+    """
+    num_groups = vectors.shape[0]
+    active = counts > 0
+    any_active = jnp.any(active)
+    first_active = jnp.argmax(active)
+    last_active = num_groups - 1 - jnp.argmax(active[::-1])
+    smallest = vectors[last_active]
+
+    def step(carry, g):
+        remaining, stopped, abort = carry
+        vec = vectors[g]
+        cnt = counts[g]
+        ratio = jnp.where(vec > 0, remaining / jnp.where(vec > 0, vec, 1.0), _INF)
+        n_fit = jnp.floor(jnp.min(ratio) + _EPS)
+        n_fit = jnp.maximum(n_fit, 0.0).astype(jnp.int32)
+        allowed = (cnt > 0) & ~stopped & ~abort
+        n = jnp.where(allowed, jnp.minimum(cnt, n_fit), 0)
+        abort = abort | ((g == first_active) & (cnt > 0) & (n == 0))
+        remaining = remaining - n.astype(vectors.dtype) * vec
+        failed = allowed & (n < cnt)
+        if quirk:
+            essentially_full = jnp.any((total > 0) & (remaining <= smallest + _EPS))
+            stopped = stopped | (failed & essentially_full)
+        return (remaining, stopped, abort), n
+
+    (_, _, abort), packed = jax.lax.scan(
+        step,
+        (capacity, jnp.asarray(False), jnp.asarray(False)),
+        jnp.arange(num_groups),
+    )
+    packed = jnp.where(abort | ~any_active, 0, packed)
+    return packed
+
+
+class _LoopState(NamedTuple):
+    counts: jnp.ndarray
+    round_type: jnp.ndarray
+    round_fill: jnp.ndarray
+    round_repl: jnp.ndarray
+    num_rounds: jnp.ndarray
+    unschedulable: jnp.ndarray
+    iters: jnp.ndarray
+
+
+@functools.partial(
+    jax.jit, static_argnames=("quirk", "mode")
+)
+def pack_kernel(
+    vectors,  # [G, R] f32 — group request vectors, FFD-sorted desc
+    counts,  # [G] i32 — pods per group
+    capacity,  # [T, R] f32 — usable capacity per type (asc-sorted fleet)
+    total,  # [T, R] f32 — raw capacity per type (for the quirk check)
+    valid_types,  # [T] bool — padding mask
+    prices,  # [T] f32 — $/hr per type (cost mode)
+    *,
+    quirk: bool = False,
+    mode: str = "ffd",
+) -> PackRounds:
+    num_groups = vectors.shape[0]
+    num_types = capacity.shape[0]
+    mr = max_rounds(num_groups)
+
+    # Weight per group for cost mode: the max utilization fraction across the
+    # largest valid type's dimensions — "how much node does one pod consume".
+    largest_valid = num_types - 1 - jnp.argmax(valid_types[::-1])
+    ref_cap = jnp.maximum(capacity[largest_valid], 1.0)
+    group_weight = jnp.max(vectors / ref_cap, axis=1)  # [G]
+
+    def body(state: _LoopState) -> _LoopState:
+        fills = jax.vmap(
+            lambda cap, tot: _fill_one_node(
+                cap, tot, vectors, state.counts, quirk=quirk
+            )
+        )(capacity, total)  # [T, G]
+        fills = jnp.where(valid_types[:, None], fills, 0)
+        sums = fills.sum(axis=1)  # [T]
+        packs_any = (sums > 0) & valid_types
+
+        if mode == "ffd":
+            bound = sums[largest_valid]
+            achieves = (sums == bound) & valid_types & (bound > 0)
+            t_sel = jnp.argmax(achieves)  # first (smallest) achieving type
+            have_pack = bound > 0
+        elif mode == "cost":
+            weighted = fills.astype(jnp.float32) @ group_weight  # [T]
+            score = jnp.where(packs_any, prices / jnp.maximum(weighted, 1e-9), _INF)
+            t_sel = jnp.argmin(score)
+            have_pack = jnp.any(packs_any)
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+
+        fill = fills[t_sel]  # [G]
+        if quirk:
+            # Replication must preserve each group's partial/full packing
+            # status: once a partially-packed group's count drops to exactly
+            # its fill, the "failed reserve" disappears and the fits()
+            # early-exit no longer fires, changing later groups' packing
+            # (observed in the reference when the last 1.5-pod pairs with a
+            # 0.5-pod). So a partial group only replicates while count stays
+            # strictly above fill: floor((c-1)/p); a fully-packed group
+            # (p == c) exhausts and allows exactly 1.
+            safe = jnp.where(
+                fill == state.counts,
+                1,
+                jnp.maximum((state.counts - 1) // jnp.maximum(fill, 1), 1),
+            )
+        else:
+            # Pure greedy: identical fills while counts stay >= fill.
+            safe = state.counts // jnp.maximum(fill, 1)
+        repl_per_group = jnp.where(fill > 0, safe, jnp.iinfo(jnp.int32).max)
+        repl = jnp.maximum(jnp.min(repl_per_group), 1).astype(jnp.int32)
+
+        # Pack branch.
+        counts_packed = state.counts - repl * fill
+        round_type = state.round_type.at[state.num_rounds].set(t_sel.astype(jnp.int32))
+        round_fill = state.round_fill.at[state.num_rounds].set(fill.astype(jnp.int32))
+        round_repl = state.round_repl.at[state.num_rounds].set(repl)
+
+        # Unschedulable branch: retire the first group with pods remaining
+        # (ref: packer.go:120-124 sets aside the largest pod; identical pods
+        # fail identically, so the whole group retires at once).
+        first_active = jnp.argmax(state.counts > 0)
+        unsched = state.unschedulable.at[first_active].add(
+            jnp.where(have_pack, 0, state.counts[first_active])
+        )
+        counts_unsched = state.counts.at[first_active].set(
+            jnp.where(have_pack, state.counts[first_active], 0)
+        )
+
+        return _LoopState(
+            counts=jnp.where(have_pack, counts_packed, counts_unsched),
+            round_type=jnp.where(have_pack, round_type, state.round_type),
+            round_fill=jnp.where(have_pack, round_fill, state.round_fill),
+            round_repl=jnp.where(have_pack, round_repl, state.round_repl),
+            num_rounds=state.num_rounds + jnp.where(have_pack, 1, 0),
+            unschedulable=unsched,
+            iters=state.iters + 1,
+        )
+
+    def cond(state: _LoopState):
+        return (state.counts.sum() > 0) & (state.iters < mr + num_groups)
+
+    init = _LoopState(
+        counts=counts.astype(jnp.int32),
+        round_type=jnp.zeros((mr,), jnp.int32),
+        round_fill=jnp.zeros((mr, num_groups), jnp.int32),
+        round_repl=jnp.zeros((mr,), jnp.int32),
+        num_rounds=jnp.asarray(0, jnp.int32),
+        unschedulable=jnp.zeros((num_groups,), jnp.int32),
+        iters=jnp.asarray(0, jnp.int32),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    return PackRounds(
+        round_type=final.round_type,
+        round_fill=final.round_fill,
+        round_repl=final.round_repl,
+        num_rounds=final.num_rounds,
+        unschedulable=final.unschedulable,
+        overflow=final.counts.sum() > 0,
+    )
+
+
+def pad_to(array: np.ndarray, size: int, axis: int = 0, value=0) -> np.ndarray:
+    pad = size - array.shape[axis]
+    if pad <= 0:
+        return array
+    widths = [(0, 0)] * array.ndim
+    widths[axis] = (0, pad)
+    return np.pad(array, widths, constant_values=value)
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Next power of two >= n — shape bucketing to avoid recompile storms
+    (SURVEY.md §7 hard parts: dynamic shapes)."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size

@@ -1,0 +1,128 @@
+"""Batched scoring + LP-relaxation solver.
+
+The cost-optimal packing problem: choose per-type node counts n_t and pod
+assignments minimizing sum_t n_t * price_t. The reference never optimizes
+this — FFD picks by max-pods-packed (packer.go:163-189) and leaves price to
+EC2 Fleet. We solve the continuous relaxation on TPU:
+
+    x[g,t]  >= 0   pods of group g assigned to type t  (sum_t x = c_g)
+    n_t     ~  max_r (sum_g x[g,t] * v[g,r]) / K[t,r]  (fractional nodes)
+    minimize sum_t price_t * n_t
+
+parameterized as x = c * softmax(logits) over feasible types, optimized with
+Adam under lax.scan — pure matmul/elementwise work that maps straight onto
+the MXU, and the same step function shards over a device mesh for large
+problems (parallel/sharded_solver.py). Integerization (largest-remainder) and
+per-type greedy fills turn the relaxed plan into real nodes; the caller
+compares the result against greedy and keeps the cheaper packing, so the LP
+path can only improve on the baseline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class LPResult(NamedTuple):
+    assignment: jnp.ndarray  # [G, T] float — relaxed pod counts
+    fractional_nodes: jnp.ndarray  # [T] float
+    objective: jnp.ndarray  # [] float — relaxed $/hr (lower bound-ish)
+
+
+def feasibility_mask(vectors: jnp.ndarray, capacity: jnp.ndarray, valid_types) -> jnp.ndarray:
+    """[G, T] bool — can one pod of group g fit an empty node of type t."""
+    fits = jnp.all(vectors[:, None, :] <= capacity[None, :, :] + 1e-6, axis=-1)
+    return fits & valid_types[None, :]
+
+
+def lp_objective(
+    logits: jnp.ndarray,  # [G, T]
+    vectors: jnp.ndarray,  # [G, R]
+    counts: jnp.ndarray,  # [G] float
+    capacity: jnp.ndarray,  # [T, R]
+    prices: jnp.ndarray,  # [T]
+    feasible: jnp.ndarray,  # [G, T] bool
+    sharpness: float = 20.0,
+) -> jnp.ndarray:
+    # -1e9, not -inf: a row with no feasible type (count 0 after the caller
+    # strips unschedulable groups) must softmax to finite garbage that the
+    # count-multiply zeroes, not NaN-poison the whole objective.
+    masked = jnp.where(feasible, logits, -1e9)
+    x = counts[:, None] * jax.nn.softmax(masked, axis=1)  # [G, T]
+    x = jnp.where(feasible, x, 0.0)
+    demand = jnp.einsum("gt,gr->tr", x, vectors)  # [T, R]
+    frac = demand / jnp.maximum(capacity, 1e-3)  # [T, R]
+    # Smooth max over resource dims keeps gradients flowing to every binding
+    # dimension; jnp.max alone starves the non-binding ones.
+    nodes = jax.nn.logsumexp(frac * sharpness, axis=1) / sharpness  # [T]
+    return jnp.sum(prices * nodes)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def lp_relax_solve(
+    vectors,  # [G, R] f32
+    counts,  # [G] i32/f32
+    capacity,  # [T, R] f32
+    valid_types,  # [T] bool
+    prices,  # [T] f32
+    steps: int = 300,
+) -> LPResult:
+    counts_f = counts.astype(jnp.float32)
+    feasible = feasibility_mask(vectors, capacity, valid_types)
+    # Initialize biased toward price-efficient types: -price per unit of the
+    # type's bottleneck capacity.
+    density = prices / jnp.maximum(jnp.max(capacity, axis=1), 1.0)
+    logits0 = jnp.broadcast_to(-jnp.log(density + 1e-9), feasible.shape).astype(
+        jnp.float32
+    )
+
+    optimizer = optax.adam(0.25)
+    opt_state = optimizer.init(logits0)
+    grad_fn = jax.grad(lp_objective)
+
+    def step(carry, _):
+        logits, opt_state = carry
+        grads = grad_fn(logits, vectors, counts_f, capacity, prices, feasible)
+        updates, opt_state = optimizer.update(grads, opt_state, logits)
+        return (optax.apply_updates(logits, updates), opt_state), ()
+
+    (logits, _), _ = jax.lax.scan(step, (logits0, opt_state), None, length=steps)
+
+    masked = jnp.where(feasible, logits, -1e9)
+    x = counts_f[:, None] * jax.nn.softmax(masked, axis=1)
+    x = jnp.where(feasible, x, 0.0)
+    demand = jnp.einsum("gt,gr->tr", x, vectors)
+    nodes = jnp.max(demand / jnp.maximum(capacity, 1e-3), axis=1)
+    return LPResult(
+        assignment=x,
+        fractional_nodes=nodes,
+        objective=jnp.sum(prices * nodes),
+    )
+
+
+def round_assignment(assignment: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Largest-remainder rounding of [G, T] relaxed assignment so each group's
+    row sums exactly to counts[g]. Returns int64 [G, T]."""
+    assignment = np.asarray(assignment, dtype=np.float64)
+    counts = np.asarray(counts, dtype=np.int64)
+    out = np.floor(assignment).astype(np.int64)
+    for g in range(assignment.shape[0]):
+        deficit = int(counts[g] - out[g].sum())
+        if deficit <= 0:
+            # Over-assignment can only come from float error; trim greedily
+            # from the smallest fractional cells.
+            while out[g].sum() > counts[g]:
+                candidates = np.nonzero(out[g] > 0)[0]
+                out[g, candidates[np.argmin(assignment[g, candidates])]] -= 1
+            continue
+        remainders = assignment[g] - np.floor(assignment[g])
+        order = np.argsort(-remainders)
+        for t in order[:deficit]:
+            out[g, t] += 1
+    return out

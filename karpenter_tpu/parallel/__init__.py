@@ -1,0 +1,12 @@
+"""Device-mesh parallelism for solver scale-out.
+
+The reference's "distributed fabric" is goroutines + the kube watch plane
+(SURVEY.md §2.7); this framework's scale axis is the (pod-groups × instance
+-types) score tensor, sharded over a jax.sharding.Mesh with XLA collectives
+riding ICI (SURVEY.md §5 long-context analogue).
+"""
+
+from karpenter_tpu.parallel.mesh import make_mesh, solver_shardings
+from karpenter_tpu.parallel.sharded_solver import sharded_lp_train_step, sharded_lp_solve
+
+__all__ = ["make_mesh", "solver_shardings", "sharded_lp_train_step", "sharded_lp_solve"]

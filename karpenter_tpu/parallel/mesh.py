@@ -1,0 +1,59 @@
+"""Mesh construction and sharding layouts for the solver.
+
+Axes:
+  "groups" (data-parallel axis): pod groups — each shard owns a slice of the
+      pod-group dimension; gradients reduce across it (psum inserted by GSPMD).
+  "types" (model-parallel axis): instance types — the score/assignment matrix
+      [G, T] is sharded across both axes; per-type reductions ride ICI.
+
+For a single host this is a flat mesh over local devices; multi-host keeps
+the same named axes over the global device set (jax.distributed handles
+process bootstrap), so the solver code is topology-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+GROUPS_AXIS = "groups"
+TYPES_AXIS = "types"
+
+
+def _factor(n: int) -> Tuple[int, int]:
+    """Split n into (groups, types) factors, as square as possible with the
+    types axis at least as large (type counts dominate group counts)."""
+    best = (1, n)
+    a = 1
+    while a * a <= n:
+        if n % a == 0:
+            best = (a, n // a)
+        a += 1
+    return best
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    groups_size, types_size = _factor(len(devices))
+    grid = np.array(devices).reshape(groups_size, types_size)
+    return Mesh(grid, (GROUPS_AXIS, TYPES_AXIS))
+
+
+def solver_shardings(mesh: Mesh):
+    """NamedShardings for the LP solver operands."""
+    return {
+        "logits": NamedSharding(mesh, P(GROUPS_AXIS, TYPES_AXIS)),  # [G, T]
+        "vectors": NamedSharding(mesh, P(GROUPS_AXIS, None)),  # [G, R]
+        "counts": NamedSharding(mesh, P(GROUPS_AXIS)),  # [G]
+        "capacity": NamedSharding(mesh, P(TYPES_AXIS, None)),  # [T, R]
+        "prices": NamedSharding(mesh, P(TYPES_AXIS)),  # [T]
+        "valid": NamedSharding(mesh, P(TYPES_AXIS)),  # [T]
+        "replicated": NamedSharding(mesh, P()),
+    }
+
+
+def pad_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple

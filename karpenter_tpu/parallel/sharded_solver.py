@@ -1,0 +1,186 @@
+"""Sharded LP solve: the multi-chip path.
+
+The [G, T] assignment problem shards over the ("groups", "types") mesh; all
+operands carry NamedShardings and GSPMD inserts the collectives (psum of the
+objective partial-sums across both axes, all-gathers on the softmax axis).
+This is this framework's context-parallelism: when 50k-pod batches with
+hundreds of types exceed one chip, the score tensor splits over ICI
+(SURVEY.md §5: "sharding the (pods × instance-types) score tensor ... is
+this project's context parallelism").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from karpenter_tpu.ops.score_kernel import LPResult, lp_objective, feasibility_mask
+from karpenter_tpu.parallel.mesh import make_mesh, pad_multiple, solver_shardings
+
+_OPTIMIZER = optax.adam(0.25)
+
+
+class LPTrainState(NamedTuple):
+    """Optimizer state for the assignment-logits 'model'."""
+
+    logits: jnp.ndarray  # [G, T]
+    opt_state: tuple
+
+
+def lp_train_init(logits0: jnp.ndarray) -> LPTrainState:
+    return LPTrainState(logits=logits0, opt_state=_OPTIMIZER.init(logits0))
+
+
+def lp_train_step(
+    state: LPTrainState,
+    vectors: jnp.ndarray,
+    counts: jnp.ndarray,
+    capacity: jnp.ndarray,
+    prices: jnp.ndarray,
+    feasible: jnp.ndarray,
+) -> Tuple[LPTrainState, jnp.ndarray]:
+    """One optimization step on the assignment logits — the framework's
+    'training step': loss, grad, Adam update."""
+    loss, grads = jax.value_and_grad(lp_objective)(
+        state.logits, vectors, counts, capacity, prices, feasible
+    )
+    updates, opt_state = _OPTIMIZER.update(grads, state.opt_state, state.logits)
+    return (
+        LPTrainState(
+            logits=optax.apply_updates(state.logits, updates), opt_state=opt_state
+        ),
+        loss,
+    )
+
+
+def _state_shardings(shardings):
+    """LPTrainState shardings: Adam's mu/nu mirror the [G, T] logits sharding;
+    scalar leaves (step count) stay replicated."""
+    template_opt_state = _OPTIMIZER.init(jnp.zeros((1, 1)))
+    return LPTrainState(
+        logits=shardings["logits"],
+        opt_state=jax.tree_util.tree_map(
+            lambda leaf: shardings["logits"]
+            if getattr(leaf, "ndim", 0) == 2
+            else shardings["replicated"],
+            template_opt_state,
+        ),
+    )
+
+
+def sharded_lp_train_step(mesh=None):
+    """Build a jitted train step with solver shardings over `mesh`.
+
+    Returns (step_fn, shardings). step_fn(state, vectors, counts, capacity,
+    prices, feasible) -> (state, loss), with the [G, T] logits sharded over
+    (groups, types) and every collective compiled by GSPMD.
+    """
+    mesh = mesh or make_mesh()
+    shardings = solver_shardings(mesh)
+    state_sharding = _state_shardings(shardings)
+    step = jax.jit(
+        lp_train_step,
+        in_shardings=(
+            state_sharding,
+            shardings["vectors"],
+            shardings["counts"],
+            shardings["capacity"],
+            shardings["prices"],
+            shardings["logits"],  # feasible is [G, T]
+        ),
+        out_shardings=(state_sharding, shardings["replicated"]),
+    )
+    return step, shardings
+
+
+def sharded_lp_solve(
+    vectors,
+    counts,
+    capacity,
+    valid_types,
+    prices,
+    steps: int = 300,
+    mesh=None,
+) -> LPResult:
+    """Multi-chip LP solve: pads G and T to mesh-divisible sizes, places
+    operands with NamedShardings, and runs the optimization loop."""
+    mesh = mesh or make_mesh()
+    groups_size, types_size = mesh.devices.shape
+    g = pad_multiple(vectors.shape[0], max(groups_size, 1))
+    t = pad_multiple(capacity.shape[0], max(types_size, 1))
+
+    vectors = jnp.asarray(_pad(vectors, g, 0))
+    counts = jnp.asarray(_pad(counts, g, 0)).astype(jnp.float32)
+    capacity = jnp.asarray(_pad(capacity, t, 0))
+    valid_types = jnp.asarray(_pad(valid_types, t, 0))
+    prices = jnp.asarray(_pad(prices, t, 0))
+
+    shardings = solver_shardings(mesh)
+    vectors = jax.device_put(vectors, shardings["vectors"])
+    counts = jax.device_put(counts, shardings["counts"])
+    capacity = jax.device_put(capacity, shardings["capacity"])
+    valid_types = jax.device_put(valid_types, shardings["valid"])
+    prices = jax.device_put(prices, shardings["prices"])
+
+    feasible = feasibility_mask(vectors, capacity, valid_types)
+    feasible = jax.device_put(feasible, shardings["logits"])
+    density = prices / jnp.maximum(jnp.max(capacity, axis=1), 1.0)
+    logits0 = jnp.broadcast_to(-jnp.log(density + 1e-9), feasible.shape).astype(
+        jnp.float32
+    )
+    logits0 = jax.device_put(logits0, shardings["logits"])
+
+    # The whole optimization runs in ONE sharded executable (lax.scan over
+    # steps): one dispatch, one run-id. Many small dispatches of a collective
+    # program can starve XLA:CPU's in-process rendezvous on low-core hosts
+    # (observed: AllReduce deadlock with 8 virtual devices on 1 core); a
+    # single scan executable avoids that and is also the efficient shape for
+    # real ICI.
+    state_shardings = _state_shardings(shardings)
+
+    def optimize(vectors, counts, capacity, prices, feasible, logits0):
+        state0 = lp_train_init(logits0)
+
+        def body(state, _):
+            state, loss = lp_train_step(
+                state, vectors, counts, capacity, prices, feasible
+            )
+            return state, loss
+
+        state, losses = jax.lax.scan(body, state0, None, length=steps)
+        return state, losses[-1]
+
+    optimize_jit = jax.jit(
+        optimize,
+        in_shardings=(
+            shardings["vectors"],
+            shardings["counts"],
+            shardings["capacity"],
+            shardings["prices"],
+            shardings["logits"],
+            shardings["logits"],
+        ),
+        out_shardings=(state_shardings, shardings["replicated"]),
+    )
+    state, _ = optimize_jit(vectors, counts, capacity, prices, feasible, logits0)
+
+    masked = jnp.where(feasible, state.logits, -1e9)
+    x = counts[:, None] * jax.nn.softmax(masked, axis=1)
+    x = jnp.where(feasible, x, 0.0)
+    demand = jnp.einsum("gt,gr->tr", x, vectors)
+    nodes = jnp.max(demand / jnp.maximum(capacity, 1e-3), axis=1)
+    return LPResult(assignment=x, fractional_nodes=nodes, objective=jnp.sum(prices * nodes))
+
+
+def _pad(array, size, value):
+    import numpy as np
+
+    array = np.asarray(array)
+    if array.shape[0] >= size:
+        return array
+    widths = [(0, size - array.shape[0])] + [(0, 0)] * (array.ndim - 1)
+    return np.pad(array, widths, constant_values=value)

@@ -1,0 +1,39 @@
+"""Injectable clock (ref: pkg/utils/injectabletime/time.go — the reference
+swaps a package-level Now var; we pass a Clock object so tests control time
+without globals)."""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+
+class Clock:
+    def now(self) -> float:
+        return _time.time()
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Deterministic clock for TTL/expiry tests."""
+
+    def __init__(self, start: float = 1_000_000.0):
+        self._now = start
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+    def advance(self, seconds: float) -> None:
+        with self._lock:
+            self._now += seconds
+
+    def set(self, timestamp: float) -> None:
+        with self._lock:
+            self._now = timestamp

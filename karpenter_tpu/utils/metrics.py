@@ -1,0 +1,160 @@
+"""Minimal Prometheus-style metrics registry (no external dependency).
+
+Ref: pkg/metrics/constants.go — namespace "karpenter", duration buckets
+matching controller-runtime; gauges/histograms rendered in text exposition
+format for scraping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Sequence, Tuple
+
+NAMESPACE = "karpenter"
+
+# ref: metrics.DurationBuckets — 5ms..60s ramp used by the reference.
+DURATION_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35, 0.4, 0.45,
+    0.5, 0.6, 0.7, 0.8, 0.9, 1.0, 1.25, 1.5, 1.75, 2.0, 2.5, 3.0, 4.0, 5.0,
+    6.0, 7.0, 8.0, 9.0, 10.0, 15.0, 20.0, 25.0, 30.0, 40.0, 50.0, 60.0,
+)
+
+LabelValues = Tuple[str, ...]
+
+
+class Gauge:
+    def __init__(self, name: str, help_text: str, labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(labels)
+        self._values: Dict[LabelValues, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            self._values[tuple(label_values)] = value
+
+    def inc(self, *label_values: str, amount: float = 1.0) -> None:
+        with self._lock:
+            key = tuple(label_values)
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(label_values), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def remove_where(self, predicate) -> None:
+        """Drop series whose label tuple matches — lets pollers clear stale
+        series (a vanished zone must not keep reporting its last count)."""
+        with self._lock:
+            for key in [k for k in self._values if predicate(k)]:
+                del self._values[key]
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for label_values, value in sorted(self._values.items()):
+                labels = ",".join(
+                    f'{n}="{v}"' for n, v in zip(self.label_names, label_values)
+                )
+                lines.append(f"{self.name}{{{labels}}} {value}")
+        return lines
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DURATION_BUCKETS,
+    ):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(labels)
+        self.buckets = tuple(buckets)
+        self._counts: Dict[LabelValues, List[int]] = {}
+        self._sums: Dict[LabelValues, float] = {}
+        self._totals: Dict[LabelValues, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, *label_values: str) -> None:
+        key = tuple(label_values)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def measure(self, *label_values: str):
+        """Context manager timing a block (ref: metrics.Measure defer-timer)."""
+        histogram = self
+
+        class _Timer:
+            def __enter__(self):
+                self.start = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                histogram.observe(time.perf_counter() - self.start, *label_values)
+                return False
+
+        return _Timer()
+
+    def count(self, *label_values: str) -> int:
+        with self._lock:
+            return self._totals.get(tuple(label_values), 0)
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                base = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
+                sep = "," if base else ""
+                for bound, count in zip(self.buckets, counts):
+                    lines.append(
+                        f'{self.name}_bucket{{{base}{sep}le="{bound}"}} {count}'
+                    )
+                lines.append(
+                    f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {self._totals[key]}'
+                )
+                lines.append(f"{self.name}_sum{{{base}}} {self._sums[key]}")
+                lines.append(f"{self.name}_count{{{base}}} {self._totals[key]}")
+        return lines
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List = []
+        self._lock = threading.Lock()
+
+    def gauge(self, name: str, help_text: str, labels: Sequence[str] = ()) -> Gauge:
+        metric = Gauge(f"{NAMESPACE}_{name}", help_text, labels)
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def histogram(
+        self, name: str, help_text: str, labels: Sequence[str] = (), buckets=DURATION_BUCKETS
+    ) -> Histogram:
+        metric = Histogram(f"{NAMESPACE}_{name}", help_text, labels, buckets)
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for metric in self._metrics:
+                lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

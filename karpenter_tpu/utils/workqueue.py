@@ -1,0 +1,107 @@
+"""Rate-limited work queues.
+
+Ref: pkg/utils/parallel/workqueue.go (token-bucket async task runner used to
+throttle CreateFleet) and termination/eviction.go (set-deduped queue with
+exponential per-item backoff 100ms -> 10s).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, Hashable, Optional
+
+from karpenter_tpu.utils.clock import Clock
+
+
+class RateLimiter:
+    """Token bucket: qps refill, burst capacity (ref: client-go flowcontrol
+    as used at aws/cloudprovider.go:41-46)."""
+
+    def __init__(self, qps: float, burst: int, clock: Optional[Clock] = None):
+        self.qps = qps
+        self.burst = burst
+        self.clock = clock or Clock()
+        self._tokens = float(burst)
+        self._last = self.clock.now()
+        self._lock = threading.Lock()
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            now = self.clock.now()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def wait_time(self) -> float:
+        with self._lock:
+            if self._tokens >= 1.0:
+                return 0.0
+            return (1.0 - self._tokens) / self.qps
+
+
+class BackoffQueue:
+    """Set-deduped retry queue with per-item exponential backoff
+    (ref: termination/eviction.go:33-54). Synchronous drain model: callers
+    pump `process(fn)`; items whose fn returns False are requeued with
+    backoff. Tests drive it with a FakeClock."""
+
+    def __init__(
+        self,
+        base_delay: float = 0.1,
+        max_delay: float = 10.0,
+        clock: Optional[Clock] = None,
+    ):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.clock = clock or Clock()
+        self._queue: deque = deque()
+        self._in_queue: set = set()
+        self._failures: Dict[Hashable, int] = {}
+        self._not_before: Dict[Hashable, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, item: Hashable) -> bool:
+        with self._lock:
+            if item in self._in_queue:
+                return False
+            self._in_queue.add(item)
+            self._queue.append(item)
+            return True
+
+    def __len__(self):
+        return len(self._queue)
+
+    def __contains__(self, item):
+        return item in self._in_queue
+
+    def process(self, fn: Callable[[Hashable], bool]) -> int:
+        """Run fn over every currently-due item once. Returns #successes.
+        Failures requeue with exponential backoff."""
+        with self._lock:
+            batch = list(self._queue)
+            self._queue.clear()
+        done = 0
+        now = self.clock.now()
+        for item in batch:
+            if self._not_before.get(item, 0.0) > now:
+                with self._lock:
+                    self._queue.append(item)
+                continue
+            ok = fn(item)
+            with self._lock:
+                if ok:
+                    self._in_queue.discard(item)
+                    self._failures.pop(item, None)
+                    self._not_before.pop(item, None)
+                    done += 1
+                else:
+                    failures = self._failures.get(item, 0) + 1
+                    self._failures[item] = failures
+                    delay = min(self.base_delay * (2 ** (failures - 1)), self.max_delay)
+                    self._not_before[item] = now + delay
+                    self._queue.append(item)
+        return done

@@ -1,13 +1,25 @@
 """Test environment: force an 8-device virtual CPU mesh so multi-chip sharding
 paths are exercised without TPU hardware (the driver separately dry-runs the
-real multichip path via __graft_entry__.dryrun_multichip)."""
+real multichip path via __graft_entry__.dryrun_multichip).
+
+Under the axon TPU harness, a sitecustomize registers the 'axon' PJRT backend
+at interpreter start (before this conftest can set JAX_PLATFORMS), and
+selecting cpu via env alone then hangs in backend init. So: update the already
+-imported jax config and drop the axon factory before any backend initializes.
+"""
 
 import os
 
-# Must happen before jax is imported anywhere.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover — jax internals moved; env var still set
+    pass

@@ -1,0 +1,83 @@
+"""Controller test harness — the expectations vocabulary.
+
+Ref: pkg/test/expectations/expectations.go — controllers are driven by
+explicit reconcile calls against the in-memory cluster, exactly like the
+reference drives envtest. `provision()` is the ExpectProvisioned analogue:
+apply pods, run selection, close the batch window, run the workers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.metrics import MetricsController
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.models.solver import Solver
+from karpenter_tpu.utils.clock import FakeClock
+
+
+class Harness:
+    def __init__(
+        self,
+        instance_types=None,
+        solver: Optional[Solver] = None,
+        clock: Optional[FakeClock] = None,
+    ):
+        self.clock = clock or FakeClock()
+        self.cluster = Cluster(clock=self.clock)
+        self.cloud = FakeCloudProvider(instance_types=instance_types, clock=self.clock)
+        self.provisioning = ProvisioningController(self.cluster, self.cloud, solver)
+        self.selection = SelectionController(self.cluster, self.provisioning)
+        self.termination = TerminationController(self.cluster, self.cloud)
+        self.node = NodeController(self.cluster)
+        self.counter = CounterController(self.cluster)
+        self.metrics = MetricsController(self.cluster)
+
+    def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:
+        self.cluster.apply_provisioner(provisioner)
+        self.provisioning.reconcile(provisioner.name)
+        return provisioner
+
+    def provision(self, *pods: PodSpec) -> List[PodSpec]:
+        """Apply pods, select, provision — returns the live pods."""
+        for pod in pods:
+            self.cluster.apply_pod(pod)
+            self.selection.reconcile(pod.namespace, pod.name)
+        for worker in self.provisioning.workers.values():
+            worker.provision()
+        for provisioner in self.cluster.list_provisioners():
+            self.counter.reconcile(provisioner.name)
+        return [self.cluster.get_pod(p.namespace, p.name) for p in pods]
+
+    def expect_scheduled(self, pod: PodSpec):
+        live = self.cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"pod {pod.name} was not scheduled"
+        return self.cluster.get_node(live.node_name)
+
+    def expect_not_scheduled(self, pod: PodSpec) -> None:
+        live = self.cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is None, (
+            f"pod {pod.name} unexpectedly scheduled to {live.node_name}"
+        )
+
+    def reconcile_nodes(self) -> None:
+        for node in list(self.cluster.list_nodes()):
+            self.node.reconcile(node.name)
+
+    def reconcile_terminations(self, rounds: int = 10) -> None:
+        for _ in range(rounds):
+            progressed = False
+            for node in list(self.cluster.list_nodes()):
+                if self.termination.reconcile(node.name) is not None:
+                    progressed = True
+            self.termination.evictions.drain_once()
+            if not progressed:
+                return

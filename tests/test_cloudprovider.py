@@ -1,0 +1,130 @@
+"""Cloud-provider suite (ref: aws/suite_test.go:104-465 against fake EC2):
+ICE blackout fallback, spot/on-demand choice, capacity-type constraints,
+registry hook installation."""
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Constraints, Provisioner, ProvisionerSpec
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.cloudprovider import InsufficientCapacityError
+from karpenter_tpu.cloudprovider.fake import UNAVAILABLE_OFFERING_TTL, FakeCloudProvider
+from karpenter_tpu.cloudprovider import registry as cp_registry
+from karpenter_tpu.api import validation
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+class TestFakeProvider:
+    def test_lowest_price_offering_chosen(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        # Spot is cheaper in the fake catalog.
+        assert node.capacity_type == "spot"
+
+    def test_on_demand_constraint_honored(self):
+        h = Harness()
+        h.apply_provisioner(
+            Provisioner(
+                name="default",
+                spec=ProvisionerSpec(
+                    constraints=Constraints(
+                        requirements=Requirements(
+                            [
+                                Requirement.in_(
+                                    wellknown.CAPACITY_TYPE_LABEL, ["on-demand"]
+                                )
+                            ]
+                        )
+                    )
+                ),
+            )
+        )
+        pod = fixtures.pod()
+        h.provision(pod)
+        assert h.expect_scheduled(pod).capacity_type == "on-demand"
+
+    def test_ice_falls_back_to_other_pool(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        # Black out the cheapest pool (small spot in every zone).
+        for zone in ("test-zone-1", "test-zone-2", "test-zone-3"):
+            h.cloud.insufficient_capacity_pools.add(
+                ("small-instance-type", zone, "spot")
+            )
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        # Fallback: same type on-demand (next cheapest viable pool).
+        assert (node.instance_type, node.capacity_type) != (
+            "small-instance-type",
+            "spot",
+        )
+
+    def test_ice_blackout_expires(self):
+        h = Harness()
+        h.cloud.cache_unavailable("small-instance-type", "test-zone-1", "spot")
+        names = {
+            (it.name, o.zone, o.capacity_type)
+            for it in h.cloud.get_instance_types()
+            for o in it.offerings
+        }
+        assert ("small-instance-type", "test-zone-1", "spot") not in names
+        h.clock.advance(UNAVAILABLE_OFFERING_TTL + 1)
+        names = {
+            (it.name, o.zone, o.capacity_type)
+            for it in h.cloud.get_instance_types()
+            for o in it.offerings
+        }
+        assert ("small-instance-type", "test-zone-1", "spot") in names
+
+    def test_total_ice_reports_errors(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        for it in h.cloud.get_instance_types():
+            for o in it.offerings:
+                h.cloud.insufficient_capacity_pools.add(
+                    (it.name, o.zone, o.capacity_type)
+                )
+        pod = fixtures.pod()
+        h.cluster.apply_pod(pod)
+        h.selection.reconcile(pod.namespace, pod.name)
+        worker = h.provisioning.worker("default")
+        stats = worker.provision()
+        assert stats.launch_errors
+        assert isinstance(stats.launch_errors[0], InsufficientCapacityError)
+        h.expect_not_scheduled(pod)
+
+    def test_create_calls_recorded(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        h.provision(fixtures.pod())
+        assert len(h.cloud.create_calls) == 1
+        _, type_names, quantity = h.cloud.create_calls[0]
+        assert quantity == 1
+        assert type_names  # instance options offered
+
+
+class TestRegistry:
+    def test_factory_and_hooks(self):
+        provider = cp_registry.new_cloud_provider("fake")
+        assert isinstance(provider, FakeCloudProvider)
+        assert validation.DEFAULT_HOOK == provider.default
+        # Defaulting hook fills capacity types.
+        p = Provisioner(name="default", spec=ProvisionerSpec())
+        validation.default_provisioner(p)
+        assert p.spec.constraints.requirements.capacity_types() == {
+            "on-demand",
+            "spot",
+        }
+        # Cleanup module-level hooks for test isolation.
+        validation.DEFAULT_HOOK = None
+        validation.VALIDATE_HOOK = None
+
+    def test_unknown_provider(self):
+        import pytest
+
+        with pytest.raises(KeyError):
+            cp_registry.new_cloud_provider("nope")

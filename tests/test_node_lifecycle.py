@@ -1,0 +1,176 @@
+"""Node lifecycle suite (ref: node/suite_test.go:60-346): readiness taint,
+liveness timeout, emptiness TTL, expiration TTL, finalizer repair — all via
+the mocked clock. Plus counter and metrics controllers."""
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.controllers.node import LIVENESS_TIMEOUT_SECONDS
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+def provision_node(h, **spec_kwargs):
+    h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec(**spec_kwargs)))
+    pod = fixtures.pod()
+    h.provision(pod)
+    return h.expect_scheduled(pod), pod
+
+
+class TestReadiness:
+    def test_not_ready_taint_removed_when_ready(self):
+        h = Harness()
+        node, _ = provision_node(h)
+        assert any(t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints)
+        h.node.reconcile(node.name)  # still not ready: taint stays
+        assert any(t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        h.node.reconcile(node.name)
+        assert not any(t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints)
+
+
+class TestLiveness:
+    def test_never_joined_node_deleted(self):
+        h = Harness()
+        node, _ = provision_node(h)
+        requeue = h.node.reconcile(node.name)
+        assert requeue is not None  # waiting for liveness deadline
+        h.clock.advance(LIVENESS_TIMEOUT_SECONDS + 1)
+        h.node.reconcile(node.name)
+        live = h.cluster.try_get_node(node.name)
+        assert live is None or live.deletion_timestamp is not None
+
+    def test_joined_node_survives(self):
+        h = Harness()
+        node, _ = provision_node(h)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        h.clock.advance(LIVENESS_TIMEOUT_SECONDS + 1)
+        h.node.reconcile(node.name)
+        assert h.cluster.get_node(node.name).deletion_timestamp is None
+
+
+class TestEmptiness:
+    def test_empty_node_stamped_then_deleted(self):
+        h = Harness()
+        node, pod = provision_node(h, ttl_seconds_after_empty=30)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        h.cluster.delete_pod(pod.namespace, pod.name)
+        h.node.reconcile(node.name)
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in node.annotations
+        h.clock.advance(31)
+        h.node.reconcile(node.name)
+        live = h.cluster.try_get_node(node.name)
+        assert live is None or live.deletion_timestamp is not None
+
+    def test_nonempty_node_annotation_cleared(self):
+        h = Harness()
+        node, pod = provision_node(h, ttl_seconds_after_empty=30)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        h.cluster.delete_pod(pod.namespace, pod.name)
+        h.node.reconcile(node.name)
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in node.annotations
+        # A new pod lands before the TTL: stamp must clear.
+        newpod = fixtures.pod()
+        h.cluster.apply_pod(newpod)
+        h.cluster.bind_pod(newpod, node)
+        h.node.reconcile(node.name)
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION not in node.annotations
+
+    def test_daemon_pods_dont_block_emptiness(self):
+        h = Harness()
+        node, pod = provision_node(h, ttl_seconds_after_empty=30)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        h.cluster.delete_pod(pod.namespace, pod.name)
+        daemon = fixtures.pod(owner_kind="DaemonSet")
+        h.cluster.apply_pod(daemon)
+        daemon.node_name = node.name
+        h.node.reconcile(node.name)
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in node.annotations
+
+
+class TestExpiration:
+    def test_expired_node_deleted(self):
+        h = Harness()
+        node, _ = provision_node(h, ttl_seconds_until_expired=300)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        requeue = h.node.reconcile(node.name)
+        assert requeue is not None and requeue <= 300
+        h.clock.advance(301)
+        h.node.reconcile(node.name)
+        live = h.cluster.try_get_node(node.name)
+        assert live is None or live.deletion_timestamp is not None
+
+    def test_no_ttl_no_expiry(self):
+        h = Harness()
+        node, _ = provision_node(h)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        h.clock.advance(10**6)
+        h.node.reconcile(node.name)
+        assert h.cluster.get_node(node.name).deletion_timestamp is None
+
+
+class TestFinalizer:
+    def test_missing_finalizer_readded(self):
+        h = Harness()
+        node, _ = provision_node(h)
+        node.finalizers.clear()
+        h.node.reconcile(node.name)
+        assert wellknown.TERMINATION_FINALIZER in node.finalizers
+
+    def test_foreign_nodes_ignored(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        foreign = NodeSpec(name="foreign")
+        h.cluster.create_node(foreign)
+        h.node.reconcile("foreign")
+        assert foreign.finalizers == []
+
+
+class TestCounter:
+    def test_capacity_aggregated(self):
+        h = Harness()
+        node, _ = provision_node(h)
+        h.counter.reconcile("default")
+        provisioner = h.cluster.try_get_provisioner("default")
+        assert provisioner.status.resources["cpu"] == node.capacity["cpu"]
+
+    def test_deleting_nodes_excluded(self):
+        h = Harness()
+        node, _ = provision_node(h)
+        h.cluster.delete_node(node.name)
+        h.counter.reconcile("default")
+        provisioner = h.cluster.try_get_provisioner("default")
+        assert provisioner.status.resources.get("cpu", 0) == 0
+
+
+class TestMetrics:
+    def test_node_gauges_published(self):
+        from karpenter_tpu.controllers.metrics import (
+            NODE_COUNT_BY_INSTANCE_TYPE,
+            NODE_COUNT_BY_ZONE,
+        )
+
+        h = Harness()
+        node, _ = provision_node(h)
+        h.metrics.reconcile("default")
+        assert NODE_COUNT_BY_ZONE.get("default", node.zone) == 1
+        assert NODE_COUNT_BY_INSTANCE_TYPE.get("default", node.instance_type) == 1
+
+    def test_render_exposition(self):
+        from karpenter_tpu.utils.metrics import REGISTRY
+
+        h = Harness()
+        provision_node(h)
+        h.metrics.reconcile("default")
+        text = REGISTRY.render()
+        assert "karpenter_nodes_by_zone" in text
+        assert "# TYPE" in text

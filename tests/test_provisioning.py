@@ -1,0 +1,213 @@
+"""Provisioning suite (ref: provisioning/suite_test.go:65-250): batch
+provisioning, accelerators, limits, daemonset overhead, labels, taints."""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import (
+    Constraints,
+    Limits,
+    Provisioner,
+    ProvisionerSpec,
+)
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.taints import Taint, Toleration
+from karpenter_tpu.controllers.provisioning import global_requirements, spec_hash
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+def default_provisioner(**kwargs) -> Provisioner:
+    return Provisioner(name="default", spec=ProvisionerSpec(**kwargs))
+
+
+class TestProvisioning:
+    def test_batch_provisions_and_binds(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        pods = fixtures.pods(10)
+        h.provision(*pods)
+        nodes = {h.expect_scheduled(p).name for p in pods}
+        assert len(nodes) == 1  # all fit one default node
+        node = h.cluster.get_node(next(iter(nodes)))
+        assert node.labels[wellknown.PROVISIONER_NAME_LABEL] == "default"
+        assert wellknown.TERMINATION_FINALIZER in node.finalizers
+        assert any(t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints)
+
+    def test_no_provisioner_no_schedule(self):
+        h = Harness()
+        pods = fixtures.pods(2)
+        h.provision(*pods)
+        for pod in pods:
+            h.expect_not_scheduled(pod)
+
+    def test_gpu_pod_gets_gpu_node(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        pod = fixtures.pod()
+        pod.requests[wellknown.RESOURCE_NVIDIA_GPU] = 1.0
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.instance_type == "nvidia-gpu-instance-type"
+
+    def test_tpu_pod_gets_tpu_node(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        pod = fixtures.pod()
+        pod.requests[wellknown.RESOURCE_GOOGLE_TPU] = 4.0
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.instance_type == "tpu-instance-type"
+
+    def test_limits_stop_launches(self):
+        h = Harness()
+        provisioner = default_provisioner(limits=Limits(resources={"cpu": "1"}))
+        h.apply_provisioner(provisioner)
+        first = fixtures.pods(1)
+        h.provision(*first)
+        h.expect_scheduled(first[0])
+        # Counter has now recorded >= 1 cpu of capacity; the next launch must
+        # be blocked (ref: provisioner.go:187-195).
+        second = fixtures.pods(1)
+        h.provision(*second)
+        h.expect_not_scheduled(second[0])
+
+    def test_daemonset_overhead_reserved(self):
+        h = Harness(
+            instance_types=[fixtures.cpu_instance("only", cpu=4, mem_gib=16)]
+        )
+        h.apply_provisioner(default_provisioner())
+        h.cluster.apply_daemonset(
+            "logging-agent", PodSpec(name="logger", requests={"cpu": "1"})
+        )
+        pods = fixtures.pods(6, cpu="1")  # 3 fit per node (4 - 1 daemon)
+        h.provision(*pods)
+        nodes = {h.expect_scheduled(p).name for p in pods}
+        assert len(nodes) == 2
+
+    def test_provisioner_labels_applied(self):
+        h = Harness()
+        h.apply_provisioner(
+            default_provisioner(constraints=Constraints(labels={"team": "infra"}))
+        )
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.labels["team"] == "infra"
+
+    def test_taints_require_toleration(self):
+        h = Harness()
+        h.apply_provisioner(
+            default_provisioner(
+                constraints=Constraints(taints=[Taint(key="dedicated", value="ml")])
+            )
+        )
+        plain = fixtures.pod()
+        tolerant = fixtures.pod(
+            tolerations=[Toleration(key="dedicated", value="ml", effect="NoSchedule")]
+        )
+        h.provision(plain, tolerant)
+        h.expect_not_scheduled(plain)
+        h.expect_scheduled(tolerant)
+
+    def test_zone_selector_honored(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        pod = fixtures.pod(node_selector={wellknown.ZONE_LABEL: "test-zone-2"})
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.zone == "test-zone-2"
+
+    def test_unschedulable_giant_left_pending(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        giant = fixtures.pod(cpu="1000")
+        h.provision(giant)
+        h.expect_not_scheduled(giant)
+
+    def test_bound_pods_filtered_from_batch(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        pod = fixtures.pod()
+        h.cluster.apply_pod(pod)
+        h.selection.reconcile(pod.namespace, pod.name)
+        # Pod gets bound out-of-band before the batch drains.
+        pod.node_name = "elsewhere"
+        for worker in h.provisioning.workers.values():
+            stats = worker.provision()
+            assert stats.scheduled_pods == 0
+
+
+class TestProvisionerLifecycle:
+    def test_requirements_refreshed_from_fleet(self):
+        h = Harness()
+        provisioner = h.apply_provisioner(default_provisioner())
+        # The worker's effective copy carries the fleet-derived requirements;
+        # the stored spec stays pristine so fleet drift can widen it again.
+        worker = h.provisioning.worker("default")
+        zones = worker.provisioner.spec.constraints.requirements.zones()
+        assert zones == {"test-zone-1", "test-zone-2", "test-zone-3"}
+        assert provisioner.spec.constraints.requirements.zones() is None
+
+    def test_fleet_recovery_widens_envelope(self):
+        # An offering that disappears (ICE blackout) and comes back must be
+        # usable again — the requirements refresh can't ratchet.
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        h.cloud.cache_unavailable("small-instance-type", "test-zone-1", "spot")
+        h.cloud.cache_unavailable("small-instance-type", "test-zone-1", "on-demand")
+        h.provisioning.reconcile("default")
+        h.clock.advance(60)  # blackout expires
+        h.provisioning.reconcile("default")
+        worker = h.provisioning.worker("default")
+        allowed = worker.provisioner.spec.constraints.requirements.zones()
+        assert "test-zone-1" in allowed
+
+    def test_spec_hash_change_restarts_worker(self):
+        h = Harness()
+        provisioner = h.apply_provisioner(default_provisioner())
+        worker1 = h.provisioning.worker("default")
+        h.provisioning.reconcile("default")
+        assert h.provisioning.worker("default") is worker1  # unchanged spec
+        provisioner.spec.constraints.labels["team"] = "infra"
+        h.cluster.apply_provisioner(provisioner)
+        h.provisioning.reconcile("default")
+        assert h.provisioning.worker("default") is not worker1
+
+    def test_delete_stops_worker(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        assert h.provisioning.worker("default") is not None
+        h.cluster.delete_provisioner("default")
+        h.provisioning.reconcile("default")
+        assert h.provisioning.worker("default") is None
+
+    def test_global_requirements_union(self):
+        reqs = global_requirements(fixtures.default_catalog())
+        assert "arm64" in reqs.architectures()
+        assert "amd64" in reqs.architectures()
+        assert reqs.capacity_types() == {"on-demand", "spot"}
+
+    def test_batching_window(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        worker = h.provisioning.worker("default")
+        pod = fixtures.pod()
+        h.cluster.apply_pod(pod)
+        worker.add(pod)
+        assert not worker.batch_ready()  # window still open
+        h.clock.advance(1.1)  # idle > 1s
+        assert worker.batch_ready()
+
+    def test_batching_max_window(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        worker = h.provisioning.worker("default")
+        for i in range(20):
+            pod = fixtures.pod()
+            h.cluster.apply_pod(pod)
+            worker.add(pod)
+            h.clock.advance(0.6)  # keeps idle window open
+        assert worker.batch_ready()  # 10s max window exceeded

@@ -1,0 +1,164 @@
+"""Scheduling suite (ref: scheduling/suite_test.go:81-660): constraint
+combinations, topology spread (zonal, hostname, combined), schedule grouping."""
+
+from collections import Counter
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec, TopologySpreadConstraint
+from karpenter_tpu.api.provisioner import Constraints, Provisioner, ProvisionerSpec
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.controllers.scheduling import Scheduler
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+def provisioner(name="default", **kwargs) -> Provisioner:
+    return Provisioner(name=name, spec=ProvisionerSpec(**kwargs))
+
+
+class TestScheduleGrouping:
+    def test_isomorphic_pods_share_schedule(self):
+        h = Harness()
+        p = h.apply_provisioner(provisioner())
+        scheduler = Scheduler(h.cluster)
+        pods = fixtures.pods(5)
+        schedules = scheduler.solve(p, pods)
+        assert len(schedules) == 1
+        assert len(schedules[0].pods) == 5
+
+    def test_distinct_selectors_split_schedules(self):
+        h = Harness()
+        p = h.apply_provisioner(provisioner())
+        scheduler = Scheduler(h.cluster)
+        a = fixtures.pod(node_selector={wellknown.ZONE_LABEL: "test-zone-1"})
+        b = fixtures.pod(node_selector={wellknown.ZONE_LABEL: "test-zone-2"})
+        c = fixtures.pod()
+        schedules = scheduler.solve(p, [a, b, c])
+        assert len(schedules) == 3
+
+    def test_gpu_pods_split_from_cpu(self):
+        h = Harness()
+        p = h.apply_provisioner(provisioner())
+        scheduler = Scheduler(h.cluster)
+        cpu_pod = fixtures.pod()
+        gpu_pod = fixtures.pod()
+        gpu_pod.requests[wellknown.RESOURCE_NVIDIA_GPU] = 1.0
+        schedules = scheduler.solve(p, [cpu_pod, gpu_pod])
+        assert len(schedules) == 2
+
+    def test_incompatible_pods_skipped(self):
+        h = Harness()
+        p = h.apply_provisioner(
+            provisioner(
+                constraints=Constraints(
+                    requirements=Requirements(
+                        [Requirement.in_(wellknown.ZONE_LABEL, ["test-zone-1"])]
+                    )
+                )
+            )
+        )
+        scheduler = Scheduler(h.cluster)
+        bad = fixtures.pod(node_selector={wellknown.ZONE_LABEL: "test-zone-2"})
+        ok = fixtures.pod()
+        schedules = scheduler.solve(p, [bad, ok])
+        assert len(schedules) == 1
+        assert schedules[0].pods == [ok]
+
+
+class TestZonalTopology:
+    def test_spread_across_zones(self):
+        h = Harness()
+        h.apply_provisioner(provisioner())
+        spread = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wellknown.ZONE_LABEL,
+            match_labels={"app": "web"},
+        )
+        pods = [
+            fixtures.pod(labels={"app": "web"}, topology_spread=[spread])
+            for _ in range(6)
+        ]
+        h.provision(*pods)
+        zones = Counter(h.expect_scheduled(p).zone for p in pods)
+        assert set(zones) == {"test-zone-1", "test-zone-2", "test-zone-3"}
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_existing_pods_counted(self):
+        h = Harness()
+        h.apply_provisioner(provisioner())
+        # Seed: an existing bound pod in zone 1.
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        existing_node = NodeSpec(name="seed", zone="test-zone-1")
+        h.cluster.create_node(existing_node)
+        seeded = fixtures.pod(labels={"app": "web"})
+        h.cluster.apply_pod(seeded)
+        h.cluster.bind_pod(seeded, existing_node)
+
+        spread = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wellknown.ZONE_LABEL,
+            match_labels={"app": "web"},
+        )
+        pods = [
+            fixtures.pod(labels={"app": "web"}, topology_spread=[spread])
+            for _ in range(2)
+        ]
+        h.provision(*pods)
+        zones = {h.expect_scheduled(p).zone for p in pods}
+        # The seeded zone already has one pod; new pods go to the other zones.
+        assert zones == {"test-zone-2", "test-zone-3"}
+
+    def test_pod_zone_selector_restricts_domains(self):
+        h = Harness()
+        h.apply_provisioner(provisioner())
+        spread = TopologySpreadConstraint(
+            max_skew=1, topology_key=wellknown.ZONE_LABEL
+        )
+        pod = fixtures.pod(
+            node_selector={wellknown.ZONE_LABEL: "test-zone-2"},
+            topology_spread=[spread],
+        )
+        h.provision(pod)
+        assert h.expect_scheduled(pod).zone == "test-zone-2"
+
+
+class TestHostnameTopology:
+    def test_fabricated_domains_force_separate_nodes(self):
+        h = Harness()
+        h.apply_provisioner(provisioner())
+        spread = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wellknown.HOSTNAME_LABEL,
+            match_labels={"app": "web"},
+        )
+        pods = [
+            fixtures.pod(labels={"app": "web"}, topology_spread=[spread])
+            for _ in range(3)
+        ]
+        h.provision(*pods)
+        # Fabricated hostname domains live on scheduler-local shadows (never
+        # the stored pod); the observable effect is one node per domain.
+        nodes = {h.expect_scheduled(p).name for p in pods}
+        assert len(nodes) == 3
+        for pod in pods:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert wellknown.HOSTNAME_LABEL not in live.node_selector
+
+    def test_max_skew_buckets(self):
+        h = Harness()
+        h.apply_provisioner(provisioner())
+        spread = TopologySpreadConstraint(
+            max_skew=2,
+            topology_key=wellknown.HOSTNAME_LABEL,
+            match_labels={"app": "web"},
+        )
+        pods = [
+            fixtures.pod(labels={"app": "web"}, topology_spread=[spread])
+            for _ in range(4)
+        ]
+        h.provision(*pods)
+        buckets = Counter(h.expect_scheduled(p).name for p in pods)
+        assert len(buckets) == 2  # ceil(4/2) domains -> 2 nodes
+        assert max(buckets.values()) <= 2

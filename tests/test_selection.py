@@ -1,0 +1,97 @@
+"""Selection suite (ref: selection/suite_test.go:75-98): multi-provisioner
+routing, alphabetical priority, unsupported-feature rejection, preference
+relaxation."""
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec, PreferredTerm, TopologySpreadConstraint
+from karpenter_tpu.api.provisioner import Constraints, Provisioner, ProvisionerSpec
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.taints import Taint, Toleration
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+def provisioner(name, **kwargs) -> Provisioner:
+    return Provisioner(name=name, spec=ProvisionerSpec(**kwargs))
+
+
+class TestSelection:
+    def test_alphabetical_first_match(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("bbb"))
+        h.apply_provisioner(provisioner("aaa"))
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.labels[wellknown.PROVISIONER_NAME_LABEL] == "aaa"
+
+    def test_incompatible_first_falls_through(self):
+        h = Harness()
+        h.apply_provisioner(
+            provisioner("aaa", constraints=Constraints(taints=[Taint(key="x", value="y")]))
+        )
+        h.apply_provisioner(provisioner("bbb"))
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.labels[wellknown.PROVISIONER_NAME_LABEL] == "bbb"
+
+    def test_non_provisionable_ignored(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        scheduled = fixtures.pod()
+        scheduled.unschedulable = False
+        daemon = fixtures.pod(owner_kind="DaemonSet")
+        h.provision(scheduled, daemon)
+        h.expect_not_scheduled(scheduled)
+        h.expect_not_scheduled(daemon)
+
+    def test_pod_affinity_rejected(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        pod = fixtures.pod(pod_affinity_terms=[{"topologyKey": "zone"}])
+        h.provision(pod)
+        h.expect_not_scheduled(pod)
+
+    def test_unsupported_topology_key_rejected(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        pod = fixtures.pod(
+            topology_spread=[
+                TopologySpreadConstraint(max_skew=1, topology_key="custom/rack")
+            ]
+        )
+        h.provision(pod)
+        h.expect_not_scheduled(pod)
+
+    def test_unsupported_operator_rejected(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        pod = fixtures.pod(
+            required_terms=[
+                [Requirement(key=wellknown.ZONE_LABEL, operator="Exists", values=())]
+            ]
+        )
+        h.provision(pod)
+        h.expect_not_scheduled(pod)
+
+    def test_preference_relaxation_on_retry(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        # Prefers an impossible zone; required constraints are satisfiable.
+        pod = fixtures.pod(
+            preferred_terms=[
+                PreferredTerm(
+                    weight=10,
+                    requirements=[Requirement.in_(wellknown.ZONE_LABEL, ["mars-1a"])],
+                )
+            ]
+        )
+        h.provision(pod)
+        h.expect_not_scheduled(pod)  # first pass: preference blocks
+        # Retry (requeue) relaxes the preference, then schedules.
+        h.selection.reconcile(pod.namespace, pod.name)
+        for worker in h.provisioning.workers.values():
+            worker.provision()
+        h.expect_scheduled(pod)

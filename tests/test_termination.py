@@ -1,0 +1,135 @@
+"""Termination suite (ref: termination/suite_test.go:76-230): drain ordering,
+do-not-evict, PDB violations, stuck pods, finalizer removal."""
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+def schedule_pods(h, *pods):
+    h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    h.provision(*pods)
+    return [h.expect_scheduled(p) for p in pods]
+
+
+class TestTermination:
+    def test_deletes_empty_node(self):
+        h = Harness()
+        (node,) = schedule_pods(h, fixtures.pod())
+        # Remove the pod, then delete the node.
+        pod = h.cluster.list_pods(node_name=node.name)[0]
+        h.cluster.delete_pod(pod.namespace, pod.name)
+        h.cluster.delete_node(node.name)
+        assert h.cluster.try_get_node(node.name) is not None  # finalizer blocks
+        h.reconcile_terminations()
+        assert h.cluster.try_get_node(node.name) is None
+        assert node.name in h.cloud.deleted_nodes
+
+    def test_cordons_before_drain(self):
+        h = Harness()
+        (node,) = schedule_pods(h, fixtures.pod())
+        h.cluster.delete_node(node.name)
+        h.termination.reconcile(node.name)
+        assert h.cluster.get_node(node.name).unschedulable
+
+    def test_evicts_pods_then_terminates(self):
+        h = Harness()
+        pods = fixtures.pods(3)
+        schedule_pods(h, *pods)
+        node = h.expect_scheduled(pods[0])
+        h.cluster.delete_node(node.name)
+        h.reconcile_terminations()
+        # Pods got eviction timestamps (deletion), then vanish; once the node
+        # is empty the cloud delete + finalizer removal completes.
+        for pod in pods:
+            live = h.cluster.try_get_pod(pod.namespace, pod.name)
+            assert live is None or live.is_terminating()
+        # Simulate kubelet finishing pod deletion.
+        for pod in pods:
+            h.cluster.delete_pod(pod.namespace, pod.name)
+        h.reconcile_terminations()
+        assert h.cluster.try_get_node(node.name) is None
+
+    def test_do_not_evict_blocks_drain(self):
+        h = Harness()
+        protected = fixtures.pod(
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"}
+        )
+        (node,) = schedule_pods(h, protected)
+        h.cluster.delete_node(node.name)
+        h.reconcile_terminations(rounds=3)
+        assert h.cluster.try_get_node(node.name) is not None  # still blocked
+        live = h.cluster.get_pod(protected.namespace, protected.name)
+        assert not live.is_terminating()
+
+    def test_daemonset_pods_not_evicted(self):
+        h = Harness()
+        (node,) = schedule_pods(h, fixtures.pod())
+        daemon = fixtures.pod(owner_kind="DaemonSet")
+        h.cluster.apply_pod(daemon)
+        daemon.node_name = node.name
+        h.cluster.delete_node(node.name)
+        # Drain only the evictable pod; daemon stays.
+        for pod in h.cluster.list_pods(node_name=node.name):
+            if not pod.is_owned_by_daemonset() and pod.is_terminating():
+                h.cluster.delete_pod(pod.namespace, pod.name)
+        h.reconcile_terminations()
+        for _ in range(3):
+            for pod in list(h.cluster.list_pods(node_name=node.name)):
+                if pod.is_terminating():
+                    h.cluster.delete_pod(pod.namespace, pod.name)
+            h.reconcile_terminations()
+        live_daemon = h.cluster.get_pod(daemon.namespace, daemon.name)
+        assert not live_daemon.is_terminating()
+        assert h.cluster.try_get_node(node.name) is None
+
+    def test_pdb_violation_retries(self):
+        h = Harness()
+        pods = [fixtures.pod(labels={"app": "db"}) for _ in range(2)]
+        schedule_pods(h, *pods)
+        node = h.expect_scheduled(pods[0])
+        # PDB requires 2 available; eviction of either violates it.
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=2)
+        h.cluster.delete_node(node.name)
+        h.reconcile_terminations(rounds=3)
+        assert h.cluster.try_get_node(node.name) is not None
+        for pod in pods:
+            assert not h.cluster.get_pod(pod.namespace, pod.name).is_terminating()
+        # Relax the PDB: drain proceeds on retry.
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=0)
+        h.clock.advance(60)  # clear eviction backoff
+        h.reconcile_terminations()
+        assert all(
+            h.cluster.get_pod(p.namespace, p.name).is_terminating() for p in pods
+        )
+
+    def test_critical_pods_evicted_last(self):
+        h = Harness()
+        normal = fixtures.pod()
+        critical = fixtures.pod(priority_class_name="system-cluster-critical")
+        schedule_pods(h, normal, critical)
+        node = h.expect_scheduled(normal)
+        h.cluster.delete_node(node.name)
+        h.termination.reconcile(node.name)
+        h.termination.evictions.drain_once()
+        live_normal = h.cluster.get_pod(normal.namespace, normal.name)
+        live_critical = h.cluster.get_pod(critical.namespace, critical.name)
+        assert live_normal.is_terminating()
+        assert not live_critical.is_terminating()  # waits for non-critical
+        h.cluster.delete_pod(normal.namespace, normal.name)
+        h.termination.reconcile(node.name)
+        h.termination.evictions.drain_once()
+        assert h.cluster.get_pod(critical.namespace, critical.name).is_terminating()
+
+    def test_node_without_finalizer_ignored(self):
+        h = Harness()
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        node = NodeSpec(name="external")
+        h.cluster.create_node(node)
+        h.cluster.delete_node(node.name)
+        assert h.termination.reconcile(node.name) is None
+        assert node.name not in h.cloud.deleted_nodes
