@@ -1,0 +1,1 @@
+"""Entry-point binaries (ref: cmd/controller, cmd/webhook)."""

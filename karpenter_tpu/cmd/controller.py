@@ -1,0 +1,56 @@
+"""Controller entry point.
+
+Ref: cmd/controller/main.go:61-99 — parse options, build logging, acquire
+leadership, construct the cloud provider (installing its API hooks), register
+all controllers, serve metrics + health.
+
+Run: python -m karpenter_tpu.cmd.controller --cluster-name my-cluster
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+from karpenter_tpu.cloudprovider import registry
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.runtime import LeaderLock, Manager, serve_http
+from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils import options as options_pkg
+
+
+def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
+    options = options_pkg.parse(argv)
+    log = klog.setup(options.log_level)
+    log.info("starting karpenter-tpu controller for cluster %s", options.cluster_name)
+
+    lock = LeaderLock()
+    if options.leader_election:
+        log.info("acquiring leader lock")
+        lock.acquire(blocking=True)
+
+    cloud = registry.new_cloud_provider(options.cloud_provider)
+    cluster = cluster if cluster is not None else Cluster()
+    manager = Manager(cluster, cloud, options)
+    manager.start()
+    serve_http(manager, options.metrics_port)
+    log.info(
+        "controller ready: metrics on :%d, solver=%s, cloud=%s",
+        options.metrics_port,
+        options.solver,
+        options.cloud_provider,
+    )
+
+    if block:
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        stop.wait()
+        manager.stop()
+        lock.release()
+    return manager
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,100 @@
+"""Admission webhook entry point.
+
+Ref: cmd/webhook/main.go:44-96 — the reference runs knative admission
+webhooks for CRD defaulting, CRD validation, and logging-config validation.
+Here the same three behaviors are exposed as an HTTP service:
+
+  POST /default   — provisioner JSON in, defaulted provisioner JSON out
+  POST /validate  — provisioner JSON in, 200 or 422 with reasons
+  POST /config    — {"level": "..."} live log-level reload
+                    (ref: the config-logging ConfigMap validation webhook)
+
+Run: python -m karpenter_tpu.cmd.webhook --cluster-name my-cluster
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import sys
+import threading
+
+from karpenter_tpu.api import validation
+from karpenter_tpu.api.serialization import provisioner_from_dict, provisioner_to_dict
+from karpenter_tpu.cloudprovider import registry
+from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils import options as options_pkg
+
+
+class WebhookHandler(http.server.BaseHTTPRequestHandler):
+    def _read_json(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _respond(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802
+        try:
+            data = self._read_json()
+        except (ValueError, json.JSONDecodeError) as error:
+            self._respond(400, {"error": f"invalid JSON: {error}"})
+            return
+        if self.path == "/default":
+            try:
+                provisioner = provisioner_from_dict(data)
+                validation.default_provisioner(provisioner)
+                self._respond(200, provisioner_to_dict(provisioner))
+            except Exception as error:  # noqa: BLE001
+                self._respond(400, {"error": str(error)})
+        elif self.path == "/validate":
+            try:
+                provisioner = provisioner_from_dict(data)
+                validation.validate_provisioner(provisioner)
+                self._respond(200, {"allowed": True})
+            except validation.ValidationError as error:
+                self._respond(422, {"allowed": False, "reason": str(error)})
+            except Exception as error:  # noqa: BLE001
+                self._respond(400, {"error": str(error)})
+        elif self.path == "/config":
+            level = data.get("level") if isinstance(data, dict) else None
+            if not isinstance(level, str) or level.lower() not in (
+                "debug",
+                "info",
+                "warning",
+                "error",
+            ):
+                self._respond(422, {"allowed": False, "reason": f"bad level {level!r}"})
+                return
+            klog.set_level(level)
+            self._respond(200, {"allowed": True})
+        else:
+            self._respond(404, {"error": "not found"})
+
+    def log_message(self, *args):
+        pass
+
+
+def main(argv=None, port: int = 8443, block: bool = True, address: str = ""):
+    options = options_pkg.parse(argv)
+    klog.setup(options.log_level)
+    registry.new_cloud_provider(options.cloud_provider)  # installs hooks
+    server = http.server.ThreadingHTTPServer((address, port), WebhookHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    klog.named("webhook").info("webhook serving on :%d", port)
+    if block:
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        server.shutdown()
+    return server
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

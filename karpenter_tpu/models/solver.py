@@ -161,6 +161,9 @@ class CostSolver(Solver):
     def solve(self, pods, instance_types, constraints, daemons=()):
         groups = group_pods(list(pods))
         fleet = build_fleet(instance_types, constraints, pods, daemons)
+        return self.solve_encoded(groups, fleet)
+
+    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         if fleet.num_types == 0 or groups.num_groups == 0:
             return ffd.pack_groups(fleet, groups)
 

@@ -1,0 +1,278 @@
+"""Runtime: the controller-manager process shell.
+
+Ref: cmd/controller/main.go + pkg/controllers/manager.go — wires cluster
+watches to reconcile loops, runs the per-Provisioner batch windows, serves
+/metrics and /healthz//readyz, and holds a leader lock. Everything is
+thread-based (the reference's goroutines) over the in-memory cluster store;
+tests keep driving controllers synchronously without any of this.
+"""
+
+from __future__ import annotations
+
+import heapq
+import http.server
+import threading
+from typing import Callable, Optional
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.metrics import MetricsController, POLL_SECONDS
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.provisioning import (
+    BATCH_IDLE_SECONDS,
+    ProvisioningController,
+)
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.models.solver import CostSolver, GreedySolver, TPUSolver
+from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.metrics import REGISTRY
+from karpenter_tpu.utils.options import Options
+
+
+class ReconcileLoop:
+    """A keyed reconcile queue with delayed requeue — the controller-runtime
+    workqueue analogue. reconcile(key) returns None (done) or a delay in
+    seconds to requeue."""
+
+    def __init__(self, name: str, reconcile: Callable, concurrency: int = 1):
+        self.name = name
+        self.reconcile = reconcile
+        self.concurrency = concurrency
+        self.log = klog.named(name)
+        self._heap: list = []  # (due_time, seq, key)
+        self._queued: set = set()
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._stop = False
+        self._threads: list = []
+
+    def enqueue(self, key, delay: float = 0.0) -> None:
+        import time as _time
+
+        with self._cv:
+            if key in self._queued and delay == 0.0:
+                return  # collapse duplicate immediate enqueues
+            self._queued.add(key)
+            self._seq += 1
+            heapq.heappush(self._heap, (_time.monotonic() + delay, self._seq, key))
+            self._cv.notify()
+
+    def start(self) -> None:
+        for i in range(self.concurrency):
+            thread = threading.Thread(
+                target=self._run, name=f"{self.name}-{i}", daemon=True
+            )
+            thread.start()
+            self._threads.append(thread)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        import time as _time
+
+        while True:
+            with self._cv:
+                while not self._stop and (
+                    not self._heap or self._heap[0][0] > _time.monotonic()
+                ):
+                    timeout = (
+                        self._heap[0][0] - _time.monotonic() if self._heap else None
+                    )
+                    self._cv.wait(timeout=timeout)
+                if self._stop:
+                    return
+                _, _, key = heapq.heappop(self._heap)
+                self._queued.discard(key)
+            try:
+                result = self.reconcile(key)
+            except Exception:  # noqa: BLE001 — a reconcile error must not kill the loop
+                self.log.exception("reconcile %r failed", key)
+                result = 1.0
+            if result is not None:
+                self.enqueue(key, delay=float(result))
+
+
+class LeaderLock:
+    """Single-host leader election stand-in: an exclusive file lock
+    (ref: cmd/controller/main.go:80-81 leader-election lease). Multi-replica
+    deployments on kube should use a Lease object instead."""
+
+    def __init__(self, path: str = "/tmp/karpenter-tpu-leader.lock"):
+        self.path = path
+        self._file = None
+
+    def acquire(self, blocking: bool = True) -> bool:
+        import fcntl
+
+        self._file = open(self.path, "w")
+        try:
+            fcntl.flock(
+                self._file,
+                fcntl.LOCK_EX | (0 if blocking else fcntl.LOCK_NB),
+            )
+            return True
+        except OSError:
+            self._file.close()
+            self._file = None
+            return False
+
+    def release(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def make_solver(name: str):
+    if name == "greedy":
+        return GreedySolver()
+    if name == "ffd":
+        return TPUSolver(mode="ffd")
+    if name == "cost":
+        return CostSolver()
+    raise ValueError(f"unknown solver {name!r}")
+
+
+class Manager:
+    """Ref: pkg/controllers/manager.go RegisterControllers + cmd wiring."""
+
+    def __init__(self, cluster: Cluster, cloud, options: Options):
+        self.cluster = cluster
+        self.cloud = cloud
+        self.options = options
+        self.log = klog.named("manager")
+        solver = make_solver(options.solver)
+        self.provisioning = ProvisioningController(cluster, cloud, solver)
+        self.selection = SelectionController(cluster, self.provisioning)
+        self.termination = TerminationController(cluster, cloud)
+        self.node = NodeController(cluster)
+        self.counter = CounterController(cluster)
+        self.metrics = MetricsController(cluster)
+        self.ready = threading.Event()
+        self._stop = threading.Event()
+
+        # Reconcile loops with the reference's concurrency envelope
+        # (selection 10k in the reference — bounded here by thread cost;
+        # the loop is keyed and collapse-deduped so fewer threads suffice).
+        self.loops = {
+            "selection": ReconcileLoop(
+                "selection", lambda key: self.selection.reconcile(*key), concurrency=8
+            ),
+            "provisioning": ReconcileLoop(
+                "provisioning", self.provisioning.reconcile, concurrency=2
+            ),
+            "termination": ReconcileLoop(
+                "termination", self.termination.reconcile, concurrency=4
+            ),
+            "node": ReconcileLoop("node", self.node.reconcile, concurrency=4),
+            "counter": ReconcileLoop(
+                "counter", lambda key: self.counter.reconcile(key), concurrency=1
+            ),
+            "metrics": ReconcileLoop(
+                "metrics", self.metrics.reconcile, concurrency=1
+            ),
+        }
+
+    # --- watch fan-out (ref: controller Register() watch wiring) ------------
+
+    def _on_event(self, kind: str, obj) -> None:
+        if kind == "pod":
+            self.loops["selection"].enqueue((obj.namespace, obj.name))
+            if obj.node_name:
+                # pod-to-node events re-list the node (ref: node/controller.go:118-150)
+                self.loops["node"].enqueue(obj.node_name)
+        elif kind == "node":
+            self.loops["node"].enqueue(obj.name)
+            self.loops["termination"].enqueue(obj.name)
+            provisioner = obj.labels.get(wellknown.PROVISIONER_NAME_LABEL)
+            if provisioner:
+                self.loops["counter"].enqueue(provisioner)
+        elif kind == "provisioner":
+            self.loops["provisioning"].enqueue(obj.name)
+            self.loops["counter"].enqueue(obj.name)
+            self.loops["metrics"].enqueue(obj.name)
+
+    # --- batch loop ---------------------------------------------------------
+
+    def _batch_loop(self) -> None:
+        while not self._stop.wait(timeout=BATCH_IDLE_SECONDS / 5):
+            for worker in list(self.provisioning.workers.values()):
+                if worker.batch_ready():
+                    try:
+                        worker.provision()
+                    except Exception:  # noqa: BLE001
+                        self.log.exception("provisioning pass failed")
+
+    def _requeue_loop(self) -> None:
+        """5-minute provisioner refresh to pick up instance-type drift
+        (ref: provisioning/controller.go:80)."""
+        while not self._stop.wait(timeout=ProvisioningController.REQUEUE_SECONDS):
+            for provisioner in self.cluster.list_provisioners():
+                self.loops["provisioning"].enqueue(provisioner.name)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.cluster.watch(self._on_event)
+        for loop in self.loops.values():
+            loop.start()
+        threading.Thread(target=self._batch_loop, daemon=True).start()
+        threading.Thread(target=self._requeue_loop, daemon=True).start()
+        # Seed existing state.
+        for provisioner in self.cluster.list_provisioners():
+            self.loops["provisioning"].enqueue(provisioner.name)
+            self.loops["metrics"].enqueue(provisioner.name)
+        for pod in self.cluster.list_pods():
+            self.loops["selection"].enqueue((pod.namespace, pod.name))
+        for node in self.cluster.list_nodes():
+            self.loops["node"].enqueue(node.name)
+        self.ready.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for loop in self.loops.values():
+            loop.stop()
+        self.ready.clear()
+
+
+class _HTTPHandler(http.server.BaseHTTPRequestHandler):
+    manager: Optional[Manager] = None
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path == "/metrics":
+            body = REGISTRY.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+        elif self.path == "/readyz":
+            ready = self.manager is not None and self.manager.ready.is_set()
+            body = b"ok" if ready else b"not ready"
+            self.send_response(200 if ready else 503)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found"
+            self.send_response(404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request logging
+        pass
+
+
+def serve_http(
+    manager: Manager, port: int, address: str = ""
+) -> http.server.ThreadingHTTPServer:
+    # Default bind is all interfaces: the scrape/probe traffic this serves
+    # arrives over the pod IP in a real deployment.
+    handler = type("Handler", (_HTTPHandler,), {"manager": manager})
+    server = http.server.ThreadingHTTPServer((address, port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -1,0 +1,85 @@
+"""Static configuration: flags + environment defaults.
+
+Ref: pkg/utils/options/options.go:27-69 and pkg/utils/env/env.go — the
+reference parses flags with env-var fallbacks, validates at boot, and injects
+the result through context. We parse argv/env into an Options dataclass that
+the runtime threads through explicitly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+class OptionsError(Exception):
+    pass
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass
+class Options:
+    cluster_name: str = ""
+    cluster_endpoint: str = ""
+    metrics_port: int = 8080  # ref: main.go:83 / chart deployment.yaml:37-41
+    health_probe_port: int = 8081  # ref: manager.go:52-57
+    kube_client_qps: float = 200.0  # ref: options.go:33
+    kube_client_burst: int = 300  # ref: options.go:34
+    solver: str = "cost"  # cost | ffd | greedy
+    cloud_provider: str = "fake"
+    leader_election: bool = True
+    log_level: str = "info"
+
+    def validate(self) -> None:
+        errors: List[str] = []
+        if not self.cluster_name:
+            errors.append("CLUSTER_NAME is required")
+        if self.metrics_port == self.health_probe_port:
+            errors.append("metrics and health ports must differ")
+        if self.solver not in ("cost", "ffd", "greedy"):
+            errors.append(f"unknown solver {self.solver!r}")
+        if errors:
+            raise OptionsError("; ".join(errors))
+
+
+def parse(argv: Optional[List[str]] = None) -> Options:
+    parser = argparse.ArgumentParser(prog="karpenter-tpu")
+    parser.add_argument("--cluster-name", default=_env("CLUSTER_NAME", ""))
+    parser.add_argument("--cluster-endpoint", default=_env("CLUSTER_ENDPOINT", ""))
+    parser.add_argument("--metrics-port", type=int, default=int(_env("METRICS_PORT", "8080")))
+    parser.add_argument(
+        "--health-probe-port", type=int, default=int(_env("HEALTH_PROBE_PORT", "8081"))
+    )
+    parser.add_argument(
+        "--kube-client-qps", type=float, default=float(_env("KUBE_CLIENT_QPS", "200"))
+    )
+    parser.add_argument(
+        "--kube-client-burst", type=int, default=int(_env("KUBE_CLIENT_BURST", "300"))
+    )
+    parser.add_argument("--solver", default=_env("KARPENTER_SOLVER", "cost"))
+    parser.add_argument("--cloud-provider", default=_env("CLOUD_PROVIDER", "fake"))
+    parser.add_argument(
+        "--no-leader-election", action="store_true",
+        default=_env("LEADER_ELECTION", "true").lower() == "false",
+    )
+    parser.add_argument("--log-level", default=_env("LOG_LEVEL", "info"))
+    args = parser.parse_args(argv)
+    options = Options(
+        cluster_name=args.cluster_name,
+        cluster_endpoint=args.cluster_endpoint,
+        metrics_port=args.metrics_port,
+        health_probe_port=args.health_probe_port,
+        kube_client_qps=args.kube_client_qps,
+        kube_client_burst=args.kube_client_burst,
+        solver=args.solver,
+        cloud_provider=args.cloud_provider,
+        leader_election=not args.no_leader_election,
+        log_level=args.log_level,
+    )
+    options.validate()
+    return options

@@ -1,0 +1,184 @@
+"""Runtime tests: the threaded manager end-to-end (real clock), HTTP
+endpoints, webhook service, serialization round-trips, options parsing."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.api.serialization import (
+    pod_from_dict,
+    pod_to_dict,
+    provisioner_from_dict,
+    provisioner_to_dict,
+)
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.utils.options import OptionsError, parse
+
+from tests import fixtures
+
+
+class TestOptions:
+    def test_parse_defaults(self):
+        options = parse(["--cluster-name", "test"])
+        assert options.cluster_name == "test"
+        assert options.kube_client_qps == 200.0
+        assert options.solver == "cost"
+
+    def test_missing_cluster_name(self):
+        with pytest.raises(OptionsError):
+            parse([])
+
+    def test_bad_solver(self):
+        with pytest.raises(OptionsError):
+            parse(["--cluster-name", "x", "--solver", "quantum"])
+
+
+class TestSerialization:
+    def test_provisioner_roundtrip(self):
+        from karpenter_tpu.api import wellknown
+        from karpenter_tpu.api.provisioner import Constraints, Limits
+        from karpenter_tpu.api.requirements import Requirement, Requirements
+        from karpenter_tpu.api.taints import Taint
+
+        provisioner = Provisioner(
+            name="default",
+            spec=ProvisionerSpec(
+                constraints=Constraints(
+                    labels={"team": "infra"},
+                    taints=[Taint(key="dedicated", value="ml")],
+                    requirements=Requirements(
+                        [Requirement.in_(wellknown.ZONE_LABEL, ["z1", "z2"])]
+                    ),
+                    provider={"subnetSelector": {"Name": "private-*"}},
+                ),
+                ttl_seconds_after_empty=30,
+                limits=Limits(resources={"cpu": "100"}),
+            ),
+        )
+        data = provisioner_to_dict(provisioner)
+        text = json.dumps(data)  # must be JSON-clean
+        restored = provisioner_from_dict(json.loads(text))
+        assert restored.name == "default"
+        assert restored.spec.constraints.labels == {"team": "infra"}
+        assert restored.spec.constraints.taints == provisioner.spec.constraints.taints
+        assert (
+            restored.spec.constraints.requirements.canonical_key()
+            == provisioner.spec.constraints.requirements.canonical_key()
+        )
+        assert restored.spec.limits.resources == {"cpu": 100.0}
+        assert restored.spec.constraints.provider == {
+            "subnetSelector": {"Name": "private-*"}
+        }
+
+    def test_pod_roundtrip(self):
+        pod = fixtures.pod(
+            labels={"app": "web"}, node_selector={"zone": "z1"}
+        )
+        restored = pod_from_dict(json.loads(json.dumps(pod_to_dict(pod))))
+        assert restored.name == pod.name
+        assert restored.uid == pod.uid
+        assert restored.requests == pod.requests
+        assert restored.node_selector == {"zone": "z1"}
+
+
+@pytest.fixture
+def manager():
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.runtime import Manager
+    from karpenter_tpu.utils.options import Options
+
+    cluster = Cluster()  # real clock: the threaded runtime needs it
+    options = Options(cluster_name="test", solver="greedy", leader_election=False)
+    mgr = Manager(cluster, FakeCloudProvider(), options)
+    mgr.start()
+    yield mgr
+    mgr.stop()
+
+
+def wait_until(predicate, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestManager:
+    def test_end_to_end_provisioning(self, manager):
+        cluster = manager.cluster
+        cluster.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        assert wait_until(lambda: manager.provisioning.worker("default") is not None)
+        pods = [
+            PodSpec(name=f"rt-{i}", requests={"cpu": "1"}, unschedulable=True)
+            for i in range(5)
+        ]
+        for pod in pods:
+            cluster.apply_pod(pod)
+        # The batch loop should fire after the 1s idle window.
+        assert wait_until(
+            lambda: all(
+                cluster.get_pod(p.namespace, p.name).node_name is not None
+                for p in pods
+            ),
+            timeout=15.0,
+        ), "pods were not provisioned by the threaded runtime"
+        assert cluster.list_nodes()
+
+    def test_http_endpoints(self, manager):
+        from karpenter_tpu.runtime import serve_http
+
+        server = serve_http(manager, 18080)
+        try:
+            health = urllib.request.urlopen("http://127.0.0.1:18080/healthz")
+            assert health.status == 200
+            ready = urllib.request.urlopen("http://127.0.0.1:18080/readyz")
+            assert ready.status == 200
+            metrics = urllib.request.urlopen("http://127.0.0.1:18080/metrics")
+            assert b"karpenter" in metrics.read()
+        finally:
+            server.shutdown()
+
+
+class TestWebhook:
+    def test_validate_and_default(self):
+        from karpenter_tpu.cmd.webhook import main as webhook_main
+
+        server = webhook_main(["--cluster-name", "test"], port=18443, block=False)
+        try:
+            provisioner = Provisioner(name="default", spec=ProvisionerSpec())
+            body = json.dumps(provisioner_to_dict(provisioner)).encode()
+
+            req = urllib.request.Request(
+                "http://127.0.0.1:18443/validate", data=body, method="POST"
+            )
+            assert json.load(urllib.request.urlopen(req))["allowed"] is True
+
+            req = urllib.request.Request(
+                "http://127.0.0.1:18443/default", data=body, method="POST"
+            )
+            defaulted = json.load(urllib.request.urlopen(req))
+            keys = {r["key"] for r in defaulted["spec"]["requirements"]}
+            assert "karpenter.sh/capacity-type" in keys  # fake provider hook ran
+
+            bad = provisioner_to_dict(
+                Provisioner(name="x" * 80, spec=ProvisionerSpec())
+            )
+            req = urllib.request.Request(
+                "http://127.0.0.1:18443/validate",
+                data=json.dumps(bad).encode(),
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req)
+            assert err.value.code == 422
+        finally:
+            server.shutdown()
+            from karpenter_tpu.api import validation
+
+            validation.DEFAULT_HOOK = None
+            validation.VALIDATE_HOOK = None
