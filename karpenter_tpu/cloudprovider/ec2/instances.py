@@ -1,0 +1,267 @@
+"""Instance launch/terminate through the fleet API.
+
+Ref: pkg/cloudprovider/aws/instance.go — capacity-type choice (spot iff
+allowed and offered), launch-template config assembly, the
+(instance type × zone × subnet) override cross-product with spot priority,
+instant-fleet launch with partial-fulfillment tolerance, recording
+insufficient-capacity pools into the blackout cache, eventually-consistent
+describe with retry, and instance → node conversion.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider import (
+    CloudProviderError,
+    InstanceType,
+    NodeSpec,
+)
+from karpenter_tpu.cloudprovider.ec2.api import (
+    INSUFFICIENT_CAPACITY_ERROR_CODE,
+    Ec2Api,
+    FleetOverride,
+    FleetRequest,
+    FleetResult,
+    Instance,
+    is_not_found,
+)
+from karpenter_tpu.cloudprovider.ec2.instancetypes import InstanceTypeProvider
+from karpenter_tpu.cloudprovider.ec2.launchtemplates import LaunchTemplateProvider
+from karpenter_tpu.cloudprovider.ec2.network import SubnetProvider
+from karpenter_tpu.cloudprovider.ec2.vendor import Ec2Provider, merge_tags
+from karpenter_tpu.utils.clock import Clock
+
+DESCRIBE_RETRY_ATTEMPTS = 3  # ref: instance.go:57-61
+DESCRIBE_RETRY_DELAY = 1.0
+
+PROVIDER_ID_FORMAT = "aws:///{zone}/{instance_id}"
+
+
+class FleetLaunchError(CloudProviderError):
+    """CreateFleet produced zero instances (ref: instance.go
+    combineFleetErrors:302-311)."""
+
+    def __init__(self, errors):
+        unique = sorted({f"{e.code}: {e.message}" for e in errors})
+        super().__init__(
+            "with fleet error(s), " + ("; ".join(unique) or "no usable capacity pools")
+        )
+        self.fleet_errors = list(errors)
+
+
+class InstanceProvider:
+    """Ref: aws/instance.go InstanceProvider:38-146."""
+
+    def __init__(
+        self,
+        api: Ec2Api,
+        instance_type_provider: InstanceTypeProvider,
+        subnet_provider: SubnetProvider,
+        launch_template_provider: LaunchTemplateProvider,
+        cluster_name: str,
+        clock: Optional[Clock] = None,
+    ):
+        self.api = api
+        self.instance_type_provider = instance_type_provider
+        self.subnet_provider = subnet_provider
+        self.launch_template_provider = launch_template_provider
+        self.cluster_name = cluster_name
+        self.clock = clock or Clock()
+
+    def create(
+        self,
+        constraints: Constraints,
+        provider: Ec2Provider,
+        instance_types: Sequence[InstanceType],
+        quantity: int,
+    ) -> List[NodeSpec]:
+        """Launch up to `quantity` nodes; partial fulfillment returns fewer
+        (ref: instance.go Create:49-89). instance_types should be sorted
+        smallest-first — spot priority derives from that order."""
+        instance_ids = self._launch(constraints, provider, instance_types, quantity)
+        instances = self._describe_with_retry(instance_ids)
+        by_name = {t.name: t for t in instance_types}
+        nodes, strays = [], []
+        for instance in instances:
+            instance_type = by_name.get(instance.instance_type)
+            if instance_type is None:
+                # Fleet launched a type we didn't offer: terminate it rather
+                # than leak a running, untracked instance.
+                strays.append(instance.instance_id)
+                continue
+            nodes.append(self._to_node(instance, instance_type))
+        if strays:
+            self.api.terminate_instances(strays)
+        if not nodes:
+            raise CloudProviderError("zero nodes were created")
+        return nodes
+
+    def terminate(self, node: NodeSpec) -> None:
+        """Ref: instance.go Terminate:91-105 — not-found is success."""
+        instance_id = parse_instance_id(node.provider_id)
+        try:
+            self.api.terminate_instances([instance_id])
+        except Exception as error:  # noqa: BLE001 — coded errors only
+            if is_not_found(error):
+                return
+            raise
+
+    # --- launch ------------------------------------------------------------
+
+    def _launch(
+        self,
+        constraints: Constraints,
+        provider: Ec2Provider,
+        instance_types: Sequence[InstanceType],
+        quantity: int,
+    ) -> List[str]:
+        """Ref: instance.go launchInstances:107-146."""
+        capacity_type = self.pick_capacity_type(constraints, instance_types)
+        templates = self.launch_template_provider.get(
+            constraints,
+            provider,
+            instance_types,
+            {wellknown.CAPACITY_TYPE_LABEL: capacity_type},
+        )
+        subnets = self.subnet_provider.get(provider)
+        allowed_zones = constraints.effective_requirements().zones()
+        result = FleetResult()
+        for template_name, template_types in templates.items():
+            overrides = self.build_overrides(
+                template_types, subnets, allowed_zones, capacity_type
+            )
+            if not overrides:
+                continue
+            fleet = self.api.create_fleet(
+                FleetRequest(
+                    launch_template_name=template_name,
+                    overrides=overrides,
+                    capacity_type=capacity_type,
+                    quantity=quantity - len(result.instance_ids),
+                    tags=merge_tags(self.cluster_name, "", dict(provider.tags)),
+                )
+            )
+            self._record_unavailable(fleet, capacity_type)
+            result.instance_ids.extend(fleet.instance_ids)
+            result.errors.extend(fleet.errors)
+            if len(result.instance_ids) >= quantity:
+                break
+        if not result.instance_ids:
+            raise FleetLaunchError(result.errors)
+        return result.instance_ids
+
+    def pick_capacity_type(
+        self, constraints: Constraints, instance_types: Sequence[InstanceType]
+    ) -> str:
+        """Spot iff the constraints allow spot AND some offering has it in an
+        allowed zone; otherwise on-demand (ref: instance.go
+        getCapacityType:281-292)."""
+        requirements = constraints.effective_requirements()
+        allowed = requirements.capacity_types()
+        if allowed is not None and wellknown.CAPACITY_TYPE_SPOT not in allowed:
+            return wellknown.CAPACITY_TYPE_ON_DEMAND
+        if allowed is None:
+            # Unconstrained capacity type defaults to on-demand (the vendor
+            # defaulting hook normally pins this; this is the backstop).
+            return wellknown.CAPACITY_TYPE_ON_DEMAND
+        zones = requirements.zones()
+        for instance_type in instance_types:
+            for offering in instance_type.offerings:
+                if offering.capacity_type != wellknown.CAPACITY_TYPE_SPOT:
+                    continue
+                if zones is None or offering.zone in zones:
+                    return wellknown.CAPACITY_TYPE_SPOT
+        return wellknown.CAPACITY_TYPE_ON_DEMAND
+
+    def build_overrides(
+        self,
+        instance_types: Sequence[InstanceType],
+        subnets,
+        allowed_zones,
+        capacity_type: str,
+    ) -> List[FleetOverride]:
+        """Cross product of instance types × offerings × subnets, one subnet
+        per zone, spot priority = smallest-first index (ref: instance.go
+        getOverrides:173-207)."""
+        subnet_by_zone: Dict[str, str] = {}
+        for subnet in subnets:
+            subnet_by_zone.setdefault(subnet.zone, subnet.subnet_id)
+        overrides = []
+        for index, instance_type in enumerate(instance_types):
+            for offering in instance_type.offerings:
+                if offering.capacity_type != capacity_type:
+                    continue
+                if allowed_zones is not None and offering.zone not in allowed_zones:
+                    continue
+                subnet_id = subnet_by_zone.get(offering.zone)
+                if subnet_id is None:
+                    continue
+                overrides.append(
+                    FleetOverride(
+                        instance_type=instance_type.name,
+                        subnet_id=subnet_id,
+                        zone=offering.zone,
+                        priority=float(index)
+                        if capacity_type == wellknown.CAPACITY_TYPE_SPOT
+                        else None,
+                    )
+                )
+        return overrides
+
+    def _record_unavailable(self, fleet: FleetResult, capacity_type: str) -> None:
+        """Feed ICE pools into the blackout cache (ref: instance.go
+        updateUnavailableOfferingsCache:270-276)."""
+        for error in fleet.errors:
+            if error.code == INSUFFICIENT_CAPACITY_ERROR_CODE:
+                self.instance_type_provider.cache_unavailable(
+                    error.instance_type, error.zone, capacity_type
+                )
+
+    # --- describe / convert ------------------------------------------------
+
+    def _describe_with_retry(self, instance_ids: List[str]) -> List[Instance]:
+        """EC2 is eventually consistent (ref: instance.go:55-65)."""
+        last_error: Optional[Exception] = None
+        for attempt in range(DESCRIBE_RETRY_ATTEMPTS):
+            try:
+                return self.api.describe_instances(instance_ids)
+            except Exception as error:  # noqa: BLE001 — coded errors only
+                last_error = error
+                if attempt < DESCRIBE_RETRY_ATTEMPTS - 1:
+                    self.clock.sleep(DESCRIBE_RETRY_DELAY)
+        raise CloudProviderError(f"describing instances: {last_error}")
+
+    def _to_node(self, instance: Instance, instance_type: InstanceType) -> NodeSpec:
+        """Ref: instance.go instanceToNode:232-268."""
+        capacity_type = (
+            wellknown.CAPACITY_TYPE_SPOT
+            if instance.spot
+            else wellknown.CAPACITY_TYPE_ON_DEMAND
+        )
+        return NodeSpec(
+            name=instance.private_dns_name or instance.instance_id,
+            labels={
+                wellknown.ZONE_LABEL: instance.zone,
+                wellknown.INSTANCE_TYPE_LABEL: instance.instance_type,
+                wellknown.CAPACITY_TYPE_LABEL: capacity_type,
+            },
+            capacity=dict(instance_type.capacity),
+            instance_type=instance.instance_type,
+            zone=instance.zone,
+            capacity_type=capacity_type,
+            provider_id=PROVIDER_ID_FORMAT.format(
+                zone=instance.zone, instance_id=instance.instance_id
+            ),
+            created_at=self.clock.now(),
+        )
+
+
+def parse_instance_id(provider_id: str) -> str:
+    """Ref: instance.go getInstanceID:294-300."""
+    parts = provider_id.split("/")
+    if len(parts) < 5:
+        raise CloudProviderError(f"parsing instance id from {provider_id!r}")
+    return parts[4]

@@ -42,5 +42,12 @@ def _register_builtins() -> None:
 
     register_factory("fake", FakeCloudProvider)
 
+    def _ec2_factory():
+        from karpenter_tpu.cloudprovider.ec2 import Ec2CloudProvider
+
+        return Ec2CloudProvider()
+
+    register_factory("ec2", _ec2_factory)
+
 
 _register_builtins()

@@ -30,10 +30,13 @@ class Harness:
         instance_types=None,
         solver: Optional[Solver] = None,
         clock: Optional[FakeClock] = None,
+        cloud=None,
     ):
         self.clock = clock or FakeClock()
         self.cluster = Cluster(clock=self.clock)
-        self.cloud = FakeCloudProvider(instance_types=instance_types, clock=self.clock)
+        self.cloud = cloud or FakeCloudProvider(
+            instance_types=instance_types, clock=self.clock
+        )
         self.provisioning = ProvisioningController(self.cluster, self.cloud, solver)
         self.selection = SelectionController(self.cluster, self.provisioning)
         self.termination = TerminationController(self.cluster, self.cloud)

@@ -1,0 +1,428 @@
+"""EC2 provider-stack suite (ref: aws/suite_test.go:104-465 against fake
+EC2): vendor defaulting/validation, subnet/SG discovery, launch-template
+reuse-by-hash, specialized-hardware AMI routing, spot/OD capacity choice,
+override cross-products, ICE blackout fallback, terminate semantics."""
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Constraints, Provisioner, ProvisionerSpec
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.cloudprovider import CloudProviderError
+from karpenter_tpu.cloudprovider.ec2 import Ec2CloudProvider
+from karpenter_tpu.cloudprovider.ec2.api import ApiError, is_not_found
+from karpenter_tpu.cloudprovider.ec2.fake import FakeEc2
+from karpenter_tpu.cloudprovider.ec2.instancetypes import (
+    ICE_BLACKOUT_TTL,
+    VM_AVAILABLE_MEMORY_FACTOR,
+    adapt_instance_type,
+    kube_reserved_cpu_millis,
+    pods_per_node,
+)
+from karpenter_tpu.cloudprovider.ec2.vendor import (
+    Ec2Provider,
+    VendorValidationError,
+    default_provider_blob,
+    merge_tags,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def make_provider(clock=None):
+    clock = clock or FakeClock()
+    api = FakeEc2()
+    return Ec2CloudProvider(api=api, clock=clock), api, clock
+
+
+def constraints_with_blob(**requirement_kwargs) -> Constraints:
+    provisioner = Provisioner(name="default", spec=ProvisionerSpec())
+    if requirement_kwargs:
+        provisioner.spec.constraints.requirements = Requirements(
+            [Requirement.in_(k, v) for k, v in requirement_kwargs.items()]
+        )
+    provisioner.spec.constraints.provider = {"instanceProfile": "test-profile"}
+    default_provider_blob(provisioner, "test-cluster")
+    return provisioner.spec.constraints
+
+
+class TestVendorExtension:
+    def test_defaulting_installs_selectors_arch_and_capacity_type(self):
+        provisioner = Provisioner(name="default", spec=ProvisionerSpec())
+        provisioner.spec.constraints.provider = {"instanceProfile": "p"}
+        default_provider_blob(provisioner, "my-cluster")
+        blob = provisioner.spec.constraints.provider
+        assert blob["subnetSelector"] == {"kubernetes.io/cluster/my-cluster": "*"}
+        assert blob["securityGroupSelector"] == {
+            "kubernetes.io/cluster/my-cluster": "*"
+        }
+        requirements = provisioner.spec.constraints.requirements
+        assert requirements.allowed(wellknown.ARCH_LABEL).finite_values() == {"amd64"}
+        assert requirements.allowed(
+            wellknown.CAPACITY_TYPE_LABEL
+        ).finite_values() == {"on-demand"}
+
+    def test_defaulting_respects_existing_requirements(self):
+        provisioner = Provisioner(name="default", spec=ProvisionerSpec())
+        provisioner.spec.constraints.requirements = Requirements(
+            [Requirement.in_(wellknown.CAPACITY_TYPE_LABEL, ["spot"])]
+        )
+        default_provider_blob(provisioner, "c")
+        allowed = provisioner.spec.constraints.requirements.allowed(
+            wellknown.CAPACITY_TYPE_LABEL
+        )
+        assert allowed.finite_values() == {"spot"}
+
+    def test_validation_requires_instance_profile(self):
+        with pytest.raises(VendorValidationError, match="instanceProfile"):
+            Ec2Provider(
+                subnet_selector={"a": "b"}, security_group_selector={"a": "b"}
+            ).validate()
+
+    def test_validation_rejects_empty_selector_values(self):
+        with pytest.raises(VendorValidationError, match="subnetSelector"):
+            Ec2Provider(
+                instance_profile="p",
+                subnet_selector={"a": ""},
+                security_group_selector={"a": "b"},
+            ).validate()
+
+    def test_deserialize_rejects_unknown_fields(self):
+        constraints = Constraints(provider={"instanceProfile": "p", "bogus": 1})
+        with pytest.raises(VendorValidationError, match="bogus"):
+            Ec2Provider.deserialize(constraints)
+
+    def test_deserialize_requires_blob(self):
+        with pytest.raises(VendorValidationError, match="defaulting hook"):
+            Ec2Provider.deserialize(Constraints())
+
+    def test_merge_tags_user_tags_win(self):
+        tags = merge_tags("c", "p", {"Name": "custom"})
+        assert tags["Name"] == "custom"
+        assert tags["kubernetes.io/cluster/c"] == "owned"
+        assert tags["karpenter.tpu/cluster/c"] == "owned"
+
+
+class TestInstanceTypeAdaptation:
+    def test_eni_pod_formula_and_memory_factor(self):
+        provider, api, _ = make_provider()
+        types = {t.name: t for t in provider.get_instance_types()}
+        m5_xlarge = types["m5.xlarge"]
+        # ENI formula: 4 * (15 - 1) + 2 = 58 (ref: instancetype.go:72-77).
+        assert m5_xlarge.get("pods") == 58
+        # 16GiB * 0.925, in bytes.
+        expected_mib = int(16 * 1024 * VM_AVAILABLE_MEMORY_FACTOR)
+        assert m5_xlarge.get("memory") == expected_mib * 1024 * 1024
+
+    def test_overhead_model(self):
+        # 2 vCPU: 100m system + 60m (6% of core 1) + 10m (1% of core 2) = 170m.
+        assert kube_reserved_cpu_millis(2) == 170
+        # 32 vCPU: 100 + 60 + 10 + 10 + 70 = 250m.
+        assert kube_reserved_cpu_millis(32) == 250
+
+    def test_opinionated_filter_drops_metal_fpga_and_unknown_families(self):
+        provider, _, _ = make_provider()
+        names = {t.name for t in provider.get_instance_types()}
+        assert "m5.metal" not in names  # bare metal
+        assert "f1.2xlarge" not in names  # FPGA
+        assert "d3.xlarge" not in names  # unsupported family prefix
+        assert {"m5.large", "c5.large", "t3.medium", "p3.8xlarge"} <= names
+
+    def test_gpu_and_arm_catalog_rows(self):
+        provider, _, _ = make_provider()
+        types = {t.name: t for t in provider.get_instance_types()}
+        assert types["p3.8xlarge"].get(wellknown.RESOURCE_NVIDIA_GPU) == 4
+        assert types["inf1.6xlarge"].get(wellknown.RESOURCE_AWS_NEURON) == 4
+        assert types["m6g.large"].architecture == "arm64"
+        assert types["m5.4xlarge"].get(wellknown.RESOURCE_AWS_POD_ENI) == 54
+
+    def test_offerings_carry_prices_and_both_capacity_types(self):
+        provider, _, _ = make_provider()
+        types = {t.name: t for t in provider.get_instance_types()}
+        offerings = types["m5.large"].offerings
+        spot = [o for o in offerings if o.capacity_type == "spot"]
+        on_demand = [o for o in offerings if o.capacity_type == "on-demand"]
+        assert spot and on_demand
+        assert all(o.price < od.price for o in spot for od in on_demand)
+
+
+class TestDiscovery:
+    def test_subnet_selector_wildcard_matches_tag_key(self):
+        provider, api, _ = make_provider()
+        subnets = provider.subnets.get(
+            Ec2Provider(
+                instance_profile="p",
+                subnet_selector={"kubernetes.io/cluster/test-cluster": "*"},
+            )
+        )
+        assert len(subnets) == len(api.zones)
+
+    def test_subnet_selector_exact_value(self):
+        provider, api, _ = make_provider()
+        subnets = provider.subnets.get(
+            Ec2Provider(
+                instance_profile="p",
+                subnet_selector={"Name": "private-test-zone-1a"},
+            )
+        )
+        assert [s.zone for s in subnets] == ["test-zone-1a"]
+
+    def test_at_most_one_cluster_tagged_security_group(self):
+        # sg-test1 and sg-test2 both carry the cluster tag; only the first
+        # survives (ref: securitygroups.go:44-66).
+        provider, _, _ = make_provider()
+        groups = provider.security_groups.get(
+            Ec2Provider(
+                instance_profile="p",
+                security_group_selector={
+                    "kubernetes.io/cluster/test-cluster": "*"
+                },
+            )
+        )
+        assert groups == ["sg-test1"]
+
+    def test_instance_types_cached_for_five_minutes(self):
+        provider, api, clock = make_provider()
+        provider.get_instance_types()
+        api.instance_type_infos.clear()
+        assert provider.get_instance_types()  # cache still serves
+        clock.advance(6 * 60)
+        assert provider.get_instance_types() == []
+
+
+class TestLaunchTemplates:
+    def test_reused_by_hash_for_identical_constraints(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = provider.get_instance_types(constraints)
+        small = [t for t in types if t.name == "m5.large"]
+        for _ in range(2):
+            provider.create(constraints, small, 1, lambda node: None)
+        assert len(api.calls["create_launch_template"]) == 1
+
+    def test_different_taints_produce_different_templates(self):
+        provider, api, _ = make_provider()
+        c1 = constraints_with_blob()
+        types = [t for t in provider.get_instance_types(c1) if t.name == "m5.large"]
+        provider.create(c1, types, 1, lambda node: None)
+        c2 = constraints_with_blob()
+        c2.taints.append(Taint(key="dedicated", value="gpu", effect="NoSchedule"))
+        provider.create(c2, types, 1, lambda node: None)
+        assert len(api.calls["create_launch_template"]) == 2
+
+    def test_gpu_types_get_accelerator_image(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = {t.name: t for t in provider.get_instance_types(constraints)}
+        by_ami = provider.amis.get([types["p3.8xlarge"], types["m5.large"]])
+        assert len(by_ami) == 2  # gpu image and plain image differ
+
+    def test_user_specified_template_bypasses_generation(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        constraints.provider["launchTemplate"] = "my-custom-template"
+        api.launch_templates["my-custom-template"] = (
+            api.create_launch_template(
+                __import__(
+                    "karpenter_tpu.cloudprovider.ec2.api", fromlist=["LaunchTemplate"]
+                ).LaunchTemplate(name="my-custom-template")
+            )
+        )
+        types = [
+            t for t in provider.get_instance_types(constraints) if t.name == "m5.large"
+        ]
+        provider.create(constraints, types, 1, lambda node: None)
+        assert len(api.calls["create_launch_template"]) == 1  # only our manual one
+        assert (
+            api.calls["create_fleet"][-1].launch_template_name
+            == "my-custom-template"
+        )
+
+
+class TestFleetLaunch:
+    def test_on_demand_picks_single_cheapest_pool(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = sorted(
+            provider.get_instance_types(constraints), key=lambda t: t.get("cpu")
+        )
+        nodes = []
+        provider.create(constraints, types[:3], 2, nodes.append)
+        assert len(nodes) == 2
+        assert all(n.capacity_type == "on-demand" for n in nodes)
+        request = api.calls["create_fleet"][-1]
+        assert request.capacity_type == "on-demand"
+        assert all(o.priority is None for o in request.overrides)
+
+    def test_spot_chosen_when_allowed_with_priorities(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob(
+            **{wellknown.CAPACITY_TYPE_LABEL: ["spot", "on-demand"]}
+        )
+        types = sorted(
+            provider.get_instance_types(constraints), key=lambda t: t.get("cpu")
+        )
+        nodes = []
+        provider.create(constraints, types[:3], 1, nodes.append)
+        assert nodes[0].capacity_type == "spot"
+        request = api.calls["create_fleet"][-1]
+        # Spot priorities follow the smallest-first ordering of the input.
+        assert [o.priority for o in request.overrides] == sorted(
+            o.priority for o in request.overrides
+        )
+
+    def test_zone_constraint_restricts_overrides(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob(
+            **{wellknown.ZONE_LABEL: ["test-zone-1b"]}
+        )
+        types = [
+            t for t in provider.get_instance_types(constraints) if t.name == "m5.large"
+        ]
+        nodes = []
+        provider.create(constraints, types, 1, nodes.append)
+        assert nodes[0].zone == "test-zone-1b"
+        assert all(
+            o.zone == "test-zone-1b" for o in api.calls["create_fleet"][-1].overrides
+        )
+
+    def test_node_carries_labels_capacity_and_provider_id(self):
+        provider, _, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = [
+            t for t in provider.get_instance_types(constraints) if t.name == "m5.xlarge"
+        ]
+        nodes = []
+        provider.create(constraints, types, 1, nodes.append)
+        node = nodes[0]
+        assert node.labels[wellknown.INSTANCE_TYPE_LABEL] == "m5.xlarge"
+        assert node.labels[wellknown.ZONE_LABEL] == node.zone
+        assert node.provider_id.startswith("aws:///")
+        assert node.capacity["cpu"] == 4
+
+
+class TestInsufficientCapacity:
+    def test_ice_pool_blacked_out_and_second_attempt_uses_other_pool(self):
+        """The reference's headline ICE test (aws/suite_test.go): first fleet
+        call hits InsufficientInstanceCapacity, the offering is blacked out,
+        and the retry lands on a different type/zone."""
+        provider, api, clock = make_provider()
+        constraints = constraints_with_blob()
+        types = sorted(
+            provider.get_instance_types(constraints), key=lambda t: t.get("cpu")
+        )
+        target = types[0]
+        # Every on-demand pool of the cheapest type is capacity-starved.
+        for offering in target.offerings:
+            if offering.capacity_type == "on-demand":
+                api.insufficient_capacity_pools.add(
+                    (target.name, offering.zone, "on-demand")
+                )
+        nodes = []
+        provider.create(constraints, types[:2], 1, nodes.append)
+        # Fleet fell through to the second type in the same call.
+        assert nodes and nodes[0].instance_type == types[1].name
+        # And the pools are now blacked out of the catalog.
+        refreshed = {
+            t.name: t for t in provider.get_instance_types(constraints)
+        }
+        assert all(
+            o.capacity_type != "on-demand"
+            for o in refreshed[target.name].offerings
+        ) or target.name not in refreshed
+
+    def test_blackout_expires_after_ttl(self):
+        provider, api, clock = make_provider()
+        provider.instance_types.cache_unavailable("m5.large", "test-zone-1a", "on-demand")
+        assert provider.instance_types.is_unavailable(
+            "m5.large", "test-zone-1a", "on-demand"
+        )
+        clock.advance(ICE_BLACKOUT_TTL + 1)
+        assert not provider.instance_types.is_unavailable(
+            "m5.large", "test-zone-1a", "on-demand"
+        )
+
+    def test_all_pools_starved_reports_errors(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = [
+            t for t in provider.get_instance_types(constraints) if t.name == "m5.large"
+        ]
+        for offering in types[0].offerings:
+            api.insufficient_capacity_pools.add(
+                ("m5.large", offering.zone, offering.capacity_type)
+            )
+        errors = provider.create(constraints, types, 1, lambda node: None)
+        assert errors and "InsufficientInstanceCapacity" in str(errors[0])
+
+
+class TestTerminate:
+    def test_terminate_by_provider_id(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = [
+            t for t in provider.get_instance_types(constraints) if t.name == "m5.large"
+        ]
+        nodes = []
+        provider.create(constraints, types, 1, nodes.append)
+        provider.delete(nodes[0])
+        assert api.calls["terminate_instances"]
+        assert not api.instances
+
+    def test_terminate_missing_instance_is_success(self):
+        provider, _, _ = make_provider()
+        node_like = type(
+            "N", (), {"provider_id": "aws:///test-zone-1a/i-doesnotexist", "name": "n"}
+        )()
+        provider.delete(node_like)  # must not raise
+
+    def test_not_found_classifier(self):
+        assert is_not_found(ApiError("InvalidInstanceID.NotFound"))
+        assert not is_not_found(ApiError("Throttled"))
+        assert not is_not_found(ValueError("x"))
+
+
+class TestRegistryIntegration:
+    def test_ec2_provider_registered_and_installs_hooks(self):
+        from karpenter_tpu.api import validation
+        from karpenter_tpu.cloudprovider import registry
+
+        provider = registry.new_cloud_provider("ec2")
+        try:
+            provisioner = Provisioner(name="default", spec=ProvisionerSpec())
+            provisioner.spec.constraints.provider = {"instanceProfile": "p"}
+            validation.default_provisioner(provisioner)
+            assert "subnetSelector" in provisioner.spec.constraints.provider
+            validation.validate_provisioner(provisioner)
+        finally:
+            registry.new_cloud_provider("fake")
+
+
+class TestEndToEnd:
+    def test_pods_provisioned_onto_ec2_backed_nodes(self):
+        """Full control-plane slice over the EC2 stack: unschedulable pods →
+        selection → batch → solver → fleet launch → bind."""
+        from tests import fixtures
+        from tests.harness import Harness
+        from karpenter_tpu.api import validation
+        from karpenter_tpu.cloudprovider import registry
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        provider = Ec2CloudProvider(api=FakeEc2(), clock=clock)
+        validation.DEFAULT_HOOK = provider.default
+        validation.VALIDATE_HOOK = provider.validate
+        try:
+            h = Harness(clock=clock, cloud=provider)
+            provisioner = Provisioner(name="default", spec=ProvisionerSpec())
+            provisioner.spec.constraints.provider = {"instanceProfile": "test"}
+            h.apply_provisioner(provisioner)
+            pods = [fixtures.pod(name=f"p-{i}") for i in range(5)]
+            live = h.provision(*pods)
+            for pod in live:
+                node = h.expect_scheduled(pod)
+                assert node.provider_id.startswith("aws:///")
+                assert node.labels[wellknown.INSTANCE_TYPE_LABEL]
+        finally:
+            validation.DEFAULT_HOOK = None
+            validation.VALIDATE_HOOK = None
