@@ -25,10 +25,11 @@ from karpenter_tpu.ops.score_kernel import lp_relax_solve, round_assignment
 
 
 class Solver(abc.ABC):
-    """solve(pods, ...) -> PackResult. Pods must already share one schedule's
-    constraints (the scheduler groups them; ref: scheduling/scheduler.go:67)."""
+    """The solver boundary. Pods must already share one schedule's
+    constraints (the scheduler groups them; ref: scheduling/scheduler.go:67).
+    `solve` densifies specs then delegates to `solve_encoded`, the
+    tensor-level entry point the benchmark and sidecar call directly."""
 
-    @abc.abstractmethod
     def solve(
         self,
         pods: Sequence[PodSpec],
@@ -36,14 +37,47 @@ class Solver(abc.ABC):
         constraints: Constraints,
         daemons: Sequence[PodSpec] = (),
     ) -> ffd.PackResult:
+        groups = group_pods(list(pods))
+        fleet = build_fleet(instance_types, constraints, pods, daemons)
+        return self.solve_encoded(groups, fleet)
+
+    @abc.abstractmethod
+    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         ...
 
 
 class GreedySolver(Solver):
-    """Host-side grouped FFD — reference-faithful fallback."""
+    """Host-side grouped FFD in pure Python — reference-faithful oracle."""
 
-    def solve(self, pods, instance_types, constraints, daemons=()):
-        return ffd.pack(pods, instance_types, constraints, daemons)
+    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
+        return ffd.pack_groups(fleet, groups)
+
+
+class NativeSolver(Solver):
+    """Compiled host FFD (native/ffd.cc via ctypes): same rounds as
+    GreedySolver, at compiled-code speed — the fallback when no accelerator
+    is attached, mirroring the role of the reference's compiled Go packer.
+    Degrades to the pure-Python path when the library can't be built."""
+
+    def __init__(self, quirk: bool = True):
+        self.quirk = quirk
+
+    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
+        from karpenter_tpu.ops import native
+
+        if fleet.num_types == 0 or groups.num_groups == 0:
+            return ffd.pack_groups(fleet, groups)
+        result = native.ffd_pack_rounds(
+            groups.vectors,
+            groups.counts.astype(np.int64),
+            fleet.capacity,
+            fleet.total,
+            quirk=self.quirk,
+        )
+        if result is None:
+            return ffd.pack_groups(fleet, groups)
+        round_list, unschedulable_counts = result
+        return _decode_rounds(round_list, unschedulable_counts, groups, fleet)
 
 
 def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool):
@@ -128,11 +162,6 @@ class TPUSolver(Solver):
         self.mode = mode
         self.quirk = quirk
 
-    def solve(self, pods, instance_types, constraints, daemons=()):
-        groups = group_pods(list(pods))
-        fleet = build_fleet(instance_types, constraints, pods, daemons)
-        return self.solve_encoded(groups, fleet)
-
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         if fleet.num_types == 0 or groups.num_groups == 0:
             return ffd.pack_groups(fleet, groups)
@@ -157,11 +186,6 @@ class CostSolver(Solver):
 
     def __init__(self, lp_steps: int = 300):
         self.lp_steps = lp_steps
-
-    def solve(self, pods, instance_types, constraints, daemons=()):
-        groups = group_pods(list(pods))
-        fleet = build_fleet(instance_types, constraints, pods, daemons)
-        return self.solve_encoded(groups, fleet)
 
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         if fleet.num_types == 0 or groups.num_groups == 0:

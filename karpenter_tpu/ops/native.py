@@ -1,0 +1,131 @@
+"""ctypes binding for the native host kernels (native/ffd.cc).
+
+The reference's hot loop is compiled Go (binpacking/packer.go); ours is
+C++ behind this binding, playing the same role: the fast host-side packer
+used when no accelerator is attached, and the honest "compiled host
+baseline" in benchmarks (a Python baseline would flatter the TPU numbers).
+
+The shared library is built on demand with `make -C native` (g++ -O3). If no
+toolchain is available the binding reports unavailable and callers fall back
+to the pure-Python FFD — the framework never hard-requires native code.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libktpu_ffd.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        result = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            capture_output=True,
+            timeout=120,
+        )
+        return result.returncode == 0 and _LIB_PATH.exists()
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not _LIB_PATH.exists() and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError:
+            _load_failed = True
+            return None
+        lib.ktpu_ffd_pack.restype = ctypes.c_int
+        lib.ktpu_ffd_pack.argtypes = [
+            ctypes.POINTER(ctypes.c_float),  # vectors
+            ctypes.POINTER(ctypes.c_int64),  # counts
+            ctypes.c_int,  # num_groups
+            ctypes.c_int,  # dims
+            ctypes.POINTER(ctypes.c_float),  # capacity
+            ctypes.POINTER(ctypes.c_float),  # total
+            ctypes.c_int,  # num_types
+            ctypes.c_int,  # quirk
+            ctypes.POINTER(ctypes.c_int),  # round_type
+            ctypes.POINTER(ctypes.c_int64),  # round_fill
+            ctypes.POINTER(ctypes.c_int64),  # round_repl
+            ctypes.POINTER(ctypes.c_int64),  # unschedulable
+            ctypes.c_int,  # max_rounds
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def ffd_pack_rounds(
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+    quirk: bool = True,
+) -> Optional[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]:
+    """Run the native FFD. Returns (rounds, unschedulable_counts) with rounds
+    as (type index, fill per group, replication) — the same decode format the
+    TPU kernel emits — or None when the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    capacity = np.ascontiguousarray(capacity, dtype=np.float32)
+    total = np.ascontiguousarray(total, dtype=np.float32)
+    num_groups, dims = vectors.shape
+    num_types = capacity.shape[0]
+    max_rounds = int(counts.sum()) + 1
+    round_type = np.zeros(max_rounds, dtype=np.int32)
+    round_fill = np.zeros((max_rounds, max(num_groups, 1)), dtype=np.int64)
+    round_repl = np.zeros(max_rounds, dtype=np.int64)
+    unschedulable = np.zeros(max(num_groups, 1), dtype=np.int64)
+
+    def ptr(array, ctype):
+        return array.ctypes.data_as(ctypes.POINTER(ctype))
+
+    rounds = lib.ktpu_ffd_pack(
+        ptr(vectors, ctypes.c_float),
+        ptr(counts, ctypes.c_int64),
+        num_groups,
+        dims,
+        ptr(capacity, ctypes.c_float),
+        ptr(total, ctypes.c_float),
+        num_types,
+        1 if quirk else 0,
+        ptr(round_type, ctypes.c_int),
+        ptr(round_fill, ctypes.c_int64),
+        ptr(round_repl, ctypes.c_int64),
+        ptr(unschedulable, ctypes.c_int64),
+        max_rounds,
+    )
+    if rounds < 0:
+        return None
+    round_list = [
+        (int(round_type[r]), round_fill[r, :num_groups], int(round_repl[r]))
+        for r in range(rounds)
+    ]
+    return round_list, unschedulable[:num_groups]

@@ -25,7 +25,12 @@ from karpenter_tpu.controllers.provisioning import (
 )
 from karpenter_tpu.controllers.selection import SelectionController
 from karpenter_tpu.controllers.termination import TerminationController
-from karpenter_tpu.models.solver import CostSolver, GreedySolver, TPUSolver
+from karpenter_tpu.models.solver import (
+    CostSolver,
+    GreedySolver,
+    NativeSolver,
+    TPUSolver,
+)
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.options import Options
@@ -130,6 +135,18 @@ class LeaderLock:
 def make_solver(name: str):
     if name == "greedy":
         return GreedySolver()
+    if name == "native":
+        # Front-load the build (make -C native) here, at startup, rather than
+        # inside the first reconcile; degrade loudly if no toolchain.
+        from karpenter_tpu.ops import native
+
+        if not native.available():
+            klog.named("runtime").warning(
+                "solver=native requested but the native library is "
+                "unavailable (no C++ toolchain?); falling back to greedy"
+            )
+            return GreedySolver()
+        return NativeSolver()
     if name == "ffd":
         return TPUSolver(mode="ffd")
     if name == "cost":

@@ -41,7 +41,7 @@ class Options:
             errors.append("CLUSTER_NAME is required")
         if self.metrics_port == self.health_probe_port:
             errors.append("metrics and health ports must differ")
-        if self.solver not in ("cost", "ffd", "greedy"):
+        if self.solver not in ("cost", "ffd", "greedy", "native"):
             errors.append(f"unknown solver {self.solver!r}")
         if errors:
             raise OptionsError("; ".join(errors))

@@ -1,0 +1,175 @@
+// Grouped First-Fit-Decreasing bin-packer — native host kernel.
+//
+// Ref: pkg/controllers/provisioning/binpacking/packer.go:82-189 and
+// packable.go:113-175 (the reference's Go hot loop). This is the C++
+// equivalent of karpenter_tpu/ops/ffd.py (same dense-array formulation, same
+// round semantics), used as the fast in-process fallback when no accelerator
+// is attached and as the host baseline in benchmarks.
+//
+// Inputs are the densified solver tensors (see ops/encode.py):
+//   vectors  [G x D] float32  pod-group request vectors, sorted desc
+//   counts   [G]     int64    pods per group
+//   capacity [T x D] float32  usable per-type capacity (minus overhead+daemons),
+//                             sorted asc (smallest type first)
+//   total    [T x D] float32  raw per-type capacity (early-exit ledger)
+//
+// Output is a round list: round r packs `fill[r]` pods-per-group onto
+// `repl[r]` identical nodes of type `type[r]`; pods with no feasible node are
+// returned in `unschedulable`.
+//
+// Build: make -C native   (produces build/libktpu_ffd.so, loaded via ctypes)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr double kEps = 1e-9;
+
+struct Problem {
+  const float* vectors;
+  const int64_t* counts;  // live ledger (mutated by caller loop)
+  int num_groups;
+  int dims;
+  const float* capacity;
+  const float* total;
+  int num_types;
+  bool quirk;
+};
+
+// Greedily fill one node of type `t`. Returns pods packed per group in
+// `fill`; mirrors ffd.fill_node (packable.go Pack:113-132 + fits():147-157).
+int64_t FillNode(const Problem& p, int t, const int64_t* counts,
+                 int64_t* fill) {
+  const float* cap_row = p.capacity + static_cast<size_t>(t) * p.dims;
+  const float* total_row = p.total + static_cast<size_t>(t) * p.dims;
+  std::memset(fill, 0, sizeof(int64_t) * p.num_groups);
+
+  int last_active = -1;
+  for (int g = p.num_groups - 1; g >= 0; --g) {
+    if (counts[g] > 0) { last_active = g; break; }
+  }
+  if (last_active < 0) return 0;
+  const float* smallest = p.vectors + static_cast<size_t>(last_active) * p.dims;
+
+  std::vector<double> remaining(p.dims);
+  for (int d = 0; d < p.dims; ++d) remaining[d] = cap_row[d];
+
+  int64_t packed_total = 0;
+  bool packed_any = false;
+  for (int g = 0; g < p.num_groups; ++g) {
+    if (counts[g] <= 0) continue;
+    const float* need = p.vectors + static_cast<size_t>(g) * p.dims;
+    int64_t n_fit = counts[g];
+    bool any_positive = false;
+    for (int d = 0; d < p.dims; ++d) {
+      if (need[d] > 0.0f) {
+        any_positive = true;
+        double q = std::floor(remaining[d] / need[d] + kEps);
+        int64_t qi = q <= 0.0 ? 0 : static_cast<int64_t>(q);
+        if (qi < n_fit) n_fit = qi;
+      }
+    }
+    (void)any_positive;  // zero-vector groups fit entirely, as in Python
+    int64_t n = n_fit < counts[g] ? n_fit : counts[g];
+    if (n > 0) {
+      fill[g] = n;
+      packed_total += n;
+      packed_any = true;
+      for (int d = 0; d < p.dims; ++d) remaining[d] -= double(need[d]) * n;
+    }
+    if (n < counts[g]) {
+      if (!packed_any) {
+        // Largest pod failed to reserve: this packable packs nothing
+        // (packer.go:120-124 set-aside semantics handled by the caller).
+        std::memset(fill, 0, sizeof(int64_t) * p.num_groups);
+        return 0;
+      }
+      if (p.quirk) {
+        // Early exit when essentially full w.r.t. the smallest pod
+        // (packable.go fits():147-157, including its exact-fit quirk).
+        for (int d = 0; d < p.dims; ++d) {
+          if (total_row[d] > 0.0f && remaining[d] <= smallest[d] + kEps) {
+            return packed_total;
+          }
+        }
+      }
+    }
+  }
+  return packed_total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of rounds written, or -1 if max_rounds was exceeded.
+// round_fill is [max_rounds x num_groups] row-major; round_type / round_repl
+// are [max_rounds]; unschedulable is [num_groups].
+int ktpu_ffd_pack(const float* vectors, const int64_t* counts_in,
+                  int num_groups, int dims, const float* capacity,
+                  const float* total, int num_types, int quirk,
+                  int* round_type, int64_t* round_fill, int64_t* round_repl,
+                  int64_t* unschedulable, int max_rounds) {
+  std::vector<int64_t> counts(counts_in, counts_in + num_groups);
+  std::memset(unschedulable, 0, sizeof(int64_t) * num_groups);
+  Problem p{vectors, counts.data(), num_groups, dims,
+            capacity, total,        num_types,  quirk != 0};
+
+  if (num_types == 0) {
+    for (int g = 0; g < num_groups; ++g) unschedulable[g] = counts[g];
+    return 0;
+  }
+
+  std::vector<int64_t> upper(num_groups), fill(num_groups);
+  int64_t remaining_pods = 0;
+  for (int g = 0; g < num_groups; ++g) remaining_pods += counts[g];
+
+  int rounds = 0;
+  while (remaining_pods > 0) {
+    // Upper bound: what the largest packable can hold (packer.go:169).
+    int64_t max_packed =
+        FillNode(p, num_types - 1, counts.data(), upper.data());
+    if (max_packed == 0) {
+      // Largest remaining pod fits nowhere: set one aside.
+      for (int g = 0; g < num_groups; ++g) {
+        if (counts[g] > 0) {
+          ++unschedulable[g];
+          --counts[g];
+          --remaining_pods;
+          break;
+        }
+      }
+      continue;
+    }
+    // Smallest type achieving the bound wins (packer.go:163-189).
+    int chosen = num_types - 1;
+    const int64_t* chosen_fill = upper.data();
+    for (int t = 0; t < num_types - 1; ++t) {
+      if (FillNode(p, t, counts.data(), fill.data()) == max_packed) {
+        chosen = t;
+        chosen_fill = fill.data();
+        break;
+      }
+    }
+    // One node per round, exactly like the sequential reference loop. (A
+    // replica-compression fast path is NOT safe here: shrinking counts can
+    // flip the largest-type upper-bound pattern mid-stream, so compressed
+    // rounds could diverge from sequential FFD.)
+    if (rounds >= max_rounds) return -1;
+    round_type[rounds] = chosen;
+    round_repl[rounds] = 1;
+    int64_t* out = round_fill + static_cast<size_t>(rounds) * num_groups;
+    for (int g = 0; g < num_groups; ++g) {
+      out[g] = chosen_fill[g];
+      counts[g] -= chosen_fill[g];
+      remaining_pods -= chosen_fill[g];
+    }
+    ++rounds;
+  }
+  return rounds;
+}
+
+}  // extern "C"
