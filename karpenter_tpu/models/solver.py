@@ -11,8 +11,11 @@ and benchmarks).
 from __future__ import annotations
 
 import abc
+import functools
 from typing import List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from karpenter_tpu.api.pods import PodSpec
@@ -21,7 +24,11 @@ from karpenter_tpu.cloudprovider import InstanceType
 from karpenter_tpu.ops import ffd
 from karpenter_tpu.ops.encode import InstanceFleet, PodGroups, build_fleet, group_pods
 from karpenter_tpu.ops.pack_kernel import bucket_size, pack_kernel, pad_to
-from karpenter_tpu.ops.score_kernel import lp_relax_solve, round_assignment
+from karpenter_tpu.ops.score_kernel import (
+    feasibility_mask,
+    lp_relax_solve,
+    round_assignment,
+)
 
 
 class Solver(abc.ABC):
@@ -80,6 +87,26 @@ class NativeSolver(Solver):
         return _decode_rounds(round_list, unschedulable_counts, groups, fleet)
 
 
+@functools.partial(jax.jit, static_argnames=("lp_steps",))
+def _cost_fused_kernel(
+    vectors, counts, capacity, total, valid, prices, *, lp_steps: int
+):
+    """All three CostSolver candidates as ONE XLA computation: greedy-FFD
+    rounds, cost-greedy rounds, and the LP relaxation. Fusing them means a
+    single dispatch and a single device->host round trip per solve — on a
+    tunneled accelerator the round trips cost more than the math."""
+    rounds_ffd = pack_kernel(
+        vectors, counts, capacity, total, valid, prices, quirk=False, mode="ffd"
+    )
+    rounds_cost = pack_kernel(
+        vectors, counts, capacity, total, valid, prices, quirk=False, mode="cost"
+    )
+    feasible_any = feasibility_mask(vectors, capacity, valid).any(axis=1)
+    solvable = jnp.where(feasible_any, counts, 0)
+    lp = lp_relax_solve(vectors, solvable, capacity, valid, prices, steps=lp_steps)
+    return rounds_ffd, rounds_cost, lp.assignment, feasible_any
+
+
 def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool):
     g_pad = bucket_size(groups.num_groups)
     t_pad = bucket_size(fleet.num_types)
@@ -95,24 +122,53 @@ def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool)
     )
 
 
+def _cheapest_feasible_options(
+    fill: np.ndarray, t: int, groups: PodGroups, fleet: InstanceFleet
+) -> List[int]:
+    """Indices of the up-to-MAX_INSTANCE_TYPES cheapest types whose usable
+    capacity holds this node's total demand.
+
+    The reference offers the ascending-size window [t, t+20) as launch
+    options (packer.go:178-180); any of those types can host the packing, and
+    the fleet buys the cheapest. But so can ANY type with enough capacity —
+    offering the cheapest feasible set instead of the next-larger set lowers
+    the purchase price without touching the packing. The chosen type t is
+    always included as the feasibility anchor."""
+    demand = (fill.astype(np.float64)[:, None] * groups.vectors).sum(axis=0)
+    feasible = np.nonzero((fleet.capacity >= demand - 1e-6).all(axis=1))[0]
+    ranked = feasible[np.argsort(fleet.prices[feasible], kind="stable")]
+    chosen = list(ranked[: ffd.MAX_INSTANCE_TYPES])
+    if t not in chosen:
+        chosen[-1 if len(chosen) == ffd.MAX_INSTANCE_TYPES else len(chosen):] = [t]
+    return chosen
+
+
 def _decode_rounds(
     round_list: List[Tuple[int, np.ndarray, int]],
     unschedulable_counts: np.ndarray,
     groups: PodGroups,
     fleet: InstanceFleet,
+    options_fn=None,
 ) -> ffd.PackResult:
     """Turn (type, fill, replication) rounds into Packing objects, merging by
-    instance-option tuple (ref: packer.go:126-135 hashes options only)."""
+    instance-option tuple (ref: packer.go:126-135 hashes options only).
+
+    options_fn(t, fill) -> [type index] overrides the reference's
+    ascending-size option window (the CostSolver passes its memoized
+    cheapest-feasible selector)."""
     cursors = [0] * groups.num_groups
     by_options = {}
     packings: List[ffd.Packing] = []
     for t, fill, repl in round_list:
-        options = fleet.instance_types[t : t + ffd.MAX_INSTANCE_TYPES]
+        if options_fn is not None:
+            options = [fleet.instance_types[i] for i in options_fn(t, fill)]
+        else:
+            options = fleet.instance_types[t : t + ffd.MAX_INSTANCE_TYPES]
+        filled_groups = [(int(g), int(fill[g])) for g in np.nonzero(fill > 0)[0]]
         nodes = []
         for _ in range(repl):
             node_pods = []
-            for g in np.nonzero(fill > 0)[0]:
-                n = int(fill[g])
+            for g, n in filled_groups:
                 node_pods.extend(groups.members[g][cursors[g] : cursors[g] + n])
                 cursors[g] += n
             nodes.append(node_pods)
@@ -138,13 +194,23 @@ def _decode_rounds(
     return ffd.PackResult(packings=packings, unschedulable=unschedulable)
 
 
-def _kernel_rounds_to_list(rounds, num_groups: int):
-    num_rounds = int(rounds.num_rounds)
+def _to_host(tree):
+    """Device->host via jax.device_get, ONE call per kernel invocation.
+
+    Every device_get is a full round trip to the accelerator (tens of ms over
+    a tunneled device), and np.asarray on a jax Array is worse still (a slow
+    element-protocol path). So kernel outputs are fetched as a single pytree
+    transfer and everything downstream is plain numpy."""
+    return jax.device_get(tree)
+
+
+def _kernel_rounds_to_list(host_rounds: "PackRounds", num_groups: int):
+    num_rounds = int(host_rounds.num_rounds)
     return [
         (
-            int(np.asarray(rounds.round_type)[r]),
-            np.asarray(rounds.round_fill)[r, :num_groups],
-            int(np.asarray(rounds.round_repl)[r]),
+            int(host_rounds.round_type[r]),
+            host_rounds.round_fill[r, :num_groups],
+            int(host_rounds.round_repl[r]),
         )
         for r in range(num_rounds)
     ]
@@ -165,14 +231,14 @@ class TPUSolver(Solver):
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         if fleet.num_types == 0 or groups.num_groups == 0:
             return ffd.pack_groups(fleet, groups)
-        rounds = _run_kernel(groups, fleet, self.mode, self.quirk)
+        rounds = _to_host(_run_kernel(groups, fleet, self.mode, self.quirk))
         if bool(rounds.overflow):
             # Defensive: static round budget exhausted — fall back to host FFD
             # rather than return a partial packing.
             return ffd.pack_groups(fleet, groups)
         return _decode_rounds(
             _kernel_rounds_to_list(rounds, groups.num_groups),
-            np.asarray(rounds.unschedulable)[: groups.num_groups],
+            rounds.unschedulable[: groups.num_groups],
             groups,
             fleet,
         )
@@ -191,61 +257,98 @@ class CostSolver(Solver):
         if fleet.num_types == 0 or groups.num_groups == 0:
             return ffd.pack_groups(fleet, groups)
 
-        candidates: List[ffd.PackResult] = []
-        for mode in ("ffd", "cost"):
-            rounds = _run_kernel(groups, fleet, mode, False)
+        # One fused accelerator computation (greedy rounds + cost rounds + LP
+        # relaxation) and ONE device->host fetch: round-trip latency to the
+        # device, not compute, dominates this problem size.
+        #
+        # Price model: a node packed for type t launches as the CHEAPEST of
+        # its MAX_INSTANCE_TYPES option window (the fleet call's lowest-price
+        # strategy; ref: instance.go:116-133), so the cost objective sees the
+        # windowed minimum price, not the raw per-type price.
+        effective_prices = np.array(
+            [
+                fleet.prices[t : t + ffd.MAX_INSTANCE_TYPES].min()
+                for t in range(fleet.num_types)
+            ],
+            dtype=np.float32,
+        )
+        g_pad = bucket_size(groups.num_groups)
+        t_pad = bucket_size(fleet.num_types)
+        fused = _cost_fused_kernel(
+            pad_to(groups.vectors, g_pad),
+            pad_to(groups.counts.astype(np.int32), g_pad),
+            pad_to(fleet.capacity, t_pad),
+            pad_to(fleet.total, t_pad),
+            pad_to(np.ones(fleet.num_types, bool), t_pad),
+            pad_to(effective_prices, t_pad),
+            lp_steps=self.lp_steps,
+        )
+        rounds_ffd, rounds_cost, lp_assignment, feasible_any = _to_host(fused)
+
+        # Candidates stay in round form; only the winner pays the decode into
+        # concrete per-node pod lists.
+        candidates: List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]] = []
+        for rounds in (rounds_ffd, rounds_cost):
             if not bool(rounds.overflow):
                 candidates.append(
-                    _decode_rounds(
+                    (
                         _kernel_rounds_to_list(rounds, groups.num_groups),
-                        np.asarray(rounds.unschedulable)[: groups.num_groups],
-                        groups,
-                        fleet,
+                        rounds.unschedulable[: groups.num_groups],
                     )
                 )
-        lp_result = self._solve_lp(groups, fleet)
-        if lp_result is not None:
-            candidates.append(lp_result)
+        lp_candidate = self._realize_lp(lp_assignment, feasible_any, groups, fleet)
+        if lp_candidate is not None:
+            candidates.append(lp_candidate)
         if not candidates:
             return ffd.pack_groups(fleet, groups)
 
-        # A candidate that leaves more pods unschedulable never wins on price.
-        best = min(
-            candidates,
-            key=lambda r: (len(r.unschedulable), r.projected_cost(), r.node_count),
+        # Score from rounds: a node's realized price is the cheapest of its
+        # offered options, which for the CostSolver is the cheapest feasible
+        # type for that fill. A candidate that leaves more pods unschedulable
+        # never wins on price. The option sets are memoized per (t, fill) so
+        # the winning candidate's decode reuses the scoring pass's work.
+        options_memo: dict = {}
+
+        def options_fn(t: int, fill: np.ndarray) -> List[int]:
+            key = (t, fill.tobytes())
+            options = options_memo.get(key)
+            if options is None:
+                options = _cheapest_feasible_options(fill, t, groups, fleet)
+                options_memo[key] = options
+            return options
+
+        def score(candidate):
+            round_list, unschedulable_counts = candidate
+            nodes = sum(repl for _, _, repl in round_list)
+            cost = sum(
+                repl * float(fleet.prices[options_fn(t, fill)].min())
+                for t, fill, repl in round_list
+            )
+            return (int(unschedulable_counts.sum()), cost, nodes)
+
+        best_rounds, best_unschedulable = min(candidates, key=score)
+        return _decode_rounds(
+            best_rounds, best_unschedulable, groups, fleet, options_fn=options_fn
         )
-        return best
 
-    def _solve_lp(
-        self, groups: PodGroups, fleet: InstanceFleet
-    ) -> Optional[ffd.PackResult]:
-        g_pad = bucket_size(groups.num_groups)
-        t_pad = bucket_size(fleet.num_types)
-        vectors = pad_to(groups.vectors, g_pad)
-        counts = pad_to(groups.counts.astype(np.int32), g_pad)
-        capacity = pad_to(fleet.capacity, t_pad)
-        valid = pad_to(np.ones(fleet.num_types, bool), t_pad)
-        prices = pad_to(fleet.prices, t_pad)
-
-        feasible = np.asarray(
-            vectors[:, None, :] <= capacity[None, :, :] + 1e-6
-        ).all(axis=-1) & valid[None, :]
-        feasible_any = feasible.any(axis=1)
-        unschedulable_counts = np.where(feasible_any, 0, counts)[: groups.num_groups]
-        solvable_counts = np.where(feasible_any, counts, 0)
-
+    def _realize_lp(
+        self,
+        lp_assignment: np.ndarray,
+        feasible_any: np.ndarray,
+        groups: PodGroups,
+        fleet: InstanceFleet,
+    ) -> Optional[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]:
+        """Integerize the relaxed [G, T] assignment (already fetched to host)
+        and realize it as greedy per-type node fills."""
+        num = groups.num_groups
+        counts = groups.counts.astype(np.int64)
+        unschedulable_counts = np.where(feasible_any[:num], 0, counts)
+        solvable_counts = np.where(feasible_any[:num], counts, 0)
         if solvable_counts.sum() == 0:
             return None
-
-        lp = lp_relax_solve(
-            vectors,
-            solvable_counts,
-            capacity,
-            valid,
-            prices,
-            steps=self.lp_steps,
-        )
-        assignment = round_assignment(np.asarray(lp.assignment), solvable_counts)
+        padded_solvable = np.zeros(lp_assignment.shape[0], dtype=np.int64)
+        padded_solvable[:num] = solvable_counts
+        assignment = round_assignment(lp_assignment, padded_solvable)
 
         # Realize the plan: per type, greedily fill nodes (pure greedy, no
         # quirk) with that type's assigned pods.
@@ -272,4 +375,4 @@ class CostSolver(Solver):
                 guard += 1
                 if guard > 4 * num_groups + 16:
                     return None
-        return _decode_rounds(round_list, unschedulable_counts, groups, fleet)
+        return round_list, unschedulable_counts

@@ -47,7 +47,11 @@ def load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not _LIB_PATH.exists() and not _build():
+        # Always run make: a no-op when fresh, a rebuild when ffd.cc changed
+        # (loading a stale binary would silently bypass source edits), and a
+        # from-scratch build when the artifact is absent (it is untracked —
+        # -march=native output is not portable across machines).
+        if not _build():
             _load_failed = True
             return None
         try:

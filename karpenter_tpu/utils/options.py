@@ -30,7 +30,7 @@ class Options:
     health_probe_port: int = 8081  # ref: manager.go:52-57
     kube_client_qps: float = 200.0  # ref: options.go:33
     kube_client_burst: int = 300  # ref: options.go:34
-    solver: str = "cost"  # cost | ffd | greedy
+    solver: str = "cost"  # cost | ffd | greedy | native
     cloud_provider: str = "fake"
     leader_election: bool = True
     log_level: str = "info"
