@@ -125,10 +125,17 @@ class CloudProvider(abc.ABC):
         instance_types: Sequence[InstanceType],
         quantity: int,
         callback: Callable[[NodeSpec], None],
+        pool_options: Optional[Sequence] = None,
     ) -> List[Exception]:
         """Launch `quantity` nodes satisfying constraints, choosing among the
         offered instance_types; invoke callback per launched node. Returns
-        per-node errors (empty = full success)."""
+        per-node errors (empty = full success).
+
+        `pool_options` (ops.ffd.PoolOption rows, cheapest first) pins the
+        launch request to specific price-ranked (type, zone) pools — the
+        cost-aware plan's override rows. None = derive rows from
+        instance_types x offerings (reference semantics,
+        ref: instance.go getOverrides:173-207)."""
 
     @abc.abstractmethod
     def delete(self, node: NodeSpec) -> None:

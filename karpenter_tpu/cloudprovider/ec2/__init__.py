@@ -96,6 +96,7 @@ class Ec2CloudProvider(CloudProvider):
         instance_types: Sequence[InstanceType],
         quantity: int,
         callback: Callable[[NodeSpec], None],
+        pool_options: Optional[Sequence] = None,
     ) -> List[Exception]:
         """Ref: aws/cloudprovider.go Create:111-133 — one throttled fleet
         launch per packing; each launched node flows through the callback."""
@@ -104,7 +105,8 @@ class Ec2CloudProvider(CloudProvider):
             provider = Ec2Provider.deserialize(constraints)
             self._throttle()
             nodes = self.instances.create(
-                constraints, provider, instance_types, quantity
+                constraints, provider, instance_types, quantity,
+                pool_options=pool_options,
             )
         except Exception as error:  # noqa: BLE001 — reported, not raised
             return [error] * quantity

@@ -77,11 +77,15 @@ class InstanceProvider:
         provider: Ec2Provider,
         instance_types: Sequence[InstanceType],
         quantity: int,
+        pool_options=None,
     ) -> List[NodeSpec]:
         """Launch up to `quantity` nodes; partial fulfillment returns fewer
         (ref: instance.go Create:49-89). instance_types should be sorted
-        smallest-first — spot priority derives from that order."""
-        instance_ids = self._launch(constraints, provider, instance_types, quantity)
+        smallest-first — spot priority derives from that order. `pool_options`
+        (price-ranked PoolOption rows) pins per-pool override rows instead."""
+        instance_ids = self._launch(
+            constraints, provider, instance_types, quantity, pool_options
+        )
         instances = self._describe_with_retry(instance_ids)
         by_name = {t.name: t for t in instance_types}
         nodes, strays = [], []
@@ -117,6 +121,7 @@ class InstanceProvider:
         provider: Ec2Provider,
         instance_types: Sequence[InstanceType],
         quantity: int,
+        pool_options=None,
     ) -> List[str]:
         """Ref: instance.go launchInstances:107-146."""
         capacity_type = self.pick_capacity_type(constraints, instance_types)
@@ -130,9 +135,15 @@ class InstanceProvider:
         allowed_zones = constraints.effective_requirements().zones()
         result = FleetResult()
         for template_name, template_types in templates.items():
-            overrides = self.build_overrides(
-                template_types, subnets, allowed_zones, capacity_type
-            )
+            if pool_options:
+                overrides = self.build_pool_overrides(
+                    pool_options, template_types, subnets, allowed_zones,
+                    capacity_type,
+                )
+            else:
+                overrides = self.build_overrides(
+                    template_types, subnets, allowed_zones, capacity_type
+                )
             if not overrides:
                 continue
             fleet = self.api.create_fleet(
@@ -209,6 +220,49 @@ class InstanceProvider:
                         else None,
                     )
                 )
+        return overrides
+
+    def build_pool_overrides(
+        self,
+        pool_options,
+        template_types: Sequence[InstanceType],
+        subnets,
+        allowed_zones,
+        capacity_type: str,
+    ) -> List[FleetOverride]:
+        """Override rows from a cost-aware plan's pinned pools: per-POOL
+        priority (price rank) instead of the reference's per-type index —
+        same row budget, strictly finer control over what spot's
+        capacity-optimized-prioritized allocation may pick."""
+        template_names = {t.name for t in template_types}
+        subnet_by_zone: Dict[str, str] = {}
+        for subnet in subnets:
+            subnet_by_zone.setdefault(subnet.zone, subnet.subnet_id)
+        overrides = []
+        for pool in pool_options:
+            if pool.instance_type.name not in template_names:
+                continue
+            if allowed_zones is not None and pool.zone not in allowed_zones:
+                continue
+            subnet_id = subnet_by_zone.get(pool.zone)
+            if subnet_id is None:
+                continue
+            offered = any(
+                o.zone == pool.zone and o.capacity_type == capacity_type
+                for o in pool.instance_type.offerings
+            )
+            if not offered:
+                continue
+            overrides.append(
+                FleetOverride(
+                    instance_type=pool.instance_type.name,
+                    subnet_id=subnet_id,
+                    zone=pool.zone,
+                    priority=float(pool.priority)
+                    if capacity_type == wellknown.CAPACITY_TYPE_SPOT
+                    else None,
+                )
+            )
         return overrides
 
     def _record_unavailable(self, fleet: FleetResult, capacity_type: str) -> None:

@@ -192,6 +192,7 @@ class FakeCloudProvider(CloudProvider):
         instance_types: Sequence[InstanceType],
         quantity: int,
         callback: Callable[[NodeSpec], None],
+        pool_options: Optional[Sequence] = None,
     ) -> List[Exception]:
         self.create_calls.append(
             (constraints, [it.name for it in instance_types], quantity)
@@ -203,16 +204,31 @@ class FakeCloudProvider(CloudProvider):
         for _ in range(quantity):
             launched = False
             last_error: Optional[Exception] = None
-            # Lowest-price-first across offered types, honoring constraints —
-            # the fleet-API behavior the reference delegates to EC2.
-            candidates = []
-            for it in instance_types:
-                for offering in it.offerings:
-                    if not allowed_zones.contains(offering.zone):
+            if pool_options:
+                # Pinned price-ranked pools: walk them in priority order,
+                # honoring constraints and the pool's own (type, zone).
+                candidates = []
+                for rank, pool in enumerate(pool_options):
+                    if not allowed_zones.contains(pool.zone):
                         continue
-                    if not allowed_capacity.contains(offering.capacity_type):
-                        continue
-                    candidates.append((offering.price, it, offering))
+                    for offering in pool.instance_type.offerings:
+                        if offering.zone != pool.zone:
+                            continue
+                        if not allowed_capacity.contains(offering.capacity_type):
+                            continue
+                        candidates.append((rank, pool.instance_type, offering))
+            else:
+                # Lowest-price-first across offered types, honoring
+                # constraints — the fleet-API behavior the reference
+                # delegates to EC2.
+                candidates = []
+                for it in instance_types:
+                    for offering in it.offerings:
+                        if not allowed_zones.contains(offering.zone):
+                            continue
+                        if not allowed_capacity.contains(offering.capacity_type):
+                            continue
+                        candidates.append((offering.price, it, offering))
             candidates.sort(key=lambda c: c[0])
             for _, it, offering in candidates:
                 pool = (it.name, offering.zone, offering.capacity_type)

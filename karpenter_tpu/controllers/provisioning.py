@@ -207,6 +207,7 @@ class ProvisionerWorker:
                 packing.instance_type_options,
                 packing.node_quantity,
                 bind_callback,
+                pool_options=packing.pool_options,
             )
             stats.launch_errors.extend(errors)
 

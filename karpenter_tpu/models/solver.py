@@ -122,25 +122,107 @@ def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool)
     )
 
 
+# Row budget for one launch request: the reference offers MAX_INSTANCE_TYPES
+# types, each crossed with ~3 zone subnets (instance.go:173-207) — we spend
+# the same number of override rows on individually price-ranked pools.
+MAX_POOL_ROWS = 3 * ffd.MAX_INSTANCE_TYPES
+# Pools priced within this band of the cheapest feasible pool are offered;
+# spot's capacity-optimized allocation picks freely among OFFERED rows, so the
+# band bounds realized price for the in-band prefix.
+POOL_PRICE_BAND = 0.05
+# Never offer fewer than this many pools (when they exist): a single-pool
+# request is one ICE away from failure (ref: the 45s blackout machinery,
+# aws/instancetypes.go:174-183, exists because pools do run dry). Rows forced
+# beyond the band for this floor are still price-capped (below) — past that,
+# ICE-retry through the blackout cache beats overpaying.
+MIN_POOL_ROWS = 4
+# Hard ceiling on any offered row relative to the cheapest feasible pool:
+# capacity-optimized allocation may land on ANY offered row, so every row is
+# a price we are willing to pay.
+MAX_POOL_PRICE_RATIO = 1.3
+
+
+def _pool_price_matrix(fleet: InstanceFleet) -> Tuple[List[str], np.ndarray]:
+    """[T, Z] price of each type's pool per zone at the fleet's capacity type
+    (inf where not offered), computed once per solve so per-round option
+    ranking is pure vectorized numpy."""
+    zones = fleet.allowed_zones or sorted(
+        {z for it in fleet.instance_types for z in it.zones()}
+    )
+    matrix = np.full((fleet.num_types, len(zones)), np.inf, dtype=np.float64)
+    zone_index = {zone: j for j, zone in enumerate(zones)}
+    for ti, instance_type in enumerate(fleet.instance_types):
+        for offering in instance_type.offerings:
+            if offering.capacity_type != fleet.capacity_type:
+                continue
+            j = zone_index.get(offering.zone)
+            if j is not None:
+                matrix[ti, j] = min(matrix[ti, j], offering.price)
+    return zones, matrix
+
+
 def _cheapest_feasible_options(
-    fill: np.ndarray, t: int, groups: PodGroups, fleet: InstanceFleet
-) -> List[int]:
-    """Indices of the up-to-MAX_INSTANCE_TYPES cheapest types whose usable
-    capacity holds this node's total demand.
+    fill: np.ndarray,
+    t: int,
+    groups: PodGroups,
+    fleet: InstanceFleet,
+    zones: Optional[List[str]] = None,
+    pool_prices: Optional[np.ndarray] = None,
+) -> Tuple[List[int], Optional[List[ffd.PoolOption]]]:
+    """Price-ranked launch options for a node with this fill.
 
     The reference offers the ascending-size window [t, t+20) as launch
-    options (packer.go:178-180); any of those types can host the packing, and
-    the fleet buys the cheapest. But so can ANY type with enough capacity —
-    offering the cheapest feasible set instead of the next-larger set lowers
-    the purchase price without touching the packing. The chosen type t is
-    always included as the feasibility anchor."""
+    options (packer.go:178-180) with priority = window index — price-blind
+    both across and within types. Any type whose usable capacity holds the
+    node's demand can host it, so we instead rank individual (type, zone)
+    pools by price at the fleet's capacity type, offer the cheapest rows
+    within POOL_PRICE_BAND (at least MIN_POOL_ROWS, at most MAX_POOL_ROWS,
+    distinct types capped at MAX_INSTANCE_TYPES to match the reference's
+    request budget), and let the allocation strategy choose among
+    near-cheapest pools only. Returns (type indices, pool rows)."""
+    if zones is None or pool_prices is None:
+        zones, pool_prices = _pool_price_matrix(fleet)
     demand = (fill.astype(np.float64)[:, None] * groups.vectors).sum(axis=0)
     feasible = np.nonzero((fleet.capacity >= demand - 1e-6).all(axis=1))[0]
-    ranked = feasible[np.argsort(fleet.prices[feasible], kind="stable")]
-    chosen = list(ranked[: ffd.MAX_INSTANCE_TYPES])
-    if t not in chosen:
-        chosen[-1 if len(chosen) == ffd.MAX_INSTANCE_TYPES else len(chosen):] = [t]
-    return chosen
+    candidate = pool_prices[feasible]  # [F, Z]
+    flat = candidate.ravel()
+    finite = np.isfinite(flat)
+    if not finite.any():
+        # Degenerate: fall back to the feasibility anchor's type options.
+        return [t], None
+    order = np.argsort(flat, kind="stable")
+    order = order[finite[order]]
+    num_zones = len(zones)
+    cheapest = flat[order[0]]
+    cutoff = cheapest * (1.0 + POOL_PRICE_BAND)
+    ceiling = cheapest * MAX_POOL_PRICE_RATIO
+    chosen_types: List[int] = []
+    chosen_set: set = set()
+    pool_options: List[ffd.PoolOption] = []
+    for flat_index in order:
+        price = float(flat[flat_index])
+        if len(pool_options) >= MAX_POOL_ROWS:
+            break
+        if price > cutoff and len(pool_options) >= MIN_POOL_ROWS:
+            break
+        if price > ceiling and pool_options:
+            break
+        ti = int(feasible[flat_index // num_zones])
+        zone = zones[flat_index % num_zones]
+        if ti not in chosen_set:
+            if len(chosen_types) >= ffd.MAX_INSTANCE_TYPES:
+                continue
+            chosen_types.append(ti)
+            chosen_set.add(ti)
+        pool_options.append(
+            ffd.PoolOption(
+                instance_type=fleet.instance_types[ti],
+                zone=zone,
+                price=price,
+                priority=len(pool_options),
+            )
+        )
+    return chosen_types, pool_options
 
 
 def _decode_rounds(
@@ -160,8 +242,10 @@ def _decode_rounds(
     by_options = {}
     packings: List[ffd.Packing] = []
     for t, fill, repl in round_list:
+        pool_opts = None
         if options_fn is not None:
-            options = [fleet.instance_types[i] for i in options_fn(t, fill)]
+            type_indices, pool_opts = options_fn(t, fill)
+            options = [fleet.instance_types[i] for i in type_indices]
         else:
             options = fleet.instance_types[t : t + ffd.MAX_INSTANCE_TYPES]
         filled_groups = [(int(g), int(fill[g])) for g in np.nonzero(fill > 0)[0]]
@@ -172,7 +256,12 @@ def _decode_rounds(
                 node_pods.extend(groups.members[g][cursors[g] : cursors[g] + n])
                 cursors[g] += n
             nodes.append(node_pods)
-        key = tuple(it.name for it in options)
+        key = (
+            tuple(it.name for it in options),
+            tuple((p.instance_type.name, p.zone) for p in pool_opts)
+            if pool_opts
+            else None,
+        )
         existing = by_options.get(key)
         if existing is not None:
             existing.node_quantity += repl
@@ -182,6 +271,7 @@ def _decode_rounds(
                 pods_per_node=nodes,
                 instance_type_options=list(options),
                 node_quantity=repl,
+                pool_options=pool_opts,
             )
             by_options[key] = packing
             packings.append(packing)
@@ -261,17 +351,17 @@ class CostSolver(Solver):
         # relaxation) and ONE device->host fetch: round-trip latency to the
         # device, not compute, dominates this problem size.
         #
-        # Price model: a node packed for type t launches as the CHEAPEST of
-        # its MAX_INSTANCE_TYPES option window (the fleet call's lowest-price
-        # strategy; ref: instance.go:116-133), so the cost objective sees the
-        # windowed minimum price, not the raw per-type price.
-        effective_prices = np.array(
-            [
-                fleet.prices[t : t + ffd.MAX_INSTANCE_TYPES].min()
-                for t in range(fleet.num_types)
-            ],
-            dtype=np.float32,
-        )
+        # Price model: a node packed for type t launches as the cheapest pool
+        # of ANY type whose capacity dominates t's (the plan offers the
+        # price-ranked feasible pools, _cheapest_feasible_options), so the
+        # cost objective sees the dominating-type minimum price — the price
+        # the realization will actually pay, not t's own list price.
+        dominates = (
+            fleet.capacity[None, :, :] >= fleet.capacity[:, None, :] - 1e-6
+        ).all(axis=2)  # [T, T'] — t' can host any node packed for t
+        effective_prices = np.where(dominates, fleet.prices[None, :], np.inf).min(
+            axis=1
+        ).astype(np.float32)
         g_pad = bucket_size(groups.num_groups)
         t_pad = bucket_size(fleet.num_types)
         fused = _cost_fused_kernel(
@@ -308,21 +398,36 @@ class CostSolver(Solver):
         # never wins on price. The option sets are memoized per (t, fill) so
         # the winning candidate's decode reuses the scoring pass's work.
         options_memo: dict = {}
+        pool_zones, pool_prices = _pool_price_matrix(fleet)
 
-        def options_fn(t: int, fill: np.ndarray) -> List[int]:
-            key = (t, fill.tobytes())
+        def options_fn(t: int, fill: np.ndarray):
+            # The anchor t only matters on the degenerate no-finite-pool path;
+            # keying by fill alone lets identical fills packed for different
+            # types share one ranking pass.
+            key = fill.tobytes()
             options = options_memo.get(key)
             if options is None:
-                options = _cheapest_feasible_options(fill, t, groups, fleet)
+                options = _cheapest_feasible_options(
+                    fill, t, groups, fleet, pool_zones, pool_prices
+                )
                 options_memo[key] = options
             return options
+
+        def round_price(t: int, fill: np.ndarray) -> float:
+            """Expected realized price of one node: capacity-optimized
+            allocation can land on any offered row and the solver cannot see
+            pool depths, so candidates are ranked by the mean offered-row
+            price, not the optimistic cheapest row."""
+            type_indices, pool_opts = options_fn(t, fill)
+            if pool_opts:
+                return float(np.mean([p.price for p in pool_opts]))
+            return float(fleet.prices[type_indices].min())
 
         def score(candidate):
             round_list, unschedulable_counts = candidate
             nodes = sum(repl for _, _, repl in round_list)
             cost = sum(
-                repl * float(fleet.prices[options_fn(t, fill)].min())
-                for t, fill, repl in round_list
+                repl * round_price(t, fill) for t, fill, repl in round_list
             )
             return (int(unschedulable_counts.sum()), cost, nodes)
 
@@ -348,6 +453,22 @@ class CostSolver(Solver):
             return None
         padded_solvable = np.zeros(lp_assignment.shape[0], dtype=np.int64)
         padded_solvable[:num] = solvable_counts
+        # Concentrate before rounding: softmax leaves a long tail of tiny
+        # per-type shards that round into poorly-filled single nodes. Keep
+        # each group's heaviest types (up to 8) and renormalize — the
+        # realized node count drops sharply at negligible objective cost.
+        lp_assignment = np.asarray(lp_assignment, dtype=np.float64).copy()
+        for g in range(num):
+            row = lp_assignment[g]
+            total_mass = row.sum()
+            if total_mass <= 0:
+                continue
+            keep = np.argsort(-row)[:8]
+            kept = np.zeros_like(row)
+            kept[keep] = row[keep]
+            kept_mass = kept.sum()
+            if kept_mass > 0:
+                lp_assignment[g] = kept * (total_mass / kept_mass)
         assignment = round_assignment(lp_assignment, padded_solvable)
 
         # Realize the plan: per type, greedily fill nodes (pure greedy, no

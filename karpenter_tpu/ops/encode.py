@@ -13,7 +13,7 @@ O(nodes×types×P) inner loop (ref: binpacking/packable.go:113-132).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -90,6 +90,11 @@ class InstanceFleet:
     capacity: np.ndarray  # [T, R] usable capacity (total - overhead - daemons)
     total: np.ndarray  # [T, R] raw capacity (node allocatable before daemons)
     prices: np.ndarray  # [T] cheapest feasible offering $/hr
+    # Launch envelope implied by the schedule's constraints: the zones pools
+    # may come from (empty = unconstrained) and the capacity type a launch
+    # would use (ref: instance.go getCapacityType:281-292).
+    allowed_zones: List[str] = field(default_factory=list)
+    capacity_type: str = wellknown.CAPACITY_TYPE_ON_DEMAND
 
     @property
     def num_types(self) -> int:
@@ -203,12 +208,36 @@ def build_fleet(
             item[2][mem],
         )
     )
+    # Launch envelope: the offered zones that survive the constraint set
+    # (offered zones are finite, so NotIn/complement requirements filter
+    # correctly — finite_values() alone would drop them), and spot iff
+    # allowed and offered by any kept type (ref: instance.go:281-292).
+    zone_values = sorted(
+        {
+            zone
+            for item in kept
+            for zone in item[0].zones()
+            if allowed_zones.contains(zone)
+        }
+    )
+    capacity_type = wellknown.CAPACITY_TYPE_ON_DEMAND
+    if allowed_capacity.contains(wellknown.CAPACITY_TYPE_SPOT):
+        for item in kept:
+            if wellknown.CAPACITY_TYPE_SPOT in item[0].capacity_types():
+                capacity_type = wellknown.CAPACITY_TYPE_SPOT
+                break
     if not kept:
         empty = np.zeros((0, wellknown.NUM_RESOURCE_DIMS), np.float32)
-        return InstanceFleet([], empty, empty.copy(), np.zeros((0,), np.float32))
+        return InstanceFleet(
+            [], empty, empty.copy(), np.zeros((0,), np.float32),
+            allowed_zones=zone_values,
+            capacity_type=capacity_type,
+        )
     return InstanceFleet(
         instance_types=[item[0] for item in kept],
         capacity=np.stack([item[1] for item in kept]),
         total=np.stack([item[2] for item in kept]),
         prices=np.array([item[3] for item in kept], dtype=np.float32),
+        allowed_zones=zone_values,
+        capacity_type=capacity_type,
     )

@@ -43,12 +43,31 @@ MAX_INSTANCE_TYPES = 20
 
 
 @dataclass
+class PoolOption:
+    """One (type, zone) launch-override row with an explicit priority.
+
+    The reference's override rows carry a priority only per *type* (its index
+    in the ascending-size window, instance.go:173-207) and are therefore
+    price-blind within a type across zones. A cost-aware plan ranks individual
+    pools by price instead — same row budget, strictly more control."""
+
+    instance_type: InstanceType
+    zone: str
+    price: float
+    priority: int
+
+
+@dataclass
 class Packing:
     """One node shape: pods per node, viable instance types, node count."""
 
     pods_per_node: List[List[PodSpec]]
     instance_type_options: List[InstanceType]
     node_quantity: int = 1
+    # Cost-aware plans additionally pin pool-level override rows (cheapest
+    # first). None = reference semantics (derive rows from
+    # instance_type_options x offered zones, priority per type).
+    pool_options: Optional[List[PoolOption]] = None
 
     @property
     def pods(self) -> List[PodSpec]:
@@ -66,14 +85,17 @@ class PackResult:
 
     def projected_cost(self) -> float:
         """$/hr if each node launches as its cheapest offered option."""
-        return sum(
-            p.node_quantity
-            * min(
-                (it.min_price() for it in p.instance_type_options),
-                default=float("inf"),
-            )
-            for p in self.packings
-        )
+        total = 0.0
+        for p in self.packings:
+            if p.pool_options:
+                price = min(pool.price for pool in p.pool_options)
+            else:
+                price = min(
+                    (it.min_price() for it in p.instance_type_options),
+                    default=float("inf"),
+                )
+            total += p.node_quantity * price
+        return total
 
 
 def fill_node(

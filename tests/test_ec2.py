@@ -426,3 +426,65 @@ class TestEndToEnd:
         finally:
             validation.DEFAULT_HOOK = None
             validation.VALIDATE_HOOK = None
+
+
+class TestPoolPinnedLaunch:
+    """Cost-aware plans pin per-pool override rows (PoolOption) that flow
+    through create() into the fleet request with per-pool priorities."""
+
+    def _pools(self, provider, constraints, names_zones):
+        from karpenter_tpu.ops.ffd import PoolOption
+
+        by_name = {t.name: t for t in provider.get_instance_types(constraints)}
+        return [
+            PoolOption(
+                instance_type=by_name[name],
+                zone=zone,
+                price=0.1 * (i + 1),
+                priority=i,
+            )
+            for i, (name, zone) in enumerate(names_zones)
+        ]
+
+    def test_pinned_pools_become_override_rows_with_pool_priorities(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob(
+            **{wellknown.CAPACITY_TYPE_LABEL: ["spot", "on-demand"]}
+        )
+        pools = self._pools(
+            provider,
+            constraints,
+            [
+                ("m5.large", "test-zone-1b"),
+                ("c5.large", "test-zone-1a"),
+                ("m5.xlarge", "test-zone-1b"),
+            ],
+        )
+        types = [p.instance_type for p in pools]
+        nodes = []
+        provider.create(constraints, types, 1, nodes.append, pool_options=pools)
+        request = api.calls["create_fleet"][-1]
+        rows = [(o.instance_type, o.zone, o.priority) for o in request.overrides]
+        assert rows == [
+            ("m5.large", "test-zone-1b", 0.0),
+            ("c5.large", "test-zone-1a", 1.0),
+            ("m5.xlarge", "test-zone-1b", 2.0),
+        ]
+        assert len(nodes) == 1
+
+    def test_pinned_pools_respect_zone_constraints(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob(
+            **{wellknown.ZONE_LABEL: ["test-zone-1a"]}
+        )
+        pools = self._pools(
+            provider,
+            constraints,
+            [("m5.large", "test-zone-1b"), ("c5.large", "test-zone-1a")],
+        )
+        types = [p.instance_type for p in pools]
+        provider.create(constraints, types, 1, lambda n: None, pool_options=pools)
+        request = api.calls["create_fleet"][-1]
+        assert [(o.instance_type, o.zone) for o in request.overrides] == [
+            ("c5.large", "test-zone-1a")
+        ]

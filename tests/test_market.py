@@ -1,0 +1,297 @@
+"""Spot-market model + fleet-allocation simulator (cloudprovider/market.py).
+
+The allocation strategies mirror the reference's CreateFleet request
+(ref: pkg/cloudprovider/aws/instance.go:116-133): lowest-price for on-demand,
+capacity-optimized-prioritized for spot. Both solvers' plans are priced by the
+same simulator, so these tests pin the strategy semantics and the fairness of
+the comparison.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.cloudprovider import InstanceType, Offering
+from karpenter_tpu.cloudprovider.market import (
+    PoolOffer,
+    SpotMarket,
+    allocate,
+    capacity_type_for,
+    generate_market,
+    plan_offers,
+    simulate_plan_cost,
+)
+from karpenter_tpu.models.solver import (
+    MAX_POOL_ROWS,
+    CostSolver,
+    GreedySolver,
+    _cheapest_feasible_options,
+)
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops.encode import build_fleet, group_pods
+
+ZONES = ("zone-a", "zone-b", "zone-c")
+
+
+def catalog_with_market(num_types=12, seed=3):
+    names = [f"m{i // 4}.{2 ** (i % 4)}x" for i in range(num_types)]
+    market = generate_market(names, ZONES, seed=seed)
+    catalog = []
+    for i, name in enumerate(names):
+        size = 2 ** (i % 4)
+        od = 0.1 * size * (1 + 0.1 * (i // 4))
+        offerings = []
+        for z in ZONES:
+            offerings.append(Offering(zone=z, capacity_type="on-demand", price=od))
+            offerings.append(
+                Offering(
+                    zone=z,
+                    capacity_type="spot",
+                    price=market.spot_price((name, z), od),
+                )
+            )
+        catalog.append(
+            InstanceType(
+                name=name,
+                capacity={"cpu": 2 * size, "memory": f"{8 * size}Gi", "pods": 110},
+                offerings=offerings,
+            )
+        )
+    return catalog, market
+
+
+def pods_of(n, cpu="500m", mem="512Mi"):
+    return [
+        PodSpec(name=f"p-{i}", requests={"cpu": cpu, "memory": mem}, unschedulable=True)
+        for i in range(n)
+    ]
+
+
+class TestGenerateMarket:
+    def test_deterministic(self):
+        a = generate_market(["m1.x", "c1.x"], ZONES, seed=7)
+        b = generate_market(["m1.x", "c1.x"], ZONES, seed=7)
+        assert a.discount == b.discount and a.depth == b.depth
+
+    def test_discount_bounds(self):
+        market = generate_market([f"t{i}.x" for i in range(50)], ZONES, seed=1)
+        values = np.array(list(market.discount.values()))
+        assert (values >= 0.25).all() and (values <= 0.95).all()
+        # Structured, not degenerate: discounts actually vary.
+        assert values.std() > 0.02
+
+    def test_depth_price_anticorrelation(self):
+        market = generate_market([f"t{i}.x" for i in range(200)], ZONES, seed=2)
+        pools = list(market.discount)
+        depth = np.array([market.depth[p] for p in pools])
+        disc = np.array([market.discount[p] for p in pools])
+        rho = np.corrcoef(depth, disc)[0, 1]
+        assert rho < -0.2  # deep pools trend cheap
+
+
+class TestAllocate:
+    def offers(self):
+        return [
+            PoolOffer("a.x", "zone-a", price=1.0, priority=0),
+            PoolOffer("b.x", "zone-b", price=0.5, priority=1),
+            PoolOffer("c.x", "zone-c", price=0.8, priority=2),
+        ]
+
+    def test_on_demand_lowest_price(self):
+        chosen = allocate(self.offers(), wellknown.CAPACITY_TYPE_ON_DEMAND)
+        assert chosen.instance_type == "b.x"  # cheapest wins regardless of priority
+
+    def test_spot_capacity_optimized_prefers_deep_pool(self):
+        market = SpotMarket(
+            depth={("a.x", "zone-a"): 10.0, ("b.x", "zone-b"): 1.0, ("c.x", "zone-c"): 1.0}
+        )
+        chosen = allocate(self.offers(), wellknown.CAPACITY_TYPE_SPOT, market)
+        # b.x is cheapest but shallow: capacity wins over price.
+        assert chosen.instance_type == "a.x"
+
+    def test_spot_priority_breaks_depth_ties(self):
+        market = SpotMarket(
+            depth={("a.x", "zone-a"): 5.0, ("b.x", "zone-b"): 4.9, ("c.x", "zone-c"): 1.0}
+        )
+        chosen = allocate(self.offers(), wellknown.CAPACITY_TYPE_SPOT, market)
+        # a and b are capacity-equivalent (within slack); lowest priority wins.
+        assert chosen.instance_type == "a.x"
+
+    def test_excluded_pools_skipped(self):
+        chosen = allocate(
+            self.offers(),
+            wellknown.CAPACITY_TYPE_ON_DEMAND,
+            excluded=[("b.x", "zone-b")],
+        )
+        assert chosen.instance_type == "c.x"
+
+    def test_no_usable_pool(self):
+        assert (
+            allocate(
+                self.offers()[:1],
+                wellknown.CAPACITY_TYPE_ON_DEMAND,
+                excluded=[("a.x", "zone-a")],
+            )
+            is None
+        )
+
+
+class TestCapacityType:
+    def test_spot_when_allowed_and_offered(self):
+        catalog, _ = catalog_with_market()
+        assert (
+            capacity_type_for(Constraints(), catalog) == wellknown.CAPACITY_TYPE_SPOT
+        )
+
+    def test_on_demand_when_requirements_forbid_spot(self):
+        from karpenter_tpu.api.requirements import Requirement, Requirements
+
+        catalog, _ = catalog_with_market()
+        constraints = Constraints(
+            requirements=Requirements(
+                [
+                    Requirement.in_(
+                        wellknown.CAPACITY_TYPE_LABEL,
+                        [wellknown.CAPACITY_TYPE_ON_DEMAND],
+                    )
+                ]
+            )
+        )
+        assert (
+            capacity_type_for(constraints, catalog)
+            == wellknown.CAPACITY_TYPE_ON_DEMAND
+        )
+
+
+class TestPoolOptions:
+    def test_cheapest_feasible_pools_hold_demand_and_are_price_sorted(self):
+        catalog, _ = catalog_with_market()
+        pods = pods_of(40)
+        groups = group_pods(pods)
+        fleet = build_fleet(catalog, Constraints(), pods)
+        fill = np.zeros(groups.num_groups, dtype=np.int64)
+        fill[0] = 4
+        type_indices, pools = _cheapest_feasible_options(fill, 0, groups, fleet)
+        assert pools and len(pools) <= MAX_POOL_ROWS
+        prices = [p.price for p in pools]
+        assert prices == sorted(prices)
+        assert len({p.instance_type.name for p in pools}) <= ffd.MAX_INSTANCE_TYPES
+        demand = (fill[:, None] * groups.vectors).sum(axis=0)
+        for p in pools:
+            idx = fleet.instance_types.index(p.instance_type)
+            assert (fleet.capacity[idx] >= demand - 1e-6).all()
+
+    def test_plan_offers_uses_pinned_pools(self):
+        catalog, market = catalog_with_market()
+        packing = ffd.Packing(
+            pods_per_node=[[]],
+            instance_type_options=[catalog[0]],
+            pool_options=[
+                ffd.PoolOption(catalog[0], "zone-b", price=0.04, priority=0),
+                ffd.PoolOption(catalog[1], "zone-a", price=0.05, priority=1),
+            ],
+        )
+        offers = plan_offers(
+            packing, ZONES, wellknown.CAPACITY_TYPE_SPOT, market
+        )
+        assert [(o.instance_type, o.zone) for o in offers] == [
+            (catalog[0].name, "zone-b"),
+            (catalog[1].name, "zone-a"),
+        ]
+        # Zone filter drops pinned rows outside the envelope.
+        offers = plan_offers(
+            packing, ["zone-a"], wellknown.CAPACITY_TYPE_SPOT, market
+        )
+        assert [(o.instance_type, o.zone) for o in offers] == [
+            (catalog[1].name, "zone-a")
+        ]
+
+
+class TestSimulatedPlanCost:
+    def test_identical_plans_price_identically(self):
+        catalog, market = catalog_with_market()
+        pods = pods_of(60)
+        constraints = Constraints()
+        result_a = GreedySolver().solve(pods, catalog, constraints)
+        result_b = GreedySolver().solve(pods, catalog, constraints)
+        assert simulate_plan_cost(
+            result_a, constraints, market, ZONES
+        ) == pytest.approx(simulate_plan_cost(result_b, constraints, market, ZONES))
+
+    def test_cost_solver_realized_not_worse_than_greedy(self):
+        catalog, market = catalog_with_market()
+        pods = pods_of(300, cpu="750m", mem="1Gi")
+        constraints = Constraints()
+        greedy = GreedySolver().solve(pods, catalog, constraints)
+        ours = CostSolver(lp_steps=50).solve(pods, catalog, constraints)
+        greedy_cost = simulate_plan_cost(greedy, constraints, market, ZONES)
+        ours_cost = simulate_plan_cost(ours, constraints, market, ZONES)
+        assert ours_cost <= greedy_cost * 1.001
+        # Both plans schedule everything.
+        assert not greedy.unschedulable and not ours.unschedulable
+        assert sum(len(n) for p in ours.packings for n in p.pods_per_node) == 300
+
+    def test_unbuyable_plan_priced_at_advertised_offering(self):
+        instance_type = InstanceType(
+            name="od.only",
+            capacity={"cpu": 4, "memory": "16Gi", "pods": 110},
+            offerings=[Offering(zone="zone-z", capacity_type="on-demand", price=0.5)],
+        )
+        packing = ffd.Packing(
+            pods_per_node=[[]], instance_type_options=[instance_type]
+        )
+        result = ffd.PackResult(packings=[packing])
+        # Zone filter excludes the only offering's zone: falls back to the
+        # advertised price instead of silently costing zero.
+        cost = simulate_plan_cost(result, Constraints(), None, ["zone-a"])
+        assert cost == pytest.approx(0.5)
+
+
+class TestLaunchEnvelope:
+    def test_not_in_zone_constraint_excluded_from_pool_rows(self):
+        """NotIn zone requirements must filter the launch envelope: offered
+        zones are finite, so the fleet's allowed_zones can always be computed
+        even for complement (NotIn) requirement sets."""
+        from karpenter_tpu.api.requirements import Requirement, Requirements
+
+        catalog, _ = catalog_with_market()
+        constraints = Constraints(
+            requirements=Requirements(
+                [Requirement("topology.kubernetes.io/zone", "NotIn", ["zone-a"])]
+            )
+        )
+        pods = pods_of(20)
+        groups = group_pods(pods)
+        fleet = build_fleet(catalog, constraints, pods)
+        assert fleet.allowed_zones == ["zone-b", "zone-c"]
+        fill = np.zeros(groups.num_groups, dtype=np.int64)
+        fill[0] = 4
+        _, pools = _cheapest_feasible_options(fill, 0, groups, fleet)
+        assert pools and all(p.zone != "zone-a" for p in pools)
+
+    def test_cost_solver_plan_launches_through_pinned_pools(self):
+        """End-to-end: the CostSolver's pool rows reach the cloud provider's
+        launch call and the fake honors the cheapest pinned pool."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+
+        catalog, _ = catalog_with_market()
+        pods = pods_of(50)
+        constraints = Constraints()
+        result = CostSolver(lp_steps=50).solve(pods, catalog, constraints)
+        packing = result.packings[0]
+        assert packing.pool_options, "cost plan should pin pool rows"
+        provider = FakeCloudProvider(instance_types=catalog)
+        nodes = []
+        provider.create(
+            constraints,
+            packing.instance_type_options,
+            packing.node_quantity,
+            nodes.append,
+            pool_options=packing.pool_options,
+        )
+        assert len(nodes) == packing.node_quantity
+        cheapest = packing.pool_options[0]
+        assert nodes[0].instance_type == cheapest.instance_type.name
+        assert nodes[0].zone == cheapest.zone
