@@ -160,12 +160,25 @@ def main():
     # simulator (lowest-price for on-demand, capacity-optimized-prioritized
     # for spot — ref: instance.go:116-133) against one market state. The
     # reference plan offers its price-blind ascending-size window with
-    # size-priority; ours offers price-ranked feasible pools.
-    greedy_cost = simulate_plan_cost(greedy_result, constraints, market, ZONES)
-    cost_solver_cost = simulate_plan_cost(cost_result, constraints, market, ZONES)
-    cost_ratio = cost_solver_cost / greedy_cost if greedy_cost else 1.0
-    # Secondary, optimistic accounting: every node at its cheapest advertised
-    # offering (assumes lowest-price allocation even for spot).
+    # size-priority; ours offers price-ranked feasible pools. Averaged over
+    # several workload/market draws so one lucky or unlucky market doesn't
+    # set the headline (seed 0's draw is in fact the least favorable).
+    ratios = []
+    for seed in range(4):
+        seed_pods, seed_catalog, seed_market = (
+            (pods, catalog, market) if seed == 0 else make_workload(seed=seed)
+        )
+        seed_groups = group_pods(seed_pods)
+        seed_fleet = build_fleet(seed_catalog, constraints, seed_pods)
+        seed_ours = solver.solve_encoded(seed_groups, seed_fleet)
+        seed_greedy = baseline_solver.solve_encoded(seed_groups, seed_fleet)
+        greedy_cost = simulate_plan_cost(seed_greedy, constraints, seed_market, ZONES)
+        ours_cost = simulate_plan_cost(seed_ours, constraints, seed_market, ZONES)
+        ratios.append(ours_cost / greedy_cost if greedy_cost else 1.0)
+    cost_ratio = float(np.mean(ratios))
+    # Secondary, optimistic accounting on the seed-0 draw: every node at its
+    # cheapest advertised offering (assumes lowest-price allocation even for
+    # spot).
     greedy_ideal = greedy_result.projected_cost()
     lowest_price_ratio = (
         cost_result.projected_cost() / greedy_ideal if greedy_ideal else 1.0
@@ -186,6 +199,7 @@ def main():
                 else "python",
                 "warmup_compile_s": round(warmup_s, 1),
                 "cost_ratio": round(cost_ratio, 4),
+                "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),
                 "pods": len(pods),
                 "types": len(catalog),

@@ -104,7 +104,7 @@ def _cost_fused_kernel(
     feasible_any = feasibility_mask(vectors, capacity, valid).any(axis=1)
     solvable = jnp.where(feasible_any, counts, 0)
     lp = lp_relax_solve(vectors, solvable, capacity, valid, prices, steps=lp_steps)
-    return rounds_ffd, rounds_cost, lp.assignment, feasible_any
+    return rounds_ffd, rounds_cost, lp.assignment, feasible_any, lp.objective
 
 
 def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool):
@@ -373,7 +373,12 @@ class CostSolver(Solver):
             pad_to(effective_prices, t_pad),
             lp_steps=self.lp_steps,
         )
-        rounds_ffd, rounds_cost, lp_assignment, feasible_any = _to_host(fused)
+        # Overlap with the device: the pool-price matrix depends only on the
+        # fleet, so build it while the kernel runs.
+        pool_zones, pool_prices = _pool_price_matrix(fleet)
+        rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
+            _to_host(fused)
+        )
 
         # Candidates stay in round form; only the winner pays the decode into
         # concrete per-node pod lists.
@@ -386,11 +391,6 @@ class CostSolver(Solver):
                         rounds.unschedulable[: groups.num_groups],
                     )
                 )
-        lp_candidate = self._realize_lp(lp_assignment, feasible_any, groups, fleet)
-        if lp_candidate is not None:
-            candidates.append(lp_candidate)
-        if not candidates:
-            return ffd.pack_groups(fleet, groups)
 
         # Score from rounds: a node's realized price is the cheapest of its
         # offered options, which for the CostSolver is the cheapest feasible
@@ -398,7 +398,6 @@ class CostSolver(Solver):
         # never wins on price. The option sets are memoized per (t, fill) so
         # the winning candidate's decode reuses the scoring pass's work.
         options_memo: dict = {}
-        pool_zones, pool_prices = _pool_price_matrix(fleet)
 
         def options_fn(t: int, fill: np.ndarray):
             # The anchor t only matters on the degenerate no-finite-pool path;
@@ -431,7 +430,25 @@ class CostSolver(Solver):
             )
             return (int(unschedulable_counts.sum()), cost, nodes)
 
-        best_rounds, best_unschedulable = min(candidates, key=score)
+        # The LP realization only adds fragmentation on top of the LP's own
+        # relaxed cost, so when a kernel candidate already meets the LP's
+        # fractional objective the (host-side, ~15ms) realization pass cannot
+        # win and is skipped.
+        scores = {id(c): score(c) for c in candidates}
+        best_kernel_cost = min(
+            (s[1] for s in scores.values() if s[0] == 0), default=np.inf
+        )
+        if not candidates or best_kernel_cost > float(lp_objective):
+            lp_candidate = self._realize_lp(
+                lp_assignment, feasible_any, groups, fleet
+            )
+            if lp_candidate is not None:
+                candidates.append(lp_candidate)
+                scores[id(lp_candidate)] = score(lp_candidate)
+        if not candidates:
+            return ffd.pack_groups(fleet, groups)
+
+        best_rounds, best_unschedulable = min(candidates, key=lambda c: scores[id(c)])
         return _decode_rounds(
             best_rounds, best_unschedulable, groups, fleet, options_fn=options_fn
         )
