@@ -1,0 +1,23 @@
+# Ref: the reference's Makefile test/battletest/build targets.
+
+.PHONY: test battletest proto native bench clean
+
+test:
+	python -m pytest tests/ -x -q
+
+# Randomized order + full output, the `make battletest` analogue.
+battletest:
+	python -m pytest tests/ -q -p no:randomly --tb=long
+
+proto:
+	protoc -I protos --python_out=karpenter_tpu/solver_service protos/solver.proto
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C native clean 2>/dev/null || true
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -146,15 +146,32 @@ def main():
     # Baseline: the reference algorithm (greedy FFD) as compiled host code —
     # the C++ packer (native/ffd.cc) when buildable, matching the reference's
     # compiled-Go hot loop; pure-Python greedy otherwise. Timed at the same
-    # boundary as the headline metric (solve_encoded on pre-built tensors) so
-    # Python encoding cost doesn't flatter either side.
+    # boundary as the headline metric (solve_encoded on pre-built tensors,
+    # warm process, repeated-call p50) so neither library load nor Python
+    # encoding cost flatters either side.
     from karpenter_tpu.models.solver import NativeSolver
     from karpenter_tpu.ops import native as native_mod
 
     baseline_solver = NativeSolver() if native_mod.available() else GreedySolver()
+    greedy_result = baseline_solver.solve_encoded(groups, fleet)  # warm: lib load
+    baseline_lat = []
+    for _ in range(5):
+        start = time.perf_counter()
+        baseline_solver.solve_encoded(groups, fleet)
+        baseline_lat.append((time.perf_counter() - start) * 1e3)
+    baseline_ms = float(np.percentile(baseline_lat, 50))
+
+    # The structural latency floor of this setup: one device->host sync on
+    # the (possibly tunneled) accelerator. Any solve that reads results back
+    # pays this once; on non-tunneled hardware it is ~sub-ms.
+    import jax
+    import jax.numpy as jnp
+
+    probe = jnp.zeros((8,), jnp.int32) + 1
+    jax.block_until_ready(probe)
     start = time.perf_counter()
-    greedy_result = baseline_solver.solve_encoded(groups, fleet)
-    baseline_ms = (time.perf_counter() - start) * 1e3
+    jax.device_get(probe)  # the same fetch path _to_host uses
+    device_fetch_floor_ms = (time.perf_counter() - start) * 1e3
 
     # Realized $/hr: both plans bought through the SAME fleet-allocation
     # simulator (lowest-price for on-demand, capacity-optimized-prioritized
@@ -165,13 +182,16 @@ def main():
     # set the headline (seed 0's draw is in fact the least favorable).
     ratios = []
     for seed in range(4):
-        seed_pods, seed_catalog, seed_market = (
-            (pods, catalog, market) if seed == 0 else make_workload(seed=seed)
-        )
-        seed_groups = group_pods(seed_pods)
-        seed_fleet = build_fleet(seed_catalog, constraints, seed_pods)
-        seed_ours = solver.solve_encoded(seed_groups, seed_fleet)
-        seed_greedy = baseline_solver.solve_encoded(seed_groups, seed_fleet)
+        if seed == 0:
+            # Seed 0's encode and both solves already happened above — reuse.
+            seed_market = market
+            seed_ours, seed_greedy = cost_result, greedy_result
+        else:
+            seed_pods, seed_catalog, seed_market = make_workload(seed=seed)
+            seed_groups = group_pods(seed_pods)
+            seed_fleet = build_fleet(seed_catalog, constraints, seed_pods)
+            seed_ours = solver.solve_encoded(seed_groups, seed_fleet)
+            seed_greedy = baseline_solver.solve_encoded(seed_groups, seed_fleet)
         greedy_cost = simulate_plan_cost(seed_greedy, constraints, seed_market, ZONES)
         ours_cost = simulate_plan_cost(seed_ours, constraints, seed_market, ZONES)
         ratios.append(ours_cost / greedy_cost if greedy_cost else 1.0)
@@ -198,6 +218,7 @@ def main():
                 if native_mod.available()
                 else "python",
                 "warmup_compile_s": round(warmup_s, 1),
+                "device_fetch_floor_ms": round(device_fetch_floor_ms, 1),
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),

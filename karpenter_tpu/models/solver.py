@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import abc
 import functools
-from typing import List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -107,18 +108,39 @@ def _cost_fused_kernel(
     return rounds_ffd, rounds_cost, lp.assignment, feasible_any, lp.objective
 
 
-def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool):
-    g_pad = bucket_size(groups.num_groups)
-    t_pad = bucket_size(fleet.num_types)
+def pad_kernel_args(vectors, counts, capacity, total, prices):
+    """Bucket-pad the six dense kernel inputs — THE padding/valid-mask
+    convention, shared by every dispatch site (in-process ffd/cost paths and
+    the sidecar) so they can't drift apart."""
+    g_pad = bucket_size(int(vectors.shape[0]))
+    t_pad = bucket_size(int(capacity.shape[0]))
+    return (
+        pad_to(vectors, g_pad),
+        pad_to(counts.astype(np.int32), g_pad),
+        pad_to(capacity, t_pad),
+        pad_to(total, t_pad),
+        pad_to(np.ones(int(capacity.shape[0]), bool), t_pad),
+        pad_to(prices, t_pad),
+    )
+
+
+def run_kernel_dense(vectors, counts, capacity, total, prices, mode: str, quirk: bool):
     return pack_kernel(
-        pad_to(groups.vectors, g_pad),
-        pad_to(groups.counts.astype(np.int32), g_pad),
-        pad_to(fleet.capacity, t_pad),
-        pad_to(fleet.total, t_pad),
-        pad_to(np.ones(fleet.num_types, bool), t_pad),
-        pad_to(fleet.prices, t_pad),
+        *pad_kernel_args(vectors, counts, capacity, total, prices),
         quirk=quirk,
         mode=mode,
+    )
+
+
+def _run_kernel(groups: PodGroups, fleet: InstanceFleet, mode: str, quirk: bool):
+    return run_kernel_dense(
+        groups.vectors,
+        groups.counts,
+        fleet.capacity,
+        fleet.total,
+        fleet.prices,
+        mode,
+        quirk,
     )
 
 
@@ -161,15 +183,19 @@ def _pool_price_matrix(fleet: InstanceFleet) -> Tuple[List[str], np.ndarray]:
     return zones, matrix
 
 
-def _cheapest_feasible_options(
+# A dense pool row: (type index, zone index, price) — the object-free form
+# the sidecar streams back; priority is the row's position in the list.
+PoolRow = Tuple[int, int, float]
+
+
+def _cheapest_feasible_pools(
     fill: np.ndarray,
     t: int,
-    groups: PodGroups,
-    fleet: InstanceFleet,
-    zones: Optional[List[str]] = None,
-    pool_prices: Optional[np.ndarray] = None,
-) -> Tuple[List[int], Optional[List[ffd.PoolOption]]]:
-    """Price-ranked launch options for a node with this fill.
+    vectors: np.ndarray,
+    capacity: np.ndarray,
+    pool_prices: np.ndarray,
+) -> Tuple[List[int], Optional[List[PoolRow]]]:
+    """Price-ranked launch options for a node with this fill (dense core).
 
     The reference offers the ascending-size window [t, t+20) as launch
     options (packer.go:178-180) with priority = window index — price-blind
@@ -180,10 +206,8 @@ def _cheapest_feasible_options(
     distinct types capped at MAX_INSTANCE_TYPES to match the reference's
     request budget), and let the allocation strategy choose among
     near-cheapest pools only. Returns (type indices, pool rows)."""
-    if zones is None or pool_prices is None:
-        zones, pool_prices = _pool_price_matrix(fleet)
-    demand = (fill.astype(np.float64)[:, None] * groups.vectors).sum(axis=0)
-    feasible = np.nonzero((fleet.capacity >= demand - 1e-6).all(axis=1))[0]
+    demand = (fill.astype(np.float64)[:, None] * vectors).sum(axis=0)
+    feasible = np.nonzero((capacity >= demand - 1e-6).all(axis=1))[0]
     candidate = pool_prices[feasible]  # [F, Z]
     flat = candidate.ravel()
     finite = np.isfinite(flat)
@@ -192,37 +216,65 @@ def _cheapest_feasible_options(
         return [t], None
     order = np.argsort(flat, kind="stable")
     order = order[finite[order]]
-    num_zones = len(zones)
+    num_zones = pool_prices.shape[1]
     cheapest = flat[order[0]]
     cutoff = cheapest * (1.0 + POOL_PRICE_BAND)
     ceiling = cheapest * MAX_POOL_PRICE_RATIO
     chosen_types: List[int] = []
     chosen_set: set = set()
-    pool_options: List[ffd.PoolOption] = []
+    pool_rows: List[PoolRow] = []
     for flat_index in order:
         price = float(flat[flat_index])
-        if len(pool_options) >= MAX_POOL_ROWS:
+        if len(pool_rows) >= MAX_POOL_ROWS:
             break
-        if price > cutoff and len(pool_options) >= MIN_POOL_ROWS:
+        if price > cutoff and len(pool_rows) >= MIN_POOL_ROWS:
             break
-        if price > ceiling and pool_options:
+        if price > ceiling and pool_rows:
             break
         ti = int(feasible[flat_index // num_zones])
-        zone = zones[flat_index % num_zones]
+        zi = int(flat_index % num_zones)
         if ti not in chosen_set:
             if len(chosen_types) >= ffd.MAX_INSTANCE_TYPES:
                 continue
             chosen_types.append(ti)
             chosen_set.add(ti)
-        pool_options.append(
-            ffd.PoolOption(
-                instance_type=fleet.instance_types[ti],
-                zone=zone,
-                price=price,
-                priority=len(pool_options),
-            )
+        pool_rows.append((ti, zi, price))
+    return chosen_types, pool_rows
+
+
+def _cheapest_feasible_options(
+    fill: np.ndarray,
+    t: int,
+    groups: PodGroups,
+    fleet: InstanceFleet,
+    zones: Optional[List[str]] = None,
+    pool_prices: Optional[np.ndarray] = None,
+) -> Tuple[List[int], Optional[List[ffd.PoolOption]]]:
+    """Object-level shell over _cheapest_feasible_pools."""
+    if zones is None or pool_prices is None:
+        zones, pool_prices = _pool_price_matrix(fleet)
+    type_indices, rows = _cheapest_feasible_pools(
+        fill, t, groups.vectors, fleet.capacity, pool_prices
+    )
+    return type_indices, pool_rows_to_options(rows, fleet, zones)
+
+
+def pool_rows_to_options(
+    rows: Optional[List[PoolRow]], fleet: InstanceFleet, zones: List[str]
+) -> Optional[List[ffd.PoolOption]]:
+    """Rehydrate dense pool rows into PoolOption objects on the fleet-holding
+    side of the solver boundary."""
+    if rows is None:
+        return None
+    return [
+        ffd.PoolOption(
+            instance_type=fleet.instance_types[ti],
+            zone=zones[zi],
+            price=price,
+            priority=i,
         )
-    return chosen_types, pool_options
+        for i, (ti, zi, price) in enumerate(rows)
+    ]
 
 
 def _decode_rounds(
@@ -237,7 +289,11 @@ def _decode_rounds(
 
     options_fn(t, fill) -> [type index] overrides the reference's
     ascending-size option window (the CostSolver passes its memoized
-    cheapest-feasible selector)."""
+    cheapest-feasible selector).
+
+    Per-node pod lists are LazyNodePods: decode records integer member
+    windows only; the ~50k-ref Python materialization happens when the bind
+    path iterates nodes, off the solve boundary's critical path."""
     cursors = [0] * groups.num_groups
     by_options = {}
     packings: List[ffd.Packing] = []
@@ -248,14 +304,12 @@ def _decode_rounds(
             options = [fleet.instance_types[i] for i in type_indices]
         else:
             options = fleet.instance_types[t : t + ffd.MAX_INSTANCE_TYPES]
-        filled_groups = [(int(g), int(fill[g])) for g in np.nonzero(fill > 0)[0]]
-        nodes = []
-        for _ in range(repl):
-            node_pods = []
-            for g, n in filled_groups:
-                node_pods.extend(groups.members[g][cursors[g] : cursors[g] + n])
-                cursors[g] += n
-            nodes.append(node_pods)
+        repl = int(repl)
+        slices = []
+        for g in np.nonzero(fill > 0)[0]:
+            g, n = int(g), int(fill[g])
+            slices.append((g, cursors[g], n))
+            cursors[g] += n * repl
         key = (
             tuple(it.name for it in options),
             tuple((p.instance_type.name, p.zone) for p in pool_opts)
@@ -265,10 +319,12 @@ def _decode_rounds(
         existing = by_options.get(key)
         if existing is not None:
             existing.node_quantity += repl
-            existing.pods_per_node.extend(nodes)
+            existing.pods_per_node.add_segment(repl, slices)
         else:
+            lazy = ffd.LazyNodePods(groups.members)
+            lazy.add_segment(repl, slices)
             packing = ffd.Packing(
-                pods_per_node=nodes,
+                pods_per_node=lazy,
                 instance_type_options=list(options),
                 node_quantity=repl,
                 pool_options=pool_opts,
@@ -334,11 +390,228 @@ class TPUSolver(Solver):
         )
 
 
+@dataclass
+class DenseSolveResult:
+    """Object-free cost-solve output — what crosses the solver boundary.
+
+    rounds: (type index, fill[G], replication) per launch round;
+    unschedulable: [G] pods per group that fit nowhere;
+    options: fill-bytes -> (type indices, pool rows) launch options for each
+    distinct fill appearing in rounds."""
+
+    rounds: List[Tuple[int, np.ndarray, int]]
+    unschedulable: np.ndarray
+    options: Dict[bytes, Tuple[List[int], Optional[List[PoolRow]]]]
+
+
+# Skip the host-side LP realization only when a kernel candidate beats the
+# LP's fractional objective by this much. The two sides are priced in
+# different models (round_price: mean offered pool row over all FEASIBLE
+# types; lp_objective: min list price over capacity-DOMINATING types — a
+# subset, so realized LP nodes can launch cheaper than the objective
+# suggests); the slack absorbs that gap instead of letting a nominally
+# dominated LP plan be skipped when it could still have won.
+LP_REALIZE_SLACK = 0.8
+
+
+def cost_solve_dense(
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+    prices: np.ndarray,
+    pool_prices,
+    lp_steps: int = 300,
+) -> Optional[DenseSolveResult]:
+    """The flagship solve on dense tensors only — shared by the in-process
+    CostSolver and the gRPC sidecar (which has no PodSpec/InstanceType
+    objects, just arrays off the wire). Returns None when no candidate packing
+    exists (caller falls back to host greedy).
+
+    Runs pure-greedy FFD, cost-greedy, and the LP-relaxation plan as ONE fused
+    accelerator computation, scores each candidate by expected realized $/hr,
+    and returns the winner's rounds + per-fill launch options.
+
+    pool_prices may be the [T, Z] array itself or a zero-arg callable
+    producing it: kernel dispatch is async, so a callable is evaluated while
+    the device computes (the in-process path hides the pure-Python matrix
+    build behind the kernel; the sidecar already has the array off the
+    wire)."""
+    num_groups = int(vectors.shape[0])
+    num_types = int(capacity.shape[0])
+
+    # Price model: a node packed for type t launches as the cheapest pool
+    # of ANY type whose capacity dominates t's (the plan offers the
+    # price-ranked feasible pools, _cheapest_feasible_pools), so the
+    # cost objective sees the dominating-type minimum price — the price
+    # the realization will actually pay, not t's own list price.
+    dominates = (
+        capacity[None, :, :] >= capacity[:, None, :] - 1e-6
+    ).all(axis=2)  # [T, T'] — t' can host any node packed for t
+    effective_prices = np.where(dominates, prices[None, :], np.inf).min(
+        axis=1
+    ).astype(np.float32)
+    fused = _cost_fused_kernel(
+        *pad_kernel_args(vectors, counts, capacity, total, effective_prices),
+        lp_steps=lp_steps,
+    )
+    # Overlap with the device: dispatch above is async, so host-side work
+    # that only depends on the fleet runs while the kernel computes.
+    if callable(pool_prices):
+        pool_prices = pool_prices()
+    rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
+        _to_host(fused)
+    )
+
+    # Candidates stay in round form; only the winner pays the decode into
+    # concrete per-node pod lists.
+    candidates: List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]] = []
+    for rounds in (rounds_ffd, rounds_cost):
+        if not bool(rounds.overflow):
+            candidates.append(
+                (
+                    _kernel_rounds_to_list(rounds, num_groups),
+                    rounds.unschedulable[:num_groups],
+                )
+            )
+
+    # Score from rounds: a node's realized price is the cheapest of its
+    # offered options, which for the cost solve is the cheapest feasible
+    # type for that fill. A candidate that leaves more pods unschedulable
+    # never wins on price. The option sets are memoized per fill so the
+    # winning candidate's decode reuses the scoring pass's work.
+    options_memo: Dict[bytes, Tuple[List[int], Optional[List[PoolRow]]]] = {}
+
+    def options_for(t: int, fill: np.ndarray):
+        # The anchor t only matters on the degenerate no-finite-pool path;
+        # keying by fill alone lets identical fills packed for different
+        # types share one ranking pass.
+        key = fill.tobytes()
+        options = options_memo.get(key)
+        if options is None:
+            options = _cheapest_feasible_pools(
+                fill, t, vectors, capacity, pool_prices
+            )
+            options_memo[key] = options
+        return options
+
+    def round_price(t: int, fill: np.ndarray) -> float:
+        """Expected realized price of one node: capacity-optimized
+        allocation can land on any offered row and the solver cannot see
+        pool depths, so candidates are ranked by the mean offered-row
+        price, not the optimistic cheapest row."""
+        type_indices, pool_rows = options_for(t, fill)
+        if pool_rows:
+            return float(np.mean([price for _, _, price in pool_rows]))
+        return float(prices[type_indices].min())
+
+    def score(candidate):
+        round_list, unschedulable_counts = candidate
+        nodes = sum(repl for _, _, repl in round_list)
+        cost = sum(
+            repl * round_price(t, fill) for t, fill, repl in round_list
+        )
+        return (int(unschedulable_counts.sum()), cost, nodes)
+
+    # The LP realization only adds fragmentation on top of the LP's own
+    # relaxed cost, so a kernel candidate clearly under the LP's fractional
+    # objective makes the (host-side, ~15ms) realization pass very unlikely
+    # to win; LP_REALIZE_SLACK covers the price-model gap between the two.
+    scores = {id(c): score(c) for c in candidates}
+    best_kernel_cost = min(
+        (s[1] for s in scores.values() if s[0] == 0), default=np.inf
+    )
+    if not candidates or best_kernel_cost > float(lp_objective) * LP_REALIZE_SLACK:
+        lp_candidate = _realize_lp_dense(
+            lp_assignment, feasible_any, vectors, counts, capacity, total
+        )
+        if lp_candidate is not None:
+            candidates.append(lp_candidate)
+            scores[id(lp_candidate)] = score(lp_candidate)
+    if not candidates:
+        return None
+
+    best_rounds, best_unschedulable = min(candidates, key=lambda c: scores[id(c)])
+    # Materialize options for every round of the winner (scoring already
+    # computed them; this is a dict lookup).
+    options: Dict[bytes, Tuple[List[int], Optional[List[PoolRow]]]] = {}
+    for t, fill, _ in best_rounds:
+        options[fill.tobytes()] = options_for(t, fill)
+    return DenseSolveResult(
+        rounds=best_rounds, unschedulable=best_unschedulable, options=options
+    )
+
+
+def _realize_lp_dense(
+    lp_assignment: np.ndarray,
+    feasible_any: np.ndarray,
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+) -> Optional[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]:
+    """Integerize the relaxed [G, T] assignment (already fetched to host)
+    and realize it as greedy per-type node fills."""
+    num = int(vectors.shape[0])
+    counts = counts.astype(np.int64)
+    unschedulable_counts = np.where(feasible_any[:num], 0, counts)
+    solvable_counts = np.where(feasible_any[:num], counts, 0)
+    if solvable_counts.sum() == 0:
+        return None
+    padded_solvable = np.zeros(lp_assignment.shape[0], dtype=np.int64)
+    padded_solvable[:num] = solvable_counts
+    # Concentrate before rounding: softmax leaves a long tail of tiny
+    # per-type shards that round into poorly-filled single nodes. Keep
+    # each group's heaviest types (up to 8) and renormalize — the
+    # realized node count drops sharply at negligible objective cost.
+    lp_assignment = np.asarray(lp_assignment, dtype=np.float64).copy()
+    for g in range(num):
+        row = lp_assignment[g]
+        total_mass = row.sum()
+        if total_mass <= 0:
+            continue
+        keep = np.argsort(-row)[:8]
+        kept = np.zeros_like(row)
+        kept[keep] = row[keep]
+        kept_mass = kept.sum()
+        if kept_mass > 0:
+            lp_assignment[g] = kept * (total_mass / kept_mass)
+    assignment = round_assignment(lp_assignment, padded_solvable)
+
+    # Realize the plan: per type, greedily fill nodes (pure greedy, no
+    # quirk) with that type's assigned pods.
+    round_list: List[Tuple[int, np.ndarray, int]] = []
+    num_types = int(capacity.shape[0])
+    for t in range(num_types):
+        counts_t = assignment[:num, t].astype(np.int64).copy()
+        guard = 0
+        while counts_t.sum() > 0:
+            fill = ffd.fill_node(
+                capacity[t],
+                total[t],
+                vectors,
+                counts_t,
+                quirk=False,
+            )
+            if fill.sum() == 0:
+                # Should not happen (feasibility pre-checked); bail out.
+                return None
+            repl_per_group = np.where(fill > 0, counts_t // np.maximum(fill, 1), np.iinfo(np.int64).max)
+            repl = max(1, int(repl_per_group.min()))
+            round_list.append((t, fill.copy(), repl))
+            counts_t -= repl * fill
+            guard += 1
+            if guard > 4 * num + 16:
+                return None
+    return round_list, unschedulable_counts
+
+
 class CostSolver(Solver):
     """The flagship: runs pure-greedy FFD, cost-greedy, and the LP-relaxation
     plan on TPU, returns the cheapest feasible packing. Because greedy is
     always among the candidates, projected $/hr can only match or beat the
-    baseline."""
+    baseline. Thin object shell over cost_solve_dense — the same core the
+    gRPC sidecar serves."""
 
     def __init__(self, lp_steps: int = 300):
         self.lp_steps = lp_steps
@@ -347,170 +620,48 @@ class CostSolver(Solver):
         if fleet.num_types == 0 or groups.num_groups == 0:
             return ffd.pack_groups(fleet, groups)
 
-        # One fused accelerator computation (greedy rounds + cost rounds + LP
-        # relaxation) and ONE device->host fetch: round-trip latency to the
-        # device, not compute, dominates this problem size.
-        #
-        # Price model: a node packed for type t launches as the cheapest pool
-        # of ANY type whose capacity dominates t's (the plan offers the
-        # price-ranked feasible pools, _cheapest_feasible_options), so the
-        # cost objective sees the dominating-type minimum price — the price
-        # the realization will actually pay, not t's own list price.
-        dominates = (
-            fleet.capacity[None, :, :] >= fleet.capacity[:, None, :] - 1e-6
-        ).all(axis=2)  # [T, T'] — t' can host any node packed for t
-        effective_prices = np.where(dominates, fleet.prices[None, :], np.inf).min(
-            axis=1
-        ).astype(np.float32)
-        g_pad = bucket_size(groups.num_groups)
-        t_pad = bucket_size(fleet.num_types)
-        fused = _cost_fused_kernel(
-            pad_to(groups.vectors, g_pad),
-            pad_to(groups.counts.astype(np.int32), g_pad),
-            pad_to(fleet.capacity, t_pad),
-            pad_to(fleet.total, t_pad),
-            pad_to(np.ones(fleet.num_types, bool), t_pad),
-            pad_to(effective_prices, t_pad),
+        # The matrix build is handed down as a thunk so it runs while the
+        # fused kernel computes on the device. cost_solve_dense guarantees
+        # the thunk runs before it returns non-None; the sentinel check
+        # below makes that contract explicit rather than an IndexError later.
+        pool_zones: Optional[List[str]] = None
+
+        def pool_prices_fn():
+            nonlocal pool_zones
+            pool_zones, matrix = _pool_price_matrix(fleet)
+            return matrix
+
+        dense = cost_solve_dense(
+            groups.vectors,
+            groups.counts,
+            fleet.capacity,
+            fleet.total,
+            fleet.prices,
+            pool_prices_fn,
             lp_steps=self.lp_steps,
         )
-        # Overlap with the device: the pool-price matrix depends only on the
-        # fleet, so build it while the kernel runs.
-        pool_zones, pool_prices = _pool_price_matrix(fleet)
-        rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
-            _to_host(fused)
-        )
-
-        # Candidates stay in round form; only the winner pays the decode into
-        # concrete per-node pod lists.
-        candidates: List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]] = []
-        for rounds in (rounds_ffd, rounds_cost):
-            if not bool(rounds.overflow):
-                candidates.append(
-                    (
-                        _kernel_rounds_to_list(rounds, groups.num_groups),
-                        rounds.unschedulable[: groups.num_groups],
-                    )
-                )
-
-        # Score from rounds: a node's realized price is the cheapest of its
-        # offered options, which for the CostSolver is the cheapest feasible
-        # type for that fill. A candidate that leaves more pods unschedulable
-        # never wins on price. The option sets are memoized per (t, fill) so
-        # the winning candidate's decode reuses the scoring pass's work.
-        options_memo: dict = {}
-
-        def options_fn(t: int, fill: np.ndarray):
-            # The anchor t only matters on the degenerate no-finite-pool path;
-            # keying by fill alone lets identical fills packed for different
-            # types share one ranking pass.
-            key = fill.tobytes()
-            options = options_memo.get(key)
-            if options is None:
-                options = _cheapest_feasible_options(
-                    fill, t, groups, fleet, pool_zones, pool_prices
-                )
-                options_memo[key] = options
-            return options
-
-        def round_price(t: int, fill: np.ndarray) -> float:
-            """Expected realized price of one node: capacity-optimized
-            allocation can land on any offered row and the solver cannot see
-            pool depths, so candidates are ranked by the mean offered-row
-            price, not the optimistic cheapest row."""
-            type_indices, pool_opts = options_fn(t, fill)
-            if pool_opts:
-                return float(np.mean([p.price for p in pool_opts]))
-            return float(fleet.prices[type_indices].min())
-
-        def score(candidate):
-            round_list, unschedulable_counts = candidate
-            nodes = sum(repl for _, _, repl in round_list)
-            cost = sum(
-                repl * round_price(t, fill) for t, fill, repl in round_list
-            )
-            return (int(unschedulable_counts.sum()), cost, nodes)
-
-        # The LP realization only adds fragmentation on top of the LP's own
-        # relaxed cost, so when a kernel candidate already meets the LP's
-        # fractional objective the (host-side, ~15ms) realization pass cannot
-        # win and is skipped.
-        scores = {id(c): score(c) for c in candidates}
-        best_kernel_cost = min(
-            (s[1] for s in scores.values() if s[0] == 0), default=np.inf
-        )
-        if not candidates or best_kernel_cost > float(lp_objective):
-            lp_candidate = self._realize_lp(
-                lp_assignment, feasible_any, groups, fleet
-            )
-            if lp_candidate is not None:
-                candidates.append(lp_candidate)
-                scores[id(lp_candidate)] = score(lp_candidate)
-        if not candidates:
+        if dense is None:
             return ffd.pack_groups(fleet, groups)
+        if pool_zones is None:
+            raise AssertionError(
+                "cost_solve_dense returned a plan without evaluating pool_prices"
+            )
+        return decode_dense_result(dense, groups, fleet, pool_zones)
 
-        best_rounds, best_unschedulable = min(candidates, key=lambda c: scores[id(c)])
-        return _decode_rounds(
-            best_rounds, best_unschedulable, groups, fleet, options_fn=options_fn
-        )
 
-    def _realize_lp(
-        self,
-        lp_assignment: np.ndarray,
-        feasible_any: np.ndarray,
-        groups: PodGroups,
-        fleet: InstanceFleet,
-    ) -> Optional[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]:
-        """Integerize the relaxed [G, T] assignment (already fetched to host)
-        and realize it as greedy per-type node fills."""
-        num = groups.num_groups
-        counts = groups.counts.astype(np.int64)
-        unschedulable_counts = np.where(feasible_any[:num], 0, counts)
-        solvable_counts = np.where(feasible_any[:num], counts, 0)
-        if solvable_counts.sum() == 0:
-            return None
-        padded_solvable = np.zeros(lp_assignment.shape[0], dtype=np.int64)
-        padded_solvable[:num] = solvable_counts
-        # Concentrate before rounding: softmax leaves a long tail of tiny
-        # per-type shards that round into poorly-filled single nodes. Keep
-        # each group's heaviest types (up to 8) and renormalize — the
-        # realized node count drops sharply at negligible objective cost.
-        lp_assignment = np.asarray(lp_assignment, dtype=np.float64).copy()
-        for g in range(num):
-            row = lp_assignment[g]
-            total_mass = row.sum()
-            if total_mass <= 0:
-                continue
-            keep = np.argsort(-row)[:8]
-            kept = np.zeros_like(row)
-            kept[keep] = row[keep]
-            kept_mass = kept.sum()
-            if kept_mass > 0:
-                lp_assignment[g] = kept * (total_mass / kept_mass)
-        assignment = round_assignment(lp_assignment, padded_solvable)
+def decode_dense_result(
+    dense: DenseSolveResult,
+    groups: PodGroups,
+    fleet: InstanceFleet,
+    zones: List[str],
+) -> ffd.PackResult:
+    """Rehydrate a DenseSolveResult into a PackResult on the object-holding
+    side of the solver boundary (in-process or the sidecar's client)."""
 
-        # Realize the plan: per type, greedily fill nodes (pure greedy, no
-        # quirk) with that type's assigned pods.
-        round_list: List[Tuple[int, np.ndarray, int]] = []
-        num_groups = groups.num_groups
-        for t in range(fleet.num_types):
-            counts_t = assignment[:num_groups, t].astype(np.int64).copy()
-            guard = 0
-            while counts_t.sum() > 0:
-                fill = ffd.fill_node(
-                    fleet.capacity[t],
-                    fleet.total[t],
-                    groups.vectors,
-                    counts_t,
-                    quirk=False,
-                )
-                if fill.sum() == 0:
-                    # Should not happen (feasibility pre-checked); bail out.
-                    return None
-                repl_per_group = np.where(fill > 0, counts_t // np.maximum(fill, 1), np.iinfo(np.int64).max)
-                repl = max(1, int(repl_per_group.min()))
-                round_list.append((t, fill.copy(), repl))
-                counts_t -= repl * fill
-                guard += 1
-                if guard > 4 * num_groups + 16:
-                    return None
-        return round_list, unschedulable_counts
+    def options_fn(t: int, fill: np.ndarray):
+        type_indices, rows = dense.options[fill.tobytes()]
+        return type_indices, pool_rows_to_options(rows, fleet, zones)
+
+    return _decode_rounds(
+        dense.rounds, dense.unschedulable, groups, fleet, options_fn=options_fn
+    )

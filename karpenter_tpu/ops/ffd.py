@@ -57,11 +57,65 @@ class PoolOption:
     priority: int
 
 
+class LazyNodePods:
+    """Per-node pod lists materialized on first access.
+
+    Distributing 50k PodSpec refs into per-node lists costs tens of ms of
+    pure Python; the solve boundary only needs the *plan* (fills, counts,
+    options). Segments record (replication, [(group, start, n)]) windows over
+    groups.members — integer bookkeeping at decode time — and the concrete
+    lists are built lazily when the bind path (or a test) iterates them.
+    Within a replicated segment node k takes members[g][start+k*n : start+(k+1)*n],
+    matching the eager decode's sequential cursor order exactly."""
+
+    def __init__(self, members):
+        self._members = members
+        self._segments: List[Tuple[int, List[Tuple[int, int, int]]]] = []
+        self._cache: Optional[List[List[PodSpec]]] = None
+
+    def add_segment(self, repl: int, slices: List[Tuple[int, int, int]]) -> None:
+        self._segments.append((repl, slices))
+        self._cache = None
+
+    def _materialize(self) -> List[List[PodSpec]]:
+        if self._cache is None:
+            nodes: List[List[PodSpec]] = []
+            for repl, slices in self._segments:
+                for k in range(repl):
+                    node: List[PodSpec] = []
+                    for g, start, n in slices:
+                        node.extend(
+                            self._members[g][start + k * n : start + (k + 1) * n]
+                        )
+                    nodes.append(node)
+            self._cache = nodes
+        return self._cache
+
+    def __len__(self) -> int:
+        return sum(repl for repl, _ in self._segments)
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __getitem__(self, index):
+        return self._materialize()[index]
+
+    def __eq__(self, other):
+        try:
+            return list(self) == list(other)
+        except TypeError:
+            return NotImplemented
+
+
 @dataclass
 class Packing:
-    """One node shape: pods per node, viable instance types, node count."""
+    """One node shape: pods per node, viable instance types, node count.
 
-    pods_per_node: List[List[PodSpec]]
+    pods_per_node is a plain list on the eager path (pack_groups) and a
+    LazyNodePods on solver-decoded packings — consumers iterate/len/index,
+    they don't mutate."""
+
+    pods_per_node: "Sequence[List[PodSpec]]"
     instance_type_options: List[InstanceType]
     node_quantity: int = 1
     # Cost-aware plans additionally pin pool-level override rows (cheapest
@@ -207,6 +261,46 @@ def pack_groups(fleet: InstanceFleet, groups: PodGroups) -> PackResult:
             by_options[key] = packing
             packings.append(packing)
     return PackResult(packings=packings, unschedulable=unschedulable)
+
+
+def pack_rounds_dense(
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+    quirk: bool = True,
+) -> Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]:
+    """pack_groups' round loop on bare arrays — (rounds, unschedulable counts)
+    in the decode format the TPU kernel and native packer emit. This is the
+    object-free last-resort path for the solver sidecar, which holds tensors
+    off the wire and no PodSpec/InstanceType objects."""
+    counts = counts.astype(np.int64).copy()
+    num_groups, num_types = int(vectors.shape[0]), int(capacity.shape[0])
+    rounds: List[Tuple[int, np.ndarray, int]] = []
+    unschedulable = np.zeros(num_groups, dtype=np.int64)
+    if num_types == 0:
+        unschedulable += counts
+        return rounds, unschedulable
+    last = num_types - 1
+    while counts.sum() > 0:
+        upper = fill_node(capacity[last], total[last], vectors, counts, quirk=quirk)
+        max_packed = int(upper.sum())
+        if max_packed == 0:
+            g = int(np.nonzero(counts > 0)[0][0])
+            unschedulable[g] += 1
+            counts[g] -= 1
+            continue
+        for t in range(num_types):
+            packed = (
+                upper
+                if t == last
+                else fill_node(capacity[t], total[t], vectors, counts, quirk=quirk)
+            )
+            if int(packed.sum()) == max_packed:
+                rounds.append((t, packed.astype(np.int64), 1))
+                counts -= packed
+                break
+    return rounds, unschedulable
 
 
 def pack(

@@ -132,7 +132,13 @@ class LeaderLock:
             self._file = None
 
 
-def make_solver(name: str):
+def make_solver(name: str, endpoint: str = ""):
+    if name == "remote":
+        # The solver-sidecar plugin boundary: solve RPCs to `endpoint`, host
+        # greedy fallback + 30s blackout when it's unreachable.
+        from karpenter_tpu.solver_service.client import RemoteSolver
+
+        return RemoteSolver(endpoint)
     if name == "greedy":
         return GreedySolver()
     if name == "native":
@@ -162,7 +168,7 @@ class Manager:
         self.cloud = cloud
         self.options = options
         self.log = klog.named("manager")
-        solver = make_solver(options.solver)
+        solver = make_solver(options.solver, options.solver_endpoint)
         self.provisioning = ProvisioningController(cluster, cloud, solver)
         self.selection = SelectionController(cluster, self.provisioning)
         self.termination = TerminationController(cluster, cloud)

@@ -1,0 +1,165 @@
+"""RemoteSolver: the control plane's side of the solver-plugin boundary.
+
+A `Solver` implementation that ships each schedule's densified problem to the
+sidecar and rehydrates the returned rounds/options against the fleet objects
+it holds. When the sidecar is unreachable or errors, it degrades to the
+in-process compiled-host greedy packer and blacks out the endpoint for
+BLACKOUT_SECONDS before trying again — the same failure-detection pattern the
+reference applies to exhausted capacity pools (ICE blackout cache,
+ref: aws/instancetypes.go:37,174-183).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import grpc
+import numpy as np
+
+from karpenter_tpu.models.solver import (
+    NativeSolver,
+    Solver,
+    _decode_rounds,
+    _pool_price_matrix,
+    pool_rows_to_options,
+)
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops.encode import InstanceFleet, PodGroups
+from karpenter_tpu.solver_service import solver_pb2 as pb
+from karpenter_tpu.solver_service import wire
+from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.metrics import REGISTRY
+
+log = klog.named("remote-solver")
+
+# Endpoint blackout after a failed RPC (the ICE-cache pattern).
+BLACKOUT_SECONDS = 30.0
+# Generous per-solve deadline: the 50k x 400 north-star config solves in
+# ~110ms; anything past this is a wedged sidecar, not a slow solve.
+DEFAULT_TIMEOUT_SECONDS = 10.0
+
+_RPC_HISTOGRAM = REGISTRY.histogram(
+    "solver_rpc_duration_seconds",
+    "Wall time of sidecar Solve RPCs",
+    labels=("outcome",),
+)
+
+
+class RemoteSolver(Solver):
+    def __init__(
+        self,
+        endpoint: str,
+        mode: str = "cost",
+        lp_steps: int = 300,
+        quirk: bool = False,
+        fallback: Optional[Solver] = None,
+        timeout_s: float = DEFAULT_TIMEOUT_SECONDS,
+        blackout_s: float = BLACKOUT_SECONDS,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.endpoint = endpoint
+        self.mode = mode
+        self.lp_steps = lp_steps
+        self.quirk = quirk
+        self.fallback = fallback or NativeSolver()
+        self.timeout_s = timeout_s
+        self.blackout_s = blackout_s
+        self.clock = clock
+        self._blackout_until = -float("inf")
+        self._channel = grpc.insecure_channel(endpoint)
+        self._solve_rpc = self._channel.unary_unary(
+            wire.SOLVE_METHOD,
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=pb.SolveResponse.FromString,
+        )
+        self._health_rpc = self._channel.unary_unary(
+            wire.HEALTH_METHOD,
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+
+    def healthy(self, timeout_s: float = 2.0) -> Optional[pb.HealthResponse]:
+        try:
+            return self._health_rpc(pb.HealthRequest(), timeout=timeout_s)
+        except grpc.RpcError:
+            return None
+
+    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
+        if self.clock() < self._blackout_until:
+            return self.fallback.solve_encoded(groups, fleet)
+
+        zones, pool_prices = _pool_price_matrix(fleet)
+        request = pb.SolveRequest(
+            group_vectors=wire.encode_tensor(groups.vectors),
+            group_counts=wire.encode_tensor(groups.counts.astype(np.int32)),
+            capacity=wire.encode_tensor(fleet.capacity),
+            total=wire.encode_tensor(fleet.total),
+            prices=wire.encode_tensor(fleet.prices),
+            pool_prices=wire.encode_tensor(pool_prices),
+            zones=zones,
+            capacity_type=fleet.capacity_type,
+            mode=self.mode,
+            lp_steps=self.lp_steps,
+            quirk=self.quirk,
+        )
+        start = self.clock()
+        try:
+            response = self._solve_rpc(request, timeout=self.timeout_s)
+        except grpc.RpcError as error:
+            _RPC_HISTOGRAM.observe(self.clock() - start, "error")
+            self._blackout_until = self.clock() + self.blackout_s
+            log.warning(
+                "sidecar %s unavailable (%s); host greedy for %.0fs",
+                self.endpoint,
+                getattr(error, "code", lambda: error)(),
+                self.blackout_s,
+            )
+            return self.fallback.solve_encoded(groups, fleet)
+        _RPC_HISTOGRAM.observe(self.clock() - start, "ok")
+        return self._decode(response, groups, fleet, zones)
+
+    @staticmethod
+    def _decode(
+        response: pb.SolveResponse,
+        groups: PodGroups,
+        fleet: InstanceFleet,
+        zones,
+    ) -> ffd.PackResult:
+        rounds = [
+            (
+                round.type_index,
+                wire.decode_tensor(round.fill),
+                round.replication,
+            )
+            for round in response.rounds
+        ]
+        unschedulable = wire.decode_tensor(response.unschedulable)
+
+        # fill bytes -> OptionSet (the server dedups option sets by fill, so
+        # the mapping is well-defined); -1 rounds use the reference window.
+        option_for_fill = {}
+        for round in response.rounds:
+            if round.option_set >= 0:
+                option_for_fill[round.fill.data] = response.option_sets[
+                    round.option_set
+                ]
+
+        def options_fn(t: int, fill: np.ndarray):
+            option_set = option_for_fill.get(fill.astype(np.int64).tobytes())
+            if option_set is None:
+                upper = min(t + ffd.MAX_INSTANCE_TYPES, fleet.num_types)
+                return list(range(t, upper)), None
+            rows = (
+                [(p.type_index, p.zone_index, p.price) for p in option_set.pools]
+                if option_set.has_pools
+                else None
+            )
+            return list(option_set.type_indices), pool_rows_to_options(
+                rows, fleet, zones
+            )
+
+        return _decode_rounds(rounds, unschedulable, groups, fleet, options_fn)
+
+    def close(self) -> None:
+        self._channel.close()

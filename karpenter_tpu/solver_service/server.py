@@ -1,0 +1,225 @@
+"""The solver sidecar: a stateless gRPC service owning the accelerator.
+
+Ref: SURVEY.md §2.7 / §7 step 5 — the north star's `pkg/cloudprovider/solver`
+plugin analogue. The control plane (any process, any language with protobuf)
+sends one SolveRequest per schedule; the sidecar runs the fused TPU cost
+solve (models/solver.cost_solve_dense) and streams back launch rounds +
+price-ranked pool options as indices. No request state survives a call
+(ref: SURVEY.md §5 checkpoint/resume — the reference keeps all state in the
+cluster API; the sidecar keeps none at all), so a crashed sidecar is replaced
+by simply restarting it; the client meanwhile degrades to host greedy.
+
+Run: python -m karpenter_tpu.solver_service.server --port 9090
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from karpenter_tpu.models import solver as solver_models
+from karpenter_tpu.ops import ffd, native
+from karpenter_tpu.solver_service import solver_pb2 as pb
+from karpenter_tpu.solver_service import wire
+from karpenter_tpu.utils import logging as klog
+
+log = klog.named("solver-server")
+
+
+def _host_rounds(vectors, counts, capacity, total, quirk):
+    """Compiled-host FFD with pure-Python fallback — the no-accelerator path."""
+    result = native.ffd_pack_rounds(
+        vectors, counts.astype(np.int64), capacity, total, quirk=quirk
+    )
+    if result is not None:
+        return result
+    return ffd.pack_rounds_dense(vectors, counts, capacity, total, quirk=quirk)
+
+
+def _encode_rounds(response, rounds, options_by_fill=None):
+    """Fill Round/OptionSet messages; option sets dedup by fill bytes."""
+    set_index: dict = {}
+    for t, fill, repl in rounds:
+        option_set = -1
+        if options_by_fill is not None:
+            # Key from the solver's own fill array (kernel fills are i32, LP
+            # fills i64) BEFORE widening for the wire.
+            key = fill.tobytes()
+            option_set = set_index.get(key)
+            if option_set is None:
+                type_indices, pool_rows = options_by_fill[key]
+                message = pb.OptionSet(
+                    type_indices=list(type_indices),
+                    has_pools=pool_rows is not None,
+                )
+                if pool_rows is not None:
+                    for ti, zi, price in pool_rows:
+                        message.pools.add(type_index=ti, zone_index=zi, price=price)
+                option_set = len(response.option_sets)
+                response.option_sets.append(message)
+                set_index[key] = option_set
+        response.rounds.add(
+            type_index=int(t),
+            fill=wire.encode_tensor(fill.astype(np.int64)),
+            replication=int(repl),
+            option_set=option_set,
+        )
+
+
+class _Handler:
+    """RPC implementations. gRPC handlers are hand-wired generic method
+    handlers (no generated stubs — grpc_tools isn't vendored)."""
+
+    def __init__(self):
+        self.solves = 0
+        self._lock = threading.Lock()
+
+    def solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        start = time.perf_counter()
+        vectors = wire.decode_tensor(request.group_vectors)
+        counts = wire.decode_tensor(request.group_counts)
+        capacity = wire.decode_tensor(request.capacity)
+        total = wire.decode_tensor(request.total)
+        prices = wire.decode_tensor(request.prices)
+
+        response = pb.SolveResponse()
+        num_groups = int(vectors.shape[0])
+        if num_groups == 0 or capacity.shape[0] == 0:
+            # Nothing to pack / nothing to pack onto: every pod is
+            # unschedulable, mirroring pack_groups' empty-fleet path.
+            response.solver = "empty"
+            response.unschedulable.CopyFrom(
+                wire.encode_tensor(counts.astype(np.int64))
+            )
+            response.solve_ms = (time.perf_counter() - start) * 1e3
+            return response
+
+        mode = request.mode or "cost"
+        if mode == "cost":
+            pool_prices = wire.decode_tensor(request.pool_prices)
+            dense = solver_models.cost_solve_dense(
+                vectors,
+                counts,
+                capacity,
+                total,
+                prices,
+                pool_prices,
+                lp_steps=int(request.lp_steps) or 300,
+            )
+            if dense is None:
+                rounds, unschedulable = _host_rounds(
+                    vectors, counts, capacity, total, quirk=True
+                )
+                response.solver = "host-greedy"
+                response.fallback = True
+                _encode_rounds(response, rounds)
+            else:
+                response.solver = "tpu-cost"
+                _encode_rounds(response, dense.rounds, dense.options)
+                unschedulable = dense.unschedulable
+        elif mode == "ffd":
+            rounds, unschedulable, used = self._ffd_rounds(
+                vectors, counts, capacity, total, prices, request.quirk
+            )
+            response.solver = used
+            response.fallback = used != "tpu-ffd"
+            _encode_rounds(response, rounds)
+        else:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"unknown mode {mode!r}"
+            )
+
+        response.unschedulable.CopyFrom(
+            wire.encode_tensor(np.asarray(unschedulable, dtype=np.int64))
+        )
+        response.solve_ms = (time.perf_counter() - start) * 1e3
+        with self._lock:
+            self.solves += 1
+        return response
+
+    @staticmethod
+    def _ffd_rounds(vectors, counts, capacity, total, prices, quirk):
+        """Reference-parity FFD on the accelerator, host fallback on overflow."""
+        num_groups = int(vectors.shape[0])
+        rounds = solver_models._to_host(
+            solver_models.run_kernel_dense(
+                vectors, counts, capacity, total, prices, mode="ffd", quirk=quirk
+            )
+        )
+        if bool(rounds.overflow):
+            round_list, unschedulable = _host_rounds(
+                vectors, counts, capacity, total, quirk=quirk
+            )
+            return round_list, unschedulable, "host-greedy"
+        return (
+            solver_models._kernel_rounds_to_list(rounds, num_groups),
+            rounds.unschedulable[:num_groups],
+            "tpu-ffd",
+        )
+
+    def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        import jax
+
+        return pb.HealthResponse(
+            status="ok",
+            platform=jax.default_backend(),
+            device_count=jax.device_count(),
+            solves=self.solves,
+        )
+
+
+class SolverServer:
+    """In-process harness around the gRPC server — tests start it on port 0
+    and read back the bound port; __main__ serves forever."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1", workers: int = 4):
+        self.handler = _Handler()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=workers))
+        method_handlers = {
+            "Solve": grpc.unary_unary_rpc_method_handler(
+                self.handler.solve,
+                request_deserializer=pb.SolveRequest.FromString,
+                response_serializer=pb.SolveResponse.SerializeToString,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                self.handler.health,
+                request_deserializer=pb.HealthRequest.FromString,
+                response_serializer=pb.HealthResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(wire.SERVICE, method_handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    def start(self) -> "SolverServer":
+        self._server.start()
+        log.info("solver sidecar listening on :%d", self.port)
+        return self
+
+    def stop(self, grace: Optional[float] = None) -> None:
+        self._server.stop(grace).wait()
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="karpenter-tpu solver sidecar")
+    parser.add_argument("--port", type=int, default=9090)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--workers", type=int, default=4)
+    args = parser.parse_args(argv)
+    server = SolverServer(port=args.port, host=args.host, workers=args.workers)
+    server.start()
+    server.wait()
+
+
+if __name__ == "__main__":
+    main()

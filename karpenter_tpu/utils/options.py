@@ -30,7 +30,8 @@ class Options:
     health_probe_port: int = 8081  # ref: manager.go:52-57
     kube_client_qps: float = 200.0  # ref: options.go:33
     kube_client_burst: int = 300  # ref: options.go:34
-    solver: str = "cost"  # cost | ffd | greedy | native
+    solver: str = "cost"  # cost | ffd | greedy | native | remote
+    solver_endpoint: str = ""  # remote: host:port of the solver sidecar
     cloud_provider: str = "fake"
     leader_election: bool = True
     log_level: str = "info"
@@ -41,8 +42,10 @@ class Options:
             errors.append("CLUSTER_NAME is required")
         if self.metrics_port == self.health_probe_port:
             errors.append("metrics and health ports must differ")
-        if self.solver not in ("cost", "ffd", "greedy", "native"):
+        if self.solver not in ("cost", "ffd", "greedy", "native", "remote"):
             errors.append(f"unknown solver {self.solver!r}")
+        if self.solver == "remote" and not self.solver_endpoint:
+            errors.append("solver=remote requires --solver-endpoint")
         if errors:
             raise OptionsError("; ".join(errors))
 
@@ -62,6 +65,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         "--kube-client-burst", type=int, default=int(_env("KUBE_CLIENT_BURST", "300"))
     )
     parser.add_argument("--solver", default=_env("KARPENTER_SOLVER", "cost"))
+    parser.add_argument(
+        "--solver-endpoint", default=_env("KARPENTER_SOLVER_ENDPOINT", "")
+    )
     parser.add_argument("--cloud-provider", default=_env("CLOUD_PROVIDER", "fake"))
     parser.add_argument(
         "--no-leader-election", action="store_true",
@@ -77,6 +83,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         kube_client_qps=args.kube_client_qps,
         kube_client_burst=args.kube_client_burst,
         solver=args.solver,
+        solver_endpoint=args.solver_endpoint,
         cloud_provider=args.cloud_provider,
         leader_election=not args.no_leader_election,
         log_level=args.log_level,

@@ -1,0 +1,217 @@
+"""The gRPC solver-plugin boundary: wire codecs, server solve parity with the
+in-process solvers, fallback + endpoint blackout on sidecar failure, health.
+
+Parity is the load-bearing property: the control plane must not care whether
+the solver runs in-process or behind the RPC — same packings, same pool
+options, same unschedulable set.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.models.solver import CostSolver, GreedySolver, TPUSolver
+from karpenter_tpu.ops.encode import build_fleet, group_pods
+from karpenter_tpu.solver_service import solver_pb2 as pb
+from karpenter_tpu.solver_service import wire
+from karpenter_tpu.solver_service.client import RemoteSolver
+from karpenter_tpu.solver_service.server import SolverServer
+
+from karpenter_tpu.api.provisioner import Constraints
+from tests import fixtures
+
+
+def make_pods(n):
+    """A mixed-shape batch: three request vectors, zipf-ish counts."""
+    return (
+        fixtures.pods(n // 2, cpu="1", memory="512Mi")
+        + fixtures.pods(n // 3, cpu="500m", memory="2Gi")
+        + fixtures.pods(n - n // 2 - n // 3, cpu="2", memory="1Gi")
+    )
+
+
+def make_instance_types(n):
+    return fixtures.size_ladder(n)
+
+
+@pytest.fixture(scope="module")
+def server():
+    server = SolverServer(port=0).start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def remote(server):
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    yield client
+    client.close()
+
+
+def _packing_signature(result):
+    """Order-independent structural signature of a PackResult."""
+    packings = []
+    for packing in sorted(
+        result.packings, key=lambda p: [it.name for it in p.instance_type_options]
+    ):
+        packings.append(
+            (
+                tuple(it.name for it in packing.instance_type_options),
+                packing.node_quantity,
+                tuple(sorted(len(node) for node in packing.pods_per_node)),
+                tuple(
+                    (p.instance_type.name, p.zone, round(p.price, 6))
+                    for p in packing.pool_options
+                )
+                if packing.pool_options
+                else None,
+            )
+        )
+    return packings, sorted(p.name for p in result.unschedulable)
+
+
+class TestWire:
+    @pytest.mark.parametrize(
+        "array",
+        [
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.array([1, 2, 3], dtype=np.int64),
+            np.array([], dtype=np.int32),
+            np.array([[np.inf, 1.5]], dtype=np.float64),
+            np.array([True, False]),
+        ],
+    )
+    def test_tensor_round_trip(self, array):
+        decoded = wire.decode_tensor(wire.encode_tensor(array))
+        assert decoded.dtype == array.dtype
+        assert decoded.shape == array.shape
+        np.testing.assert_array_equal(decoded, array)
+
+    def test_rejects_unknown_dtype(self):
+        with pytest.raises(ValueError):
+            wire.encode_tensor(np.array(["a"], dtype=object))
+        with pytest.raises(ValueError):
+            wire.decode_tensor(pb.Tensor(shape=[1], dtype="f16", data=b"\x00\x00"))
+
+
+class TestServerParity:
+    def test_cost_mode_matches_in_process(self, remote, constraints):
+        pods = make_pods(120)
+        types = make_instance_types(12)
+        local = CostSolver().solve(pods, types, constraints)
+        over_wire = remote.solve(pods, types, constraints)
+        assert _packing_signature(over_wire) == _packing_signature(local)
+
+    def test_ffd_mode_matches_reference_greedy(self, server, constraints):
+        client = RemoteSolver(
+            f"127.0.0.1:{server.port}", mode="ffd", quirk=True
+        )
+        pods = make_pods(80)
+        types = make_instance_types(8)
+        greedy = GreedySolver().solve(pods, types, constraints)
+        over_wire = client.solve(pods, types, constraints)
+        client.close()
+        assert _packing_signature(over_wire) == _packing_signature(greedy)
+
+    def test_empty_fleet_marks_all_unschedulable(self, remote, constraints):
+        pods = make_pods(5)
+        result = remote.solve(pods, [], constraints)
+        assert not result.packings
+        assert len(result.unschedulable) == 5
+
+    def test_solve_is_stateless_across_requests(self, remote, constraints):
+        pods = make_pods(40)
+        types = make_instance_types(6)
+        first = remote.solve(pods, types, constraints)
+        second = remote.solve(pods, types, constraints)
+        assert _packing_signature(first) == _packing_signature(second)
+
+
+class TestFallback:
+    def test_dead_endpoint_falls_back_to_host_greedy(self, constraints):
+        clock = FakeClock()
+        client = RemoteSolver(
+            "127.0.0.1:1",  # nothing listens here
+            timeout_s=0.5,
+            clock=clock,
+        )
+        pods = make_pods(30)
+        types = make_instance_types(5)
+        result = client.solve(pods, types, constraints)
+        client.close()
+        oracle = GreedySolver().solve(pods, types, constraints)
+        assert result.node_count == oracle.node_count
+        assert not result.unschedulable
+
+    def test_blackout_skips_rpc_until_expiry(self, constraints):
+        clock = FakeClock()
+        calls = []
+
+        class CountingFallback(GreedySolver):
+            def solve_encoded(self, groups, fleet):
+                calls.append(clock())
+                return super().solve_encoded(groups, fleet)
+
+        client = RemoteSolver(
+            "127.0.0.1:1",
+            timeout_s=0.2,
+            blackout_s=30.0,
+            clock=clock,
+            fallback=CountingFallback(),
+        )
+        pods = make_pods(10)
+        types = make_instance_types(3)
+        client.solve(pods, types, constraints)  # RPC fails -> blackout set
+        assert client._blackout_until == pytest.approx(clock() + 30.0)
+        before = clock()
+        client.solve(pods, types, constraints)  # inside blackout: no RPC wait
+        assert clock() == before  # fake clock: a timed-out RPC would not tick it,
+        assert len(calls) == 2  # but both solves went to the fallback
+        clock.advance(31.0)
+        client.solve(pods, types, constraints)  # blackout expired: RPC retried
+        assert len(calls) == 3
+        client.close()
+
+    def test_recovers_when_sidecar_comes_back(self, server, constraints):
+        clock = FakeClock()
+        client = RemoteSolver(
+            f"127.0.0.1:{server.port}", blackout_s=30.0, clock=clock
+        )
+        client._blackout_until = clock() + 5.0  # as if a failure just happened
+        pods = make_pods(20)
+        types = make_instance_types(4)
+        clock.advance(6.0)
+        local = CostSolver().solve(pods, types, constraints)
+        result = client.solve(pods, types, constraints)
+        client.close()
+        assert _packing_signature(result) == _packing_signature(local)
+
+
+class TestHealth:
+    def test_health_reports_platform_and_solves(self, remote, constraints):
+        first = remote.healthy()
+        assert first is not None and first.status == "ok"
+        assert first.device_count >= 1
+        remote.solve(make_pods(4), make_instance_types(2), constraints)
+        second = remote.healthy()
+        assert second.solves == first.solves + 1
+
+    def test_health_none_when_unreachable(self):
+        client = RemoteSolver("127.0.0.1:1")
+        assert client.healthy(timeout_s=0.3) is None
+        client.close()
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0):
+        self._now = start
+
+    def __call__(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+
+@pytest.fixture()
+def constraints():
+    return Constraints()
