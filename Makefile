@@ -5,9 +5,9 @@
 test:
 	python -m pytest tests/ -x -q
 
-# Randomized order + full output, the `make battletest` analogue.
+# Fail-late with full tracebacks (no -x), the `make battletest` analogue.
 battletest:
-	python -m pytest tests/ -q -p no:randomly --tb=long
+	python -m pytest tests/ -q --tb=long
 
 proto:
 	protoc -I protos --python_out=karpenter_tpu/solver_service protos/solver.proto

@@ -95,16 +95,34 @@ def _cost_fused_kernel(
     """All three CostSolver candidates as ONE XLA computation: greedy-FFD
     rounds, cost-greedy rounds, and the LP relaxation. Fusing them means a
     single dispatch and a single device->host round trip per solve — on a
-    tunneled accelerator the round trips cost more than the math."""
+    tunneled accelerator the round trips cost more than the math.
+
+    Price model: a node packed for type t launches as the cheapest pool of
+    ANY type whose capacity dominates t's (the plan offers the price-ranked
+    feasible pools, _cheapest_feasible_pools), so the cost objective sees the
+    dominating-type minimum price — the price the realization will actually
+    pay, not t's own list price. The [T, T] dominance reduction is tensor
+    math, so it rides along in the same compiled computation."""
+    dominates = (
+        capacity[None, :, :] >= capacity[:, None, :] - 1e-6
+    ).all(axis=2)  # [T, T'] — t' can host any node packed for t
+    valid_prices = jnp.where(valid, prices, jnp.inf)
+    effective_prices = jnp.where(dominates, valid_prices[None, :], jnp.inf).min(
+        axis=1
+    )
     rounds_ffd = pack_kernel(
-        vectors, counts, capacity, total, valid, prices, quirk=False, mode="ffd"
+        vectors, counts, capacity, total, valid, effective_prices,
+        quirk=False, mode="ffd",
     )
     rounds_cost = pack_kernel(
-        vectors, counts, capacity, total, valid, prices, quirk=False, mode="cost"
+        vectors, counts, capacity, total, valid, effective_prices,
+        quirk=False, mode="cost",
     )
     feasible_any = feasibility_mask(vectors, capacity, valid).any(axis=1)
     solvable = jnp.where(feasible_any, counts, 0)
-    lp = lp_relax_solve(vectors, solvable, capacity, valid, prices, steps=lp_steps)
+    lp = lp_relax_solve(
+        vectors, solvable, capacity, valid, effective_prices, steps=lp_steps
+    )
     return rounds_ffd, rounds_cost, lp.assignment, feasible_any, lp.objective
 
 
@@ -188,12 +206,26 @@ def _pool_price_matrix(fleet: InstanceFleet) -> Tuple[List[str], np.ndarray]:
 PoolRow = Tuple[int, int, float]
 
 
+def sort_pool_rows(pool_prices: np.ndarray):
+    """Global price order of all (type, zone) pool rows — identical for every
+    fill, so the O(TZ log TZ) sort is hoisted out of the per-fill option
+    ranking: (row type, row zone, row price) each [N], price-ascending,
+    non-offered (inf) rows dropped."""
+    flat = pool_prices.ravel()
+    finite = np.isfinite(flat)
+    order = np.argsort(flat, kind="stable")
+    order = order[finite[order]]
+    num_zones = pool_prices.shape[1]
+    return order // num_zones, order % num_zones, flat[order]
+
+
 def _cheapest_feasible_pools(
     fill: np.ndarray,
     t: int,
     vectors: np.ndarray,
     capacity: np.ndarray,
     pool_prices: np.ndarray,
+    pool_order=None,
 ) -> Tuple[List[int], Optional[List[PoolRow]]]:
     """Price-ranked launch options for a node with this fill (dense core).
 
@@ -207,38 +239,49 @@ def _cheapest_feasible_pools(
     request budget), and let the allocation strategy choose among
     near-cheapest pools only. Returns (type indices, pool rows)."""
     demand = (fill.astype(np.float64)[:, None] * vectors).sum(axis=0)
-    feasible = np.nonzero((capacity >= demand - 1e-6).all(axis=1))[0]
-    candidate = pool_prices[feasible]  # [F, Z]
-    flat = candidate.ravel()
-    finite = np.isfinite(flat)
-    if not finite.any():
+    feasible_mask = (capacity >= demand - 1e-6).all(axis=1)
+    if pool_order is None:
+        pool_order = sort_pool_rows(pool_prices)
+    all_types, all_zones, all_prices = pool_order
+    # The global price order restricted to feasible types keeps its sort.
+    keep = feasible_mask[all_types]
+    if not keep.any():
         # Degenerate: fall back to the feasibility anchor's type options.
         return [t], None
-    order = np.argsort(flat, kind="stable")
-    order = order[finite[order]]
-    num_zones = pool_prices.shape[1]
-    cheapest = flat[order[0]]
+    row_types = all_types[keep]
+    row_zones = all_zones[keep]
+    prices_sorted = all_prices[keep]
+
+    # Vectorized form of the sequential selection walk: rows of a type past
+    # the MAX_INSTANCE_TYPES-th distinct one are skipped (not appended, not
+    # counted); the walk stops at the first row where the appended-so-far
+    # count hits the row budget, exits the price band past MIN_POOL_ROWS, or
+    # exceeds the ceiling with anything appended.
+    uniques, first_idx, inverse = np.unique(
+        row_types, return_index=True, return_inverse=True
+    )
+    type_rank = np.argsort(np.argsort(first_idx))  # first-occurrence order
+    admissible = type_rank[inverse] < ffd.MAX_INSTANCE_TYPES
+    count_excl = np.concatenate(([0], np.cumsum(admissible)[:-1]))
+    cheapest = prices_sorted[0]
     cutoff = cheapest * (1.0 + POOL_PRICE_BAND)
     ceiling = cheapest * MAX_POOL_PRICE_RATIO
-    chosen_types: List[int] = []
-    chosen_set: set = set()
-    pool_rows: List[PoolRow] = []
-    for flat_index in order:
-        price = float(flat[flat_index])
-        if len(pool_rows) >= MAX_POOL_ROWS:
-            break
-        if price > cutoff and len(pool_rows) >= MIN_POOL_ROWS:
-            break
-        if price > ceiling and pool_rows:
-            break
-        ti = int(feasible[flat_index // num_zones])
-        zi = int(flat_index % num_zones)
-        if ti not in chosen_set:
-            if len(chosen_types) >= ffd.MAX_INSTANCE_TYPES:
-                continue
-            chosen_types.append(ti)
-            chosen_set.add(ti)
-        pool_rows.append((ti, zi, price))
+    stop_mask = (
+        (count_excl >= MAX_POOL_ROWS)
+        | ((prices_sorted > cutoff) & (count_excl >= MIN_POOL_ROWS))
+        | ((prices_sorted > ceiling) & (count_excl >= 1))
+    )
+    stops = np.nonzero(stop_mask)[0]
+    stop = int(stops[0]) if stops.size else len(prices_sorted)
+    selected = np.nonzero(admissible[:stop])[0]
+
+    pool_rows: List[PoolRow] = [
+        (int(row_types[i]), int(row_zones[i]), float(prices_sorted[i]))
+        for i in selected
+    ]
+    sel_types = row_types[selected]
+    _, sel_first = np.unique(sel_types, return_index=True)
+    chosen_types = [int(sel_types[i]) for i in np.sort(sel_first)]
     return chosen_types, pool_rows
 
 
@@ -440,19 +483,8 @@ def cost_solve_dense(
     num_groups = int(vectors.shape[0])
     num_types = int(capacity.shape[0])
 
-    # Price model: a node packed for type t launches as the cheapest pool
-    # of ANY type whose capacity dominates t's (the plan offers the
-    # price-ranked feasible pools, _cheapest_feasible_pools), so the
-    # cost objective sees the dominating-type minimum price — the price
-    # the realization will actually pay, not t's own list price.
-    dominates = (
-        capacity[None, :, :] >= capacity[:, None, :] - 1e-6
-    ).all(axis=2)  # [T, T'] — t' can host any node packed for t
-    effective_prices = np.where(dominates, prices[None, :], np.inf).min(
-        axis=1
-    ).astype(np.float32)
     fused = _cost_fused_kernel(
-        *pad_kernel_args(vectors, counts, capacity, total, effective_prices),
+        *pad_kernel_args(vectors, counts, capacity, total, prices),
         lp_steps=lp_steps,
     )
     # Overlap with the device: dispatch above is async, so host-side work
@@ -481,6 +513,7 @@ def cost_solve_dense(
     # never wins on price. The option sets are memoized per fill so the
     # winning candidate's decode reuses the scoring pass's work.
     options_memo: Dict[bytes, Tuple[List[int], Optional[List[PoolRow]]]] = {}
+    pool_order = sort_pool_rows(pool_prices)
 
     def options_for(t: int, fill: np.ndarray):
         # The anchor t only matters on the degenerate no-finite-pool path;
@@ -490,20 +523,29 @@ def cost_solve_dense(
         options = options_memo.get(key)
         if options is None:
             options = _cheapest_feasible_pools(
-                fill, t, vectors, capacity, pool_prices
+                fill, t, vectors, capacity, pool_prices, pool_order
             )
             options_memo[key] = options
         return options
+
+    price_memo: Dict[bytes, float] = {}
 
     def round_price(t: int, fill: np.ndarray) -> float:
         """Expected realized price of one node: capacity-optimized
         allocation can land on any offered row and the solver cannot see
         pool depths, so candidates are ranked by the mean offered-row
-        price, not the optimistic cheapest row."""
-        type_indices, pool_rows = options_for(t, fill)
-        if pool_rows:
-            return float(np.mean([price for _, _, price in pool_rows]))
-        return float(prices[type_indices].min())
+        price, not the optimistic cheapest row. Memoized per fill — the
+        same fill recurs across candidates and replicated rounds."""
+        key = fill.tobytes()
+        price = price_memo.get(key)
+        if price is None:
+            type_indices, pool_rows = options_for(t, fill)
+            if pool_rows:
+                price = float(np.mean([p for _, _, p in pool_rows]))
+            else:
+                price = float(prices[type_indices].min())
+            price_memo[key] = price
+        return price
 
     def score(candidate):
         round_list, unschedulable_counts = candidate
@@ -579,7 +621,18 @@ def _realize_lp_dense(
     assignment = round_assignment(lp_assignment, padded_solvable)
 
     # Realize the plan: per type, greedily fill nodes (pure greedy, no
-    # quirk) with that type's assigned pods.
+    # quirk) with that type's assigned pods. The compiled path does all
+    # types in one call; pure Python below is the no-toolchain fallback.
+    from karpenter_tpu.ops import native
+
+    native_rounds = native.lp_realize(
+        vectors, assignment[:num, : capacity.shape[0]], capacity, total
+    )
+    if native_rounds is native.INFEASIBLE:
+        return None  # proven unrealizable — don't redo the work in Python
+    if native_rounds is not None:
+        return native_rounds, unschedulable_counts
+
     round_list: List[Tuple[int, np.ndarray, int]] = []
     num_types = int(capacity.shape[0])
     for t in range(num_types):

@@ -75,6 +75,20 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),  # unschedulable
             ctypes.c_int,  # max_rounds
         ]
+        lib.ktpu_lp_realize.restype = ctypes.c_int
+        lib.ktpu_lp_realize.argtypes = [
+            ctypes.POINTER(ctypes.c_float),  # vectors
+            ctypes.c_int,  # num_groups
+            ctypes.c_int,  # dims
+            ctypes.POINTER(ctypes.c_int64),  # assignment [T x G]
+            ctypes.POINTER(ctypes.c_float),  # capacity
+            ctypes.POINTER(ctypes.c_float),  # total
+            ctypes.c_int,  # num_types
+            ctypes.POINTER(ctypes.c_int),  # round_type
+            ctypes.POINTER(ctypes.c_int64),  # round_fill
+            ctypes.POINTER(ctypes.c_int64),  # round_repl
+            ctypes.c_int,  # max_rounds
+        ]
         _lib = lib
         return _lib
 
@@ -133,3 +147,76 @@ def ffd_pack_rounds(
         for r in range(rounds)
     ]
     return round_list, unschedulable[:num_groups]
+
+
+# lp_realize sentinel: the native code determined the assignment cannot be
+# realized (an assigned pod fits nowhere on its type) — distinct from None
+# (library unavailable / buffer overflow), where a pure-Python retry is
+# worthwhile.
+INFEASIBLE = "infeasible"
+
+# Don't pre-allocate more than this for the round buffers; past it the
+# pure-Python realization (which allocates per round) is the safer path.
+_MAX_REALIZE_BUFFER_BYTES = 64 << 20
+
+
+def lp_realize(
+    vectors: np.ndarray,
+    assignment: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+):
+    """Realize an integerized [G, T] LP assignment as replication-compressed
+    per-type greedy node fills (native). Returns the round list; INFEASIBLE
+    when the native code proves the assignment unrealizable (callers drop the
+    candidate); None when the library is unavailable or the problem exceeds
+    the buffer envelope (callers fall back to pure Python)."""
+    lib = load()
+    if lib is None:
+        return None
+    vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+    capacity = np.ascontiguousarray(capacity, dtype=np.float32)
+    total = np.ascontiguousarray(total, dtype=np.float32)
+    num_groups, dims = vectors.shape
+    num_types = capacity.shape[0]
+    # [T x G] row-major for per-type column scans.
+    assignment_tg = np.ascontiguousarray(assignment.T, dtype=np.int64)
+    # Rounds scale with the assignment's nonzero entries, not T*G: each
+    # round's binding group drops below its fill, so a (type, group) entry
+    # contributes O(1) rounds. 4x + slack headroom; overflow (-1) falls back
+    # to the unbounded pure-Python path.
+    nnz = int(np.count_nonzero(assignment_tg))
+    active = int((assignment_tg.sum(axis=1) > 0).sum())
+    max_rounds = 4 * nnz + 16 * active + 64
+    if max_rounds * max(num_groups, 1) * 8 > _MAX_REALIZE_BUFFER_BYTES:
+        return None
+    round_type = np.zeros(max_rounds, dtype=np.int32)
+    round_fill = np.zeros((max_rounds, max(num_groups, 1)), dtype=np.int64)
+    round_repl = np.zeros(max_rounds, dtype=np.int64)
+
+    def ptr(array, ctype):
+        return array.ctypes.data_as(ctypes.POINTER(ctype))
+
+    rounds = lib.ktpu_lp_realize(
+        ptr(vectors, ctypes.c_float),
+        num_groups,
+        dims,
+        ptr(assignment_tg, ctypes.c_int64),
+        ptr(capacity, ctypes.c_float),
+        ptr(total, ctypes.c_float),
+        num_types,
+        ptr(round_type, ctypes.c_int),
+        ptr(round_fill, ctypes.c_int64),
+        ptr(round_repl, ctypes.c_int64),
+        max_rounds,
+    )
+    if rounds == -2:
+        return INFEASIBLE
+    if rounds < 0:
+        return None
+    # Copy row slices so the (possibly large) backing buffer isn't pinned by
+    # views held through decode.
+    return [
+        (int(round_type[r]), round_fill[r, :num_groups].copy(), int(round_repl[r]))
+        for r in range(rounds)
+    ]

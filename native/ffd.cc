@@ -172,4 +172,56 @@ int ktpu_ffd_pack(const float* vectors, const int64_t* counts_in,
   return rounds;
 }
 
+// Realize an integerized LP assignment (karpenter_tpu/models/solver.py
+// _realize_lp_dense): for each type t, greedily fill nodes (pure greedy, no
+// quirk) with that type's assigned pods, replication-compressed — repl =
+// min over filled groups of counts/fill, so 50k identical pods collapse to
+// one round instead of thousands. Replication is exact here because each
+// type's realization is independent (no cross-type largest-bound pattern to
+// preserve, unlike ktpu_ffd_pack above).
+//
+// assignment is [T x num_groups] row-major (pods of group g assigned to
+// type t). Returns rounds written, -1 if max_rounds exceeded, -2 if some
+// assigned pod doesn't fit its type (infeasible assignment — caller bails).
+int ktpu_lp_realize(const float* vectors, int num_groups, int dims,
+                    const int64_t* assignment, const float* capacity,
+                    const float* total, int num_types, int* round_type,
+                    int64_t* round_fill, int64_t* round_repl,
+                    int max_rounds) {
+  Problem p{vectors,  nullptr, num_groups, dims,
+            capacity, total,   num_types,  false};
+  std::vector<int64_t> counts(num_groups), fill(num_groups);
+  int rounds = 0;
+  for (int t = 0; t < num_types; ++t) {
+    const int64_t* column = assignment + static_cast<size_t>(t) * num_groups;
+    int64_t remaining = 0;
+    for (int g = 0; g < num_groups; ++g) {
+      counts[g] = column[g];
+      remaining += column[g];
+    }
+    while (remaining > 0) {
+      if (FillNode(p, t, counts.data(), fill.data()) == 0) return -2;
+      int64_t repl = -1;
+      for (int g = 0; g < num_groups; ++g) {
+        if (fill[g] > 0) {
+          int64_t k = counts[g] / fill[g];
+          if (repl < 0 || k < repl) repl = k;
+        }
+      }
+      if (repl < 1) repl = 1;
+      if (rounds >= max_rounds) return -1;
+      round_type[rounds] = t;
+      round_repl[rounds] = repl;
+      int64_t* out = round_fill + static_cast<size_t>(rounds) * num_groups;
+      for (int g = 0; g < num_groups; ++g) {
+        out[g] = fill[g];
+        counts[g] -= repl * fill[g];
+        remaining -= repl * fill[g];
+      }
+      ++rounds;
+    }
+  }
+  return rounds;
+}
+
 }  // extern "C"
