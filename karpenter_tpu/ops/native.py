@@ -157,7 +157,10 @@ INFEASIBLE = "infeasible"
 
 # Don't pre-allocate more than this for the round buffers; past it the
 # pure-Python realization (which allocates per round) is the safer path.
-_MAX_REALIZE_BUFFER_BYTES = 64 << 20
+# The buffers are np.empty (never zero-filled — the C++ writes every cell of
+# each round it returns), so below the cap the cost is address space, not
+# touched pages, and the cap only needs to guard true pathologies.
+_MAX_REALIZE_BUFFER_BYTES = 512 << 20
 
 
 def lp_realize(
@@ -190,9 +193,9 @@ def lp_realize(
     max_rounds = 4 * nnz + 16 * active + 64
     if max_rounds * max(num_groups, 1) * 8 > _MAX_REALIZE_BUFFER_BYTES:
         return None
-    round_type = np.zeros(max_rounds, dtype=np.int32)
-    round_fill = np.zeros((max_rounds, max(num_groups, 1)), dtype=np.int64)
-    round_repl = np.zeros(max_rounds, dtype=np.int64)
+    round_type = np.empty(max_rounds, dtype=np.int32)
+    round_fill = np.empty((max_rounds, max(num_groups, 1)), dtype=np.int64)
+    round_repl = np.empty(max_rounds, dtype=np.int64)
 
     def ptr(array, ctype):
         return array.ctypes.data_as(ctypes.POINTER(ctype))
