@@ -1,0 +1,3 @@
+{{- define "karpenter-tpu.fullname" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

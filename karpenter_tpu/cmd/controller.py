@@ -35,9 +35,13 @@ def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     manager = Manager(cluster, cloud, options)
     manager.start()
     serve_http(manager, options.metrics_port)
+    # Separate probe port, matching the reference's split (manager.go:52-57)
+    # and the chart's liveness/readiness wiring.
+    serve_http(manager, options.health_probe_port)
     log.info(
-        "controller ready: metrics on :%d, solver=%s, cloud=%s",
+        "controller ready: metrics on :%d, health on :%d, solver=%s, cloud=%s",
         options.metrics_port,
+        options.health_probe_port,
         options.solver,
         options.cloud_provider,
     )

@@ -25,11 +25,28 @@ from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.scheduling import Scheduler
 from karpenter_tpu.models.solver import GreedySolver, Solver
 from karpenter_tpu.ops.ffd import PackResult
+from karpenter_tpu.utils.metrics import REGISTRY
 
 # Batching envelope (ref: provisioner.go:42-47).
 MAX_PODS_PER_BATCH = 2000
 BATCH_IDLE_SECONDS = 1.0
 BATCH_MAX_SECONDS = 10.0
+
+# Duration histograms around the three hot stages, matching the reference's
+# only performance instrumentation (ref: scheduling/scheduler.go:34-47,
+# binpacking/packer.go:41-55, provisioner.go:252-265 via metrics.Measure).
+SCHEDULING_DURATION = REGISTRY.histogram(
+    "allocation_scheduling_duration_seconds",
+    "Duration of the constraint-grouping stage per batch",
+)
+SOLVE_DURATION = REGISTRY.histogram(
+    "allocation_binpacking_duration_seconds",
+    "Duration of solver packing per schedule",
+)
+BIND_DURATION = REGISTRY.histogram(
+    "allocation_bind_duration_seconds",
+    "Duration of node creation + pod binding per packing",
+)
 
 
 def global_requirements(instance_types) -> Requirements:
@@ -160,13 +177,17 @@ class ProvisionerWorker:
             for template in self.cluster.list_daemonset_templates()
             if self._daemon_schedules_here(template)
         ]
-        for schedule in self.scheduler.solve(self.provisioner, pods):
+        with SCHEDULING_DURATION.measure():
+            schedules = self.scheduler.solve(self.provisioner, pods)
+        for schedule in schedules:
             instance_types = self.cloud.get_instance_types(schedule.constraints)
-            result = self.solver.solve(
-                schedule.pods, instance_types, schedule.constraints, daemons
-            )
+            with SOLVE_DURATION.measure():
+                result = self.solver.solve(
+                    schedule.pods, instance_types, schedule.constraints, daemons
+                )
             stats.unschedulable_pods += len(result.unschedulable)
-            self._launch(schedule.constraints, result, stats)
+            with BIND_DURATION.measure():
+                self._launch(schedule.constraints, result, stats)
         if stats.launched_nodes:
             live = self.cluster.try_get_provisioner(self.provisioner.name)
             if live is not None:
