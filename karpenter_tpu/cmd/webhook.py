@@ -81,6 +81,19 @@ class WebhookHandler(http.server.BaseHTTPRequestHandler):
 
 
 def main(argv=None, port: int = 8443, block: bool = True, address: str = ""):
+    # --port belongs to this binary, not the shared options envelope
+    # (the chart passes it; options.parse would reject the unknown flag).
+    if argv:
+        argv = list(argv)
+        for i, arg in enumerate(list(argv)):
+            if arg.startswith("--port="):
+                port = int(arg.split("=", 1)[1])
+                argv.pop(i)
+                break
+            if arg == "--port" and i + 1 < len(argv):
+                port = int(argv[i + 1])
+                del argv[i : i + 2]
+                break
     options = options_pkg.parse(argv)
     klog.setup(options.log_level)
     registry.new_cloud_provider(options.cloud_provider)  # installs hooks

@@ -26,6 +26,7 @@ from karpenter_tpu.controllers.scheduling import Scheduler
 from karpenter_tpu.models.solver import GreedySolver, Solver
 from karpenter_tpu.ops.ffd import PackResult
 from karpenter_tpu.utils.metrics import REGISTRY
+from karpenter_tpu.utils.tracing import TRACER
 
 # Batching envelope (ref: provisioner.go:42-47).
 MAX_PODS_PER_BATCH = 2000
@@ -177,16 +178,24 @@ class ProvisionerWorker:
             for template in self.cluster.list_daemonset_templates()
             if self._daemon_schedules_here(template)
         ]
-        with SCHEDULING_DURATION.measure():
+        with SCHEDULING_DURATION.measure(), TRACER.span(
+            "provision.schedule", provisioner=self.provisioner.name, pods=len(pods)
+        ):
             schedules = self.scheduler.solve(self.provisioner, pods)
         for schedule in schedules:
             instance_types = self.cloud.get_instance_types(schedule.constraints)
-            with SOLVE_DURATION.measure():
+            with SOLVE_DURATION.measure(), TRACER.span(
+                "provision.solve",
+                pods=len(schedule.pods),
+                instance_types=len(instance_types),
+            ):
                 result = self.solver.solve(
                     schedule.pods, instance_types, schedule.constraints, daemons
                 )
             stats.unschedulable_pods += len(result.unschedulable)
-            with BIND_DURATION.measure():
+            with BIND_DURATION.measure(), TRACER.span(
+                "provision.bind", nodes=result.node_count
+            ):
                 self._launch(schedule.constraints, result, stats)
         if stats.launched_nodes:
             live = self.cluster.try_get_provisioner(self.provisioner.name)

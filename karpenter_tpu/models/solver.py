@@ -30,6 +30,7 @@ from karpenter_tpu.ops.score_kernel import (
     lp_relax_solve,
     round_assignment,
 )
+from karpenter_tpu.utils.tracing import TRACER, device_profile
 
 
 class Solver(abc.ABC):
@@ -483,17 +484,23 @@ def cost_solve_dense(
     num_groups = int(vectors.shape[0])
     num_types = int(capacity.shape[0])
 
-    fused = _cost_fused_kernel(
-        *pad_kernel_args(vectors, counts, capacity, total, prices),
-        lp_steps=lp_steps,
-    )
-    # Overlap with the device: dispatch above is async, so host-side work
-    # that only depends on the fleet runs while the kernel computes.
-    if callable(pool_prices):
-        pool_prices = pool_prices()
-    rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
-        _to_host(fused)
-    )
+    # device_profile is a no-op unless KARPENTER_JAX_PROFILE_DIR is set, in
+    # which case each solve captures a jax.profiler device trace whose XLA
+    # ops line up with the host spans via TraceAnnotation.
+    with device_profile(TRACER), TRACER.span(
+        "solve.device", groups=num_groups, types=num_types
+    ):
+        fused = _cost_fused_kernel(
+            *pad_kernel_args(vectors, counts, capacity, total, prices),
+            lp_steps=lp_steps,
+        )
+        # Overlap with the device: dispatch above is async, so host-side work
+        # that only depends on the fleet runs while the kernel computes.
+        if callable(pool_prices):
+            pool_prices = pool_prices()
+        rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
+            _to_host(fused)
+        )
 
     # Candidates stay in round form; only the winner pays the decode into
     # concrete per-node pod lists.

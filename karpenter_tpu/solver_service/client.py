@@ -30,6 +30,7 @@ from karpenter_tpu.solver_service import solver_pb2 as pb
 from karpenter_tpu.solver_service import wire
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.metrics import REGISTRY
+from karpenter_tpu.utils.tracing import TRACER
 
 log = klog.named("remote-solver")
 
@@ -104,15 +105,32 @@ class RemoteSolver(Solver):
             quirk=self.quirk,
         )
         start = self.clock()
-        try:
-            response = self._solve_rpc(request, timeout=self.timeout_s)
-        except grpc.RpcError as error:
+        response = None
+        # The span covers ONLY the RPC hop — the fallback solve runs outside
+        # it so an outage doesn't misattribute host solve time to the wire.
+        with TRACER.span(
+            "solver.rpc",
+            endpoint=self.endpoint,
+            mode=self.mode,
+            groups=groups.num_groups,
+            types=fleet.num_types,
+        ) as span:
+            try:
+                response = self._solve_rpc(request, timeout=self.timeout_s)
+            except grpc.RpcError as error:
+                span.set(outcome="error")
+                rpc_error = error
+            else:
+                span.set(
+                    outcome="ok", server_ms=response.solve_ms, solver=response.solver
+                )
+        if response is None:
             _RPC_HISTOGRAM.observe(self.clock() - start, "error")
             self._blackout_until = self.clock() + self.blackout_s
             log.warning(
                 "sidecar %s unavailable (%s); host greedy for %.0fs",
                 self.endpoint,
-                getattr(error, "code", lambda: error)(),
+                getattr(rpc_error, "code", lambda: rpc_error)(),
                 self.blackout_s,
             )
             return self.fallback.solve_encoded(groups, fleet)

@@ -28,6 +28,7 @@ from karpenter_tpu.ops import ffd, native
 from karpenter_tpu.solver_service import solver_pb2 as pb
 from karpenter_tpu.solver_service import wire
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.tracing import TRACER
 
 log = klog.named("solver-server")
 
@@ -81,6 +82,10 @@ class _Handler:
         self._lock = threading.Lock()
 
     def solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        with TRACER.span("solver.serve", mode=request.mode or "cost"):
+            return self._solve(request, context)
+
+    def _solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         start = time.perf_counter()
         vectors = wire.decode_tensor(request.group_vectors)
         counts = wire.decode_tensor(request.group_counts)

@@ -1,0 +1,199 @@
+"""Tracing: spans around the provisioning pipeline and the solver boundary.
+
+The reference ships no tracing at all (SURVEY.md §5 — only Prometheus
+duration histograms); the rebuild adds it because the solve path now crosses
+a process boundary (gRPC sidecar) and a device boundary (host↔TPU), where
+aggregate histograms can't show *which* hop ate the latency budget.
+
+Design: an in-process tracer with explicit context-manager spans. Spans
+nest via a thread-local stack, live in a bounded ring buffer, and export as
+Chrome trace events (chrome://tracing / Perfetto load them directly).
+Enablement is environment-driven so production runs pay one branch per span
+when disabled:
+
+  KARPENTER_TRACE=1                 enable span collection
+  KARPENTER_TRACE_FILE=/path.json   flush Chrome trace events there on exit
+  KARPENTER_JAX_PROFILE_DIR=/path   capture a jax.profiler device trace
+                                    around each solve (TPU-side timeline)
+
+The TPU side rides jax.profiler: when a profile dir is set, solver spans
+also enter a jax.profiler.TraceAnnotation so host spans and XLA device ops
+line up in the same TensorBoard/Perfetto view.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_MAX_SPANS = 65536
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float
+    duration_s: float = 0.0
+    attributes: Dict[str, object] = field(default_factory=dict)
+    parent: Optional[str] = None
+    thread_id: int = 0
+
+
+class Tracer:
+    def __init__(self, enabled: Optional[bool] = None):
+        self.enabled = (
+            enabled
+            if enabled is not None
+            else os.environ.get("KARPENTER_TRACE", "") not in ("", "0", "false")
+        )
+        self.profile_dir = os.environ.get("KARPENTER_JAX_PROFILE_DIR") or None
+        self._spans: deque = deque(maxlen=_MAX_SPANS)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    # -- spans ---------------------------------------------------------------
+
+    def span(self, name: str, **attributes):
+        """Context manager: times the block, records nesting."""
+        return _SpanContext(self, name, attributes)
+
+    def record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # -- export --------------------------------------------------------------
+
+    def chrome_trace_events(self) -> List[dict]:
+        """Complete ('X') events in the Chrome trace event format."""
+        return [
+            {
+                "name": span.name,
+                "ph": "X",
+                "ts": span.start_s * 1e6,
+                "dur": span.duration_s * 1e6,
+                "pid": os.getpid(),
+                "tid": span.thread_id,
+                "args": {**span.attributes, "parent": span.parent or ""},
+            }
+            for span in self.spans()
+        ]
+
+    def flush(self, path: Optional[str] = None) -> Optional[str]:
+        path = path or os.environ.get("KARPENTER_TRACE_FILE")
+        if not path:
+            return None
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.chrome_trace_events()}, f)
+        return path
+
+    # -- stack ---------------------------------------------------------------
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+
+class _SpanContext:
+    __slots__ = ("tracer", "name", "attributes", "_start", "_jax_ctx")
+
+    def __init__(self, tracer: Tracer, name: str, attributes: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attributes = attributes
+        self._jax_ctx = None
+
+    def __enter__(self):
+        if not self.tracer.enabled:
+            return self
+        self._start = time.perf_counter()
+        stack = self.tracer._stack()
+        stack.append(self.name)
+        if self.tracer.profile_dir is not None:
+            # Line this host span up with XLA device ops in the jax profile.
+            try:
+                import jax.profiler
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+        return self
+
+    def set(self, **attributes) -> None:
+        self.attributes.update(attributes)
+
+    def __exit__(self, *exc):
+        if not self.tracer.enabled:
+            return False
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*exc)
+        stack = self.tracer._stack()
+        stack.pop()
+        self.tracer.record(
+            Span(
+                name=self.name,
+                start_s=self._start,
+                duration_s=time.perf_counter() - self._start,
+                attributes=dict(self.attributes),
+                parent=stack[-1] if stack else None,
+                thread_id=threading.get_ident() & 0xFFFF,
+            )
+        )
+        return False
+
+
+class _ProfileSession:
+    """jax.profiler capture around a block (KARPENTER_JAX_PROFILE_DIR)."""
+
+    def __init__(self, tracer: Tracer):
+        self.tracer = tracer
+        self._active = False
+
+    def __enter__(self):
+        if self.tracer.profile_dir is not None:
+            try:
+                import jax.profiler
+
+                jax.profiler.start_trace(self.tracer.profile_dir)
+                self._active = True
+            except Exception:
+                self._active = False
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        return False
+
+
+def device_profile(tracer: Optional[Tracer] = None) -> _ProfileSession:
+    return _ProfileSession(tracer or TRACER)
+
+
+# The process-wide tracer, mirroring metrics.REGISTRY. When a trace file is
+# configured, collected spans flush there at interpreter exit (the documented
+# KARPENTER_TRACE_FILE contract); flush() can also be called any time.
+TRACER = Tracer()
+if TRACER.enabled and os.environ.get("KARPENTER_TRACE_FILE"):
+    atexit.register(TRACER.flush)

@@ -1,0 +1,125 @@
+"""Tracing: span nesting, Chrome trace export, pipeline + solver-RPC wiring,
+disabled-by-default behavior (the reference has no tracing at all —
+SURVEY.md §5 — so everything here is rebuild-added surface)."""
+
+import json
+
+import pytest
+
+from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.models.solver import GreedySolver
+from karpenter_tpu.utils import tracing
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+@pytest.fixture()
+def tracer(monkeypatch):
+    tracer = tracing.Tracer(enabled=True)
+    monkeypatch.setattr(tracing, "TRACER", tracer)
+    return tracer
+
+
+class TestSpans:
+    def test_span_records_duration_and_attributes(self, tracer):
+        with tracer.span("work", items=3):
+            pass
+        [span] = tracer.spans("work")
+        assert span.duration_s >= 0
+        assert span.attributes["items"] == 3
+
+    def test_nesting_sets_parent(self, tracer):
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                pass
+        [inner] = tracer.spans("inner")
+        [outer] = tracer.spans("outer")
+        assert inner.parent == "outer"
+        assert outer.parent is None
+
+    def test_set_updates_attributes_mid_span(self, tracer):
+        with tracer.span("rpc") as span:
+            span.set(outcome="ok")
+        [span] = tracer.spans("rpc")
+        assert span.attributes["outcome"] == "ok"
+
+    def test_disabled_tracer_records_nothing(self):
+        tracer = tracing.Tracer(enabled=False)
+        with tracer.span("work"):
+            pass
+        assert tracer.spans() == []
+
+    def test_ring_buffer_bounded(self, tracer):
+        for i in range(tracing._MAX_SPANS + 100):
+            tracer.record(tracing.Span(name=f"s{i}", start_s=0.0))
+        assert len(tracer.spans()) == tracing._MAX_SPANS
+
+
+class TestChromeExport:
+    def test_events_format(self, tracer, tmp_path):
+        with tracer.span("outer"):
+            with tracer.span("inner", detail="x"):
+                pass
+        events = tracer.chrome_trace_events()
+        assert {e["name"] for e in events} == {"outer", "inner"}
+        for event in events:
+            assert event["ph"] == "X"
+            assert event["dur"] >= 0
+        path = tmp_path / "trace.json"
+        flushed = tracer.flush(str(path))
+        assert flushed == str(path)
+        loaded = json.loads(path.read_text())
+        assert len(loaded["traceEvents"]) == 2
+
+    def test_flush_without_target_is_noop(self, tracer, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TRACE_FILE", raising=False)
+        assert tracer.flush() is None
+
+
+class TestPipelineWiring:
+    def test_provision_emits_stage_spans(self, tracer, monkeypatch):
+        # The controllers import TRACER by value; patch their references too.
+        from karpenter_tpu.controllers import provisioning as prov_mod
+
+        monkeypatch.setattr(prov_mod, "TRACER", tracer)
+        h = Harness(solver=GreedySolver())
+        h.apply_provisioner(Provisioner(name="default"))
+        h.provision(*fixtures.pods(5))
+        assert tracer.spans("provision.schedule")
+        [solve] = tracer.spans("provision.solve")
+        assert solve.attributes["pods"] == 5
+        assert tracer.spans("provision.bind")
+
+    def test_remote_solve_emits_rpc_spans(self, tracer, monkeypatch):
+        from karpenter_tpu.solver_service import client as client_mod
+        from karpenter_tpu.solver_service import server as server_mod
+        from karpenter_tpu.solver_service.client import RemoteSolver
+        from karpenter_tpu.solver_service.server import SolverServer
+        from karpenter_tpu.api.provisioner import Constraints
+
+        monkeypatch.setattr(client_mod, "TRACER", tracer)
+        monkeypatch.setattr(server_mod, "TRACER", tracer)
+        server = SolverServer(port=0).start()
+        try:
+            remote = RemoteSolver(f"127.0.0.1:{server.port}")
+            remote.solve(fixtures.pods(6), fixtures.size_ladder(3), Constraints())
+            remote.close()
+        finally:
+            server.stop()
+        [rpc] = tracer.spans("solver.rpc")
+        assert rpc.attributes["outcome"] == "ok"
+        assert rpc.attributes["server_ms"] > 0
+        assert tracer.spans("solver.serve")  # server-side span, same process here
+
+    def test_rpc_error_span_marks_outcome(self, tracer, monkeypatch):
+        from karpenter_tpu.solver_service import client as client_mod
+        from karpenter_tpu.solver_service.client import RemoteSolver
+        from karpenter_tpu.api.provisioner import Constraints
+
+        monkeypatch.setattr(client_mod, "TRACER", tracer)
+        remote = RemoteSolver("127.0.0.1:1", timeout_s=0.3)
+        remote.solve(fixtures.pods(3), fixtures.size_ladder(2), Constraints())
+        remote.close()
+        [rpc] = tracer.spans("solver.rpc")
+        assert rpc.attributes["outcome"] == "error"
