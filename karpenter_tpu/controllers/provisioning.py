@@ -42,7 +42,8 @@ SCHEDULING_DURATION = REGISTRY.histogram(
 )
 SOLVE_DURATION = REGISTRY.histogram(
     "allocation_binpacking_duration_seconds",
-    "Duration of solver packing per schedule",
+    "Duration of solver packing per schedule batch (all of a pass's "
+    "schedules solve together, sharing one device round trip)",
 )
 BIND_DURATION = REGISTRY.histogram(
     "allocation_bind_duration_seconds",
@@ -182,16 +183,40 @@ class ProvisionerWorker:
             "provision.schedule", provisioner=self.provisioner.name, pods=len(pods)
         ):
             schedules = self.scheduler.solve(self.provisioner, pods)
-        for schedule in schedules:
-            instance_types = self.cloud.get_instance_types(schedule.constraints)
-            with SOLVE_DURATION.measure(), TRACER.span(
-                "provision.solve",
-                pods=len(schedule.pods),
-                instance_types=len(instance_types),
-            ):
-                result = self.solver.solve(
-                    schedule.pods, instance_types, schedule.constraints, daemons
-                )
+        # All schedules solve as ONE batch: device-backed solvers share a
+        # single device->host round trip across them, and the sidecar's
+        # streaming RPC does the same across the wire (the reference loops
+        # Pack per schedule — provisioner.go:102-135).
+        problems = [
+            (
+                schedule.pods,
+                self.cloud.get_instance_types(schedule.constraints),
+                schedule.constraints,
+                daemons,
+            )
+            for schedule in schedules
+        ]
+        with SOLVE_DURATION.measure(), TRACER.span(
+            "provision.solve",
+            schedules=len(problems),
+            pods=sum(len(p[0]) for p in problems),
+        ):
+            results = self.solver.solve_many(problems)
+        for schedule, result in zip(schedules, results):
+            if stats.launch_errors:
+                # An earlier schedule's launch failed (e.g. ICE): its pools
+                # are now in the unavailable-offerings blackout, but this
+                # schedule was solved against the pre-failure batch snapshot.
+                # Re-solve it against fresh instance types so the within-pass
+                # capacity feedback of the sequential loop is preserved
+                # (ref: aws/instancetypes.go:174-183 blackout semantics).
+                fresh_types = self.cloud.get_instance_types(schedule.constraints)
+                with SOLVE_DURATION.measure(), TRACER.span(
+                    "provision.resolve", pods=len(schedule.pods)
+                ):
+                    result = self.solver.solve(
+                        schedule.pods, fresh_types, schedule.constraints, daemons
+                    )
             stats.unschedulable_pods += len(result.unschedulable)
             with BIND_DURATION.measure(), TRACER.span(
                 "provision.bind", nodes=result.node_count

@@ -50,6 +50,32 @@ class Solver(abc.ABC):
         fleet = build_fleet(instance_types, constraints, pods, daemons)
         return self.solve_encoded(groups, fleet)
 
+    def solve_many(
+        self,
+        problems: Sequence[
+            Tuple[Sequence[PodSpec], Sequence[InstanceType], Constraints, Sequence[PodSpec]]
+        ],
+    ) -> List[ffd.PackResult]:
+        """Solve a batch of independent schedule problems. Device-backed
+        solvers override solve_encoded_many to share one device->host round
+        trip across the whole batch (a pod batch regularly splits into many
+        schedules — ref: provisioner.go solves them in a loop, paying the
+        kernel per schedule)."""
+        encoded = []
+        for pods, instance_types, constraints, daemons in problems:
+            encoded.append(
+                (
+                    group_pods(list(pods)),
+                    build_fleet(instance_types, constraints, pods, daemons),
+                )
+            )
+        return self.solve_encoded_many(encoded)
+
+    def solve_encoded_many(
+        self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
+    ) -> List[ffd.PackResult]:
+        return [self.solve_encoded(groups, fleet) for groups, fleet in items]
+
     @abc.abstractmethod
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         ...
@@ -490,17 +516,42 @@ def cost_solve_dense(
     with device_profile(TRACER), TRACER.span(
         "solve.device", groups=num_groups, types=num_types
     ):
-        fused = _cost_fused_kernel(
-            *pad_kernel_args(vectors, counts, capacity, total, prices),
-            lp_steps=lp_steps,
-        )
+        fused = cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps)
         # Overlap with the device: dispatch above is async, so host-side work
         # that only depends on the fleet runs while the kernel computes.
         if callable(pool_prices):
             pool_prices = pool_prices()
-        rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
-            _to_host(fused)
-        )
+        fetched = _to_host(fused)
+
+    return cost_solve_finish(
+        fetched, vectors, counts, capacity, total, prices, pool_prices
+    )
+
+
+def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int = 300):
+    """Dispatch the fused kernel asynchronously; pair with a (batchable)
+    fetch + cost_solve_finish. Splitting dispatch from finish lets a batch of
+    schedules share ONE device->host round trip (the dominant latency on
+    tunneled accelerators) instead of paying it per solve."""
+    return _cost_fused_kernel(
+        *pad_kernel_args(vectors, counts, capacity, total, prices),
+        lp_steps=lp_steps,
+    )
+
+
+def cost_solve_finish(
+    fetched,
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+    prices: np.ndarray,
+    pool_prices: np.ndarray,
+) -> Optional[DenseSolveResult]:
+    """Host-side candidate scoring + LP realization over fetched kernel
+    outputs (the second half of cost_solve_dense)."""
+    num_groups = int(vectors.shape[0])
+    rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = fetched
 
     # Candidates stay in round form; only the winner pays the decode into
     # concrete per-node pod lists.
@@ -707,6 +758,54 @@ class CostSolver(Solver):
                 "cost_solve_dense returned a plan without evaluating pool_prices"
             )
         return decode_dense_result(dense, groups, fleet, pool_zones)
+
+    def solve_encoded_many(
+        self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
+    ) -> List[ffd.PackResult]:
+        """Batch path: dispatch every schedule's fused kernel first (async),
+        build all pool matrices while the device works, then fetch ALL
+        outputs in one device->host transfer — K schedules cost one round
+        trip instead of K (the round trip dominates on tunneled devices)."""
+        results: List[Optional[ffd.PackResult]] = [None] * len(items)
+        pending = []  # (index, groups, fleet, fused, zones, pool_prices)
+        for i, (groups, fleet) in enumerate(items):
+            if fleet.num_types == 0 or groups.num_groups == 0:
+                results[i] = ffd.pack_groups(fleet, groups)
+                continue
+            fused = cost_solve_dispatch(
+                groups.vectors,
+                groups.counts,
+                fleet.capacity,
+                fleet.total,
+                fleet.prices,
+                self.lp_steps,
+            )
+            zones, pool_prices = _pool_price_matrix(fleet)  # overlaps device
+            pending.append((i, groups, fleet, fused, zones, pool_prices))
+
+        if pending:
+            with device_profile(TRACER), TRACER.span(
+                "solve.device.batch", solves=len(pending)
+            ):
+                fetched_all = _to_host([entry[3] for entry in pending])
+            for (i, groups, fleet, _, zones, pool_prices), fetched in zip(
+                pending, fetched_all
+            ):
+                dense = cost_solve_finish(
+                    fetched,
+                    groups.vectors,
+                    groups.counts,
+                    fleet.capacity,
+                    fleet.total,
+                    fleet.prices,
+                    pool_prices,
+                )
+                results[i] = (
+                    ffd.pack_groups(fleet, groups)
+                    if dense is None
+                    else decode_dense_result(dense, groups, fleet, zones)
+                )
+        return results
 
 
 def decode_dense_result(

@@ -74,6 +74,11 @@ class RemoteSolver(Solver):
             request_serializer=pb.SolveRequest.SerializeToString,
             response_deserializer=pb.SolveResponse.FromString,
         )
+        self._stream_rpc = self._channel.stream_stream(
+            wire.SOLVE_STREAM_METHOD,
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=pb.SolveResponse.FromString,
+        )
         self._health_rpc = self._channel.unary_unary(
             wire.HEALTH_METHOD,
             request_serializer=pb.HealthRequest.SerializeToString,
@@ -86,10 +91,7 @@ class RemoteSolver(Solver):
         except grpc.RpcError:
             return None
 
-    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
-        if self.clock() < self._blackout_until:
-            return self.fallback.solve_encoded(groups, fleet)
-
+    def _build_request(self, groups: PodGroups, fleet: InstanceFleet):
         zones, pool_prices = _pool_price_matrix(fleet)
         request = pb.SolveRequest(
             group_vectors=wire.encode_tensor(groups.vectors),
@@ -104,6 +106,58 @@ class RemoteSolver(Solver):
             lp_steps=self.lp_steps,
             quirk=self.quirk,
         )
+        return request, zones
+
+    def solve_encoded_many(self, items) -> list:
+        """Batch of schedules over the streaming RPC: the sidecar dispatches
+        every kernel before fetching, so the batch shares one device round
+        trip. Falls back (whole batch) to the host solver on RPC failure."""
+        items = list(items)
+        if not items:
+            return []
+        if self.clock() < self._blackout_until:
+            return self.fallback.solve_encoded_many(items)
+        built = [self._build_request(groups, fleet) for groups, fleet in items]
+        start = self.clock()
+        responses = None
+        rpc_error = None
+        with TRACER.span(
+            "solver.rpc.stream", endpoint=self.endpoint, solves=len(items)
+        ) as span:
+            try:
+                responses = list(
+                    self._stream_rpc(
+                        iter(request for request, _ in built),
+                        timeout=self.timeout_s * len(items),
+                    )
+                )
+                span.set(outcome="ok")
+            except grpc.RpcError as error:
+                span.set(outcome="error")
+                rpc_error = error
+        if responses is None or len(responses) != len(items):
+            _RPC_HISTOGRAM.observe(self.clock() - start, "error")
+            self._blackout_until = self.clock() + self.blackout_s
+            log.warning(
+                "sidecar %s stream failed (%s); host fallback for %.0fs",
+                self.endpoint,
+                getattr(rpc_error, "code", lambda: "short stream")(),
+                self.blackout_s,
+            )
+            return self.fallback.solve_encoded_many(items)
+        _RPC_HISTOGRAM.observe(self.clock() - start, "ok")
+        return [
+            self._decode(response, groups, fleet, zones)
+            for response, (groups, fleet), (_, zones) in zip(
+                responses, items, built
+            )
+        ]
+
+    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
+        if self.clock() < self._blackout_until:
+            return self.fallback.solve_encoded(groups, fleet)
+
+        request, zones = self._build_request(groups, fleet)
         start = self.clock()
         response = None
         # The span covers ONLY the RPC hop — the fallback solve runs outside

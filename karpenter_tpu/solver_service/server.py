@@ -117,17 +117,9 @@ class _Handler:
                 pool_prices,
                 lp_steps=int(request.lp_steps) or 300,
             )
-            if dense is None:
-                rounds, unschedulable = _host_rounds(
-                    vectors, counts, capacity, total, quirk=True
-                )
-                response.solver = "host-greedy"
-                response.fallback = True
-                _encode_rounds(response, rounds)
-            else:
-                response.solver = "tpu-cost"
-                _encode_rounds(response, dense.rounds, dense.options)
-                unschedulable = dense.unschedulable
+            unschedulable = self._encode_cost(
+                response, dense, vectors, counts, capacity, total
+            )
         elif mode == "ffd":
             rounds, unschedulable, used = self._ffd_rounds(
                 vectors, counts, capacity, total, prices, request.quirk
@@ -147,6 +139,89 @@ class _Handler:
         with self._lock:
             self.solves += 1
         return response
+
+    @staticmethod
+    def _encode_cost(response, dense, vectors, counts, capacity, total):
+        """Encode a cost-solve outcome (host-greedy fallback when dense is
+        None); returns the unschedulable counts for the caller to attach."""
+        if dense is None:
+            rounds, unschedulable = _host_rounds(
+                vectors, counts, capacity, total, quirk=True
+            )
+            response.solver = "host-greedy"
+            response.fallback = True
+            _encode_rounds(response, rounds)
+            return unschedulable
+        response.solver = "tpu-cost"
+        _encode_rounds(response, dense.rounds, dense.options)
+        return dense.unschedulable
+
+    def solve_stream(self, request_iterator, context):
+        """Batched solve: dispatch every cost-mode request's kernel before
+        fetching any result, so the stream shares ONE device->host round trip
+        (the latency floor on tunneled accelerators). Responses are yielded
+        in request order. Non-cost / empty requests take the unary path
+        inline."""
+        ready = {}  # order -> finished SolveResponse
+        pending = []  # (order, start, fused, arrays..., pool_prices)
+        order = 0
+        for request in request_iterator:
+            mode = request.mode or "cost"
+            # Route on the shape fields alone — full tensor decode only on
+            # the path that consumes the data.
+            num_groups = (list(request.group_vectors.shape) or [0])[0]
+            num_types = (list(request.capacity.shape) or [0])[0]
+            if mode != "cost" or num_groups == 0 or num_types == 0:
+                ready[order] = self.solve(request, context)
+            else:
+                start = time.perf_counter()
+                vectors = wire.decode_tensor(request.group_vectors)
+                counts = wire.decode_tensor(request.group_counts)
+                capacity = wire.decode_tensor(request.capacity)
+                total = wire.decode_tensor(request.total)
+                prices = wire.decode_tensor(request.prices)
+                pool_prices = wire.decode_tensor(request.pool_prices)
+                fused = solver_models.cost_solve_dispatch(
+                    vectors,
+                    counts,
+                    capacity,
+                    total,
+                    prices,
+                    int(request.lp_steps) or 300,
+                )
+                pending.append(
+                    (order, start, fused, vectors, counts, capacity, total,
+                     prices, pool_prices)
+                )
+            order += 1
+
+        if pending:
+            with TRACER.span("solver.serve.stream", solves=len(pending)):
+                fetched_all = solver_models._to_host(
+                    [entry[2] for entry in pending]
+                )
+            for (
+                (slot, start, _, vectors, counts, capacity, total, prices,
+                 pool_prices),
+                fetched,
+            ) in zip(pending, fetched_all):
+                response = pb.SolveResponse()
+                dense = solver_models.cost_solve_finish(
+                    fetched, vectors, counts, capacity, total, prices, pool_prices
+                )
+                unschedulable = self._encode_cost(
+                    response, dense, vectors, counts, capacity, total
+                )
+                response.unschedulable.CopyFrom(
+                    wire.encode_tensor(np.asarray(unschedulable, dtype=np.int64))
+                )
+                response.solve_ms = (time.perf_counter() - start) * 1e3
+                with self._lock:
+                    self.solves += 1
+                ready[slot] = response
+
+        for slot in range(order):
+            yield ready[slot]
 
     @staticmethod
     def _ffd_rounds(vectors, counts, capacity, total, prices, quirk):
@@ -189,6 +264,11 @@ class SolverServer:
         method_handlers = {
             "Solve": grpc.unary_unary_rpc_method_handler(
                 self.handler.solve,
+                request_deserializer=pb.SolveRequest.FromString,
+                response_serializer=pb.SolveResponse.SerializeToString,
+            ),
+            "SolveStream": grpc.stream_stream_rpc_method_handler(
+                self.handler.solve_stream,
                 request_deserializer=pb.SolveRequest.FromString,
                 response_serializer=pb.SolveResponse.SerializeToString,
             ),

@@ -13,6 +13,7 @@ from karpenter_tpu.solver_service import solver_pb2 as pb
 
 SERVICE = "karpenter.solver.v1.Solver"
 SOLVE_METHOD = f"/{SERVICE}/Solve"
+SOLVE_STREAM_METHOD = f"/{SERVICE}/SolveStream"
 HEALTH_METHOD = f"/{SERVICE}/Health"
 
 _DTYPES = {

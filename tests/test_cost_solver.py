@@ -130,3 +130,23 @@ class TestCostSolver:
         pods = [fixtures.pod(cpu="1000", name="giant")] + fixtures.pods(5)
         cost = CostSolver().solve(pods, aws_like_catalog(), Constraints())
         assert [p.name for p in cost.unschedulable] == ["giant"]
+
+
+class TestBatchedSolve:
+    def test_solve_encoded_many_matches_sequential(self):
+        from karpenter_tpu.ops.encode import build_fleet, group_pods
+
+        solver = CostSolver()
+        problems = []
+        for n, t in ((120, 8), (60, 5), (0, 3), (30, 0)):
+            pods = fixtures.pods(n, cpu="1", memory="1Gi")
+            catalog = fixtures.size_ladder(t)
+            problems.append(
+                (group_pods(pods), build_fleet(catalog, Constraints(), pods))
+            )
+        batched = solver.solve_encoded_many(problems)
+        sequential = [solver.solve_encoded(g, f) for g, f in problems]
+        for got, want in zip(batched, sequential):
+            assert got.node_count == want.node_count
+            assert got.projected_cost() == pytest.approx(want.projected_cost())
+            assert len(got.unschedulable) == len(want.unschedulable)

@@ -211,3 +211,44 @@ class TestProvisionerLifecycle:
             worker.add(pod)
             h.clock.advance(0.6)  # keeps idle window open
         assert worker.batch_ready()  # 10s max window exceeded
+
+
+class TestCapacityFeedback:
+    def test_later_schedule_resolved_after_capacity_failure(self):
+        """Schedules solve as one batch against a pre-launch snapshot; when an
+        earlier schedule's launch hits insufficient capacity (blacking out its
+        pools), later schedules must be re-solved against fresh instance
+        types or they retry the exhausted pools (ref: the sequential loop's
+        implicit feedback via aws/instancetypes.go:174-183)."""
+        from karpenter_tpu.models.solver import CostSolver
+
+        # A is cheap and the obvious pick; B costs >1.3x so the cost plan's
+        # pool rows never include it as a fallback row for an A-packed node.
+        type_a = fixtures.cpu_instance("type-a", cpu=8, mem_gib=16, price=0.10)
+        type_b = fixtures.cpu_instance("type-b", cpu=8, mem_gib=16, price=0.24)
+        h = Harness(instance_types=[type_a, type_b], solver=CostSolver())
+        h.apply_provisioner(default_provisioner())
+        # Exhaust every type-a pool in zone 1 before the pass.
+        for capacity_type in ("on-demand", "spot"):
+            h.cloud.insufficient_capacity_pools.add(
+                ("type-a", "test-zone-1", capacity_type)
+            )
+
+        # Schedule 1: pinned to type-a in zone-1 — its launch must fail and
+        # black out the pools. Schedule 2: zone-1, free choice of type.
+        probe = fixtures.pod(
+            node_selector={
+                wellknown.INSTANCE_TYPE_LABEL: "type-a",
+                wellknown.ZONE_LABEL: "test-zone-1",
+            }
+        )
+        followers = fixtures.pods(
+            6, cpu="1", memory="1Gi",
+            node_selector={wellknown.ZONE_LABEL: "test-zone-1"},
+        )
+        h.provision(probe, *followers)
+
+        h.expect_not_scheduled(probe)  # its only pool is exhausted
+        for pod in followers:
+            node = h.expect_scheduled(pod)
+            assert node.labels[wellknown.INSTANCE_TYPE_LABEL] == "type-b"

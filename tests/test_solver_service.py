@@ -215,3 +215,51 @@ class FakeClock:
 @pytest.fixture()
 def constraints():
     return Constraints()
+
+
+class TestSolveStream:
+    def test_stream_matches_sequential_unary(self, server, constraints):
+        client = RemoteSolver(f"127.0.0.1:{server.port}")
+        problems = [
+            (make_pods(40), make_instance_types(5)),
+            (make_pods(25), make_instance_types(8)),
+            (make_pods(10), make_instance_types(3)),
+        ]
+        batched = client.solve_many(
+            [(pods, types, constraints, ()) for pods, types in problems]
+        )
+        sequential = [
+            client.solve(pods, types, constraints) for pods, types in problems
+        ]
+        client.close()
+        assert len(batched) == 3
+        for got, want in zip(batched, sequential):
+            assert _packing_signature(got) == _packing_signature(want)
+
+    def test_stream_handles_empty_fleet_entries(self, server, constraints):
+        client = RemoteSolver(f"127.0.0.1:{server.port}")
+        results = client.solve_many(
+            [
+                (make_pods(12), make_instance_types(4), constraints, ()),
+                (make_pods(5), [], constraints, ()),  # nothing to pack onto
+            ]
+        )
+        client.close()
+        assert not results[0].unschedulable
+        assert len(results[1].unschedulable) == 5 and not results[1].packings
+
+    def test_stream_falls_back_whole_batch_on_dead_endpoint(self, constraints):
+        clock = FakeClock()
+        client = RemoteSolver("127.0.0.1:1", timeout_s=0.3, clock=clock)
+        problems = [
+            (make_pods(10), make_instance_types(3), constraints, ()),
+            (make_pods(6), make_instance_types(2), constraints, ()),
+        ]
+        results = client.solve_many(problems)
+        client.close()
+        oracle = GreedySolver().solve_many(problems)
+        assert [r.node_count for r in results] == [r.node_count for r in oracle]
+        assert clock() < client._blackout_until  # blackout armed
+
+    def test_empty_batch(self, remote):
+        assert remote.solve_encoded_many([]) == []
