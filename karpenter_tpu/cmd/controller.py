@@ -9,13 +9,14 @@ Run: python -m karpenter_tpu.cmd.controller --cluster-name my-cluster
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import threading
 
 from karpenter_tpu.cloudprovider import registry
 from karpenter_tpu.controllers.cluster import Cluster
-from karpenter_tpu.runtime import LeaderLock, Manager, serve_http
+from karpenter_tpu.runtime import LeaderElector, LeaderLock, Manager, serve_http
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils import options as options_pkg
 
@@ -25,14 +26,36 @@ def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     log = klog.setup(options.log_level)
     log.info("starting karpenter-tpu controller for cluster %s", options.cluster_name)
 
-    lock = LeaderLock()
-    if options.leader_election:
-        log.info("acquiring leader lock")
-        lock.acquire(blocking=True)
-
-    cloud = registry.new_cloud_provider(options.cloud_provider)
     cluster = cluster if cluster is not None else Cluster()
+    cloud = registry.new_cloud_provider(options.cloud_provider)
+    # Manager is constructed (but not started) before the campaign so the
+    # lease-loss callback has something concrete to stop — no window where a
+    # loss arrives with nothing wired.
     manager = Manager(cluster, cloud, options)
+    stop = threading.Event()
+
+    def on_lost_lease():
+        # Reference behavior: a deposed leader must stop reconciling and get
+        # replaced (cmd/controller/main.go exits on lost lease). Stopping the
+        # manager flips /healthz to 503 so the liveness probe restarts the
+        # pod; in block mode the process also exits.
+        log.error("leadership lost; stopping controller")
+        manager.stop()
+        stop.set()
+
+    identity = f"{os.uname().nodename}-{os.getpid()}"
+    # Two layers of mutual exclusion: the host-level file lock guards
+    # multiple processes on one machine; the store-level lease guards
+    # replicas sharing a cluster store (in production the kube API).
+    file_lock = LeaderLock()
+    elector = LeaderElector(cluster, identity, on_lost=on_lost_lease)
+    if options.leader_election:
+        log.info("campaigning for leadership as %s", identity)
+        file_lock.acquire(blocking=True)
+        elector.acquire(blocking=True)
+        holder = cluster.get_lease(LeaderElector.LEASE_NAME)
+        log.info("leadership acquired; lease holder %s", holder and holder[0])
+
     manager.start()
     serve_http(manager, options.metrics_port)
     # Separate probe port, matching the reference's split (manager.go:52-57)
@@ -47,12 +70,12 @@ def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     )
 
     if block:
-        stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         signal.signal(signal.SIGINT, lambda *_: stop.set())
         stop.wait()
         manager.stop()
-        lock.release()
+        elector.release()
+        file_lock.release()
     return manager
 
 

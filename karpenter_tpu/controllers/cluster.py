@@ -36,6 +36,7 @@ class Cluster:
         self._provisioners: Dict[str, Provisioner] = {}
         self._daemonsets: Dict[str, PodSpec] = {}  # name -> pod template
         self._pdbs: Dict[str, Tuple[Dict[str, str], int]] = {}  # selector, minAvailable
+        self._leases: Dict[str, Tuple[str, float]] = {}  # name -> (holder, expiry)
         self._watchers: List[Callable[[str, object], None]] = []
 
     # --- watch plumbing ----------------------------------------------------
@@ -219,3 +220,36 @@ class Cluster:
     def list_daemonset_templates(self) -> List[PodSpec]:
         with self._lock:
             return list(self._daemonsets.values())
+
+    # --- leases (coordination.k8s.io Lease analogue) -----------------------
+
+    def acquire_lease(self, name: str, holder: str, duration_s: float) -> bool:
+        """Compare-and-swap acquire/renew: succeeds when the lease is free,
+        expired, or already held by `holder` (renewal). The store-side
+        analogue of the Lease object the reference's leader election uses
+        (ref: cmd/controller/main.go:80-81)."""
+        with self._lock:
+            now = self.clock.now()
+            current = self._leases.get(name)
+            if current is not None:
+                current_holder, expiry = current
+                if current_holder != holder and now < expiry:
+                    return False
+            self._leases[name] = (holder, now + duration_s)
+            return True
+
+    def release_lease(self, name: str, holder: str) -> bool:
+        with self._lock:
+            current = self._leases.get(name)
+            if current is None or current[0] != holder:
+                return False
+            del self._leases[name]
+            return True
+
+    def get_lease(self, name: str) -> Optional[Tuple[str, float]]:
+        """(holder, expiry) or None; expired leases read as None."""
+        with self._lock:
+            current = self._leases.get(name)
+            if current is None or self.clock.now() >= current[1]:
+                return None
+            return current

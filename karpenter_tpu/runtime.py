@@ -102,10 +102,78 @@ class ReconcileLoop:
                 self.enqueue(key, delay=float(result))
 
 
+class LeaderElector:
+    """Lease-based leader election over the cluster store
+    (ref: cmd/controller/main.go:80-81 — controller-runtime leader election
+    on a coordination.k8s.io Lease). One candidate holds a named lease and
+    renews it at RENEW_SECONDS; rivals CAS-acquire and win only after the
+    holder's LEASE_SECONDS expire without renewal. Losing a held lease (e.g.
+    a renewal pause longer than the TTL) fires on_lost — production wiring
+    stops the manager, matching the reference's exit-on-lost-lease."""
+
+    LEASE_NAME = "karpenter-tpu-leader"
+    LEASE_SECONDS = 15.0
+    RENEW_SECONDS = 5.0
+
+    def __init__(self, cluster, identity: str, on_lost=None):
+        self.cluster = cluster
+        self.identity = identity
+        self.on_lost = on_lost
+        self.is_leader = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def try_acquire(self) -> bool:
+        won = self.cluster.acquire_lease(
+            self.LEASE_NAME, self.identity, self.LEASE_SECONDS
+        )
+        if won:
+            self.is_leader.set()
+        return won
+
+    def acquire(self, blocking: bool = True, poll_s: float = 1.0) -> bool:
+        """Campaign until leadership (blocking) or one attempt; then keep
+        renewing in the background."""
+        while not self.try_acquire():
+            if not blocking:
+                return False
+            if self._stop.wait(timeout=poll_s):
+                return False
+        self._thread = threading.Thread(target=self._renew_loop, daemon=True)
+        self._thread.start()
+        return True
+
+    def _renew_once(self) -> bool:
+        """One renewal attempt; on failure (someone took our expired lease)
+        drops leadership and fires on_lost."""
+        if self.cluster.acquire_lease(
+            self.LEASE_NAME, self.identity, self.LEASE_SECONDS
+        ):
+            return True
+        self.is_leader.clear()
+        if self.on_lost is not None:
+            self.on_lost()
+        return False
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(timeout=self.RENEW_SECONDS):
+            if not self._renew_once():
+                return
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if self.is_leader.is_set():
+            self.cluster.release_lease(self.LEASE_NAME, self.identity)
+            self.is_leader.clear()
+
+
 class LeaderLock:
-    """Single-host leader election stand-in: an exclusive file lock
-    (ref: cmd/controller/main.go:80-81 leader-election lease). Multi-replica
-    deployments on kube should use a Lease object instead."""
+    """Single-host leader election stand-in: an exclusive file lock.
+    Kept for single-process deployments without a shared store; in-cluster
+    runs use LeaderElector over the Lease analogue."""
 
     def __init__(self, path: str = "/tmp/karpenter-tpu-leader.lock"):
         self.path = path
@@ -261,6 +329,11 @@ class Manager:
             loop.stop()
         self.ready.clear()
 
+    def healthy(self) -> bool:
+        """False once stopped — flips /healthz to 503 (a deposed leader must
+        fail its liveness probe, not idle at 200)."""
+        return not self._stop.is_set()
+
 
 class _HTTPHandler(http.server.BaseHTTPRequestHandler):
     manager: Optional[Manager] = None
@@ -271,8 +344,12 @@ class _HTTPHandler(http.server.BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
         elif self.path == "/healthz":
-            body = b"ok"
-            self.send_response(200)
+            # Unhealthy once the manager stops (e.g. deposed leader) so the
+            # liveness probe restarts the pod instead of letting a stopped
+            # replica idle at 200.
+            healthy = self.manager is None or self.manager.healthy()
+            body = b"ok" if healthy else b"stopped"
+            self.send_response(200 if healthy else 503)
             self.send_header("Content-Type", "text/plain")
         elif self.path == "/readyz":
             ready = self.manager is not None and self.manager.ready.is_set()

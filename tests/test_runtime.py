@@ -182,3 +182,75 @@ class TestWebhook:
 
             validation.DEFAULT_HOOK = None
             validation.VALIDATE_HOOK = None
+
+
+class TestLeaderElection:
+    """Lease-based election (ref: cmd/controller/main.go:80-81)."""
+
+    def _cluster(self):
+        from karpenter_tpu.controllers.cluster import Cluster
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        return Cluster(clock=clock), clock
+
+    def test_single_winner(self):
+        from karpenter_tpu.runtime import LeaderElector
+
+        cluster, _ = self._cluster()
+        a = LeaderElector(cluster, "a")
+        b = LeaderElector(cluster, "b")
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert a.is_leader.is_set() and not b.is_leader.is_set()
+
+    def test_renewal_keeps_leadership(self):
+        from karpenter_tpu.runtime import LeaderElector
+
+        cluster, clock = self._cluster()
+        a = LeaderElector(cluster, "a")
+        b = LeaderElector(cluster, "b")
+        assert a.try_acquire()
+        clock.advance(LeaderElector.LEASE_SECONDS - 1)
+        assert a.try_acquire()  # renew before expiry
+        clock.advance(LeaderElector.LEASE_SECONDS - 1)
+        assert not b.try_acquire()  # renewed lease still live
+
+    def test_expired_lease_hands_over(self):
+        from karpenter_tpu.runtime import LeaderElector
+
+        cluster, clock = self._cluster()
+        a = LeaderElector(cluster, "a")
+        b = LeaderElector(cluster, "b")
+        assert a.try_acquire()
+        clock.advance(LeaderElector.LEASE_SECONDS + 1)
+        assert b.try_acquire()
+        # The stale holder's next renewal fails (CAS sees the new holder).
+        assert not cluster.acquire_lease(
+            LeaderElector.LEASE_NAME, "a", LeaderElector.LEASE_SECONDS
+        )
+
+    def test_release_allows_immediate_takeover(self):
+        from karpenter_tpu.runtime import LeaderElector
+
+        cluster, _ = self._cluster()
+        a = LeaderElector(cluster, "a")
+        b = LeaderElector(cluster, "b")
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire()
+
+    def test_lost_lease_fires_callback(self):
+        from karpenter_tpu.runtime import LeaderElector
+
+        cluster, clock = self._cluster()
+        lost = []
+        a = LeaderElector(cluster, "a", on_lost=lambda: lost.append(True))
+        assert a.try_acquire()
+        clock.advance(LeaderElector.LEASE_SECONDS + 1)
+        b = LeaderElector(cluster, "b")
+        assert b.try_acquire()
+        # Drive one renewal attempt (the thread loop's body).
+        assert not a._renew_once()
+        assert not a.is_leader.is_set()
+        assert lost == [True]
