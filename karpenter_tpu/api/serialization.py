@@ -66,6 +66,10 @@ def provisioner_to_dict(provisioner: Provisioner) -> Dict[str, Any]:
         "status": {
             "resources": dict(provisioner.status.resources),
             "lastScaleTime": provisioner.status.last_scale_time,
+            "conditions": [
+                {"type": kind, "status": "True" if value else "False"}
+                for kind, value in sorted(provisioner.status.conditions.items())
+            ],
         },
     }
     if constraints.provider is not None:
@@ -104,6 +108,11 @@ def provisioner_from_dict(data: Dict[str, Any]) -> Provisioner:
     status = data.get("status", {})
     provisioner.status = ProvisionerStatus(
         last_scale_time=status.get("lastScaleTime"),
+        conditions={
+            c.get("type", ""): c.get("status") == "True"
+            for c in status.get("conditions", [])
+            if c.get("type")
+        },
         resources=dict(status.get("resources", {})),
     )
     return provisioner

@@ -335,6 +335,10 @@ class ProvisioningController:
             )
         else:
             self.workers[provisioner.name].provisioner = effective
+        # A provisioner with a running worker is ready to scale — the Active
+        # status condition (ref: provisioner_status.go:40-50 knative
+        # conditions; the v0.5.x reference defines but barely drives it).
+        provisioner.status.conditions["Active"] = True
 
     def worker(self, name: str) -> Optional[ProvisionerWorker]:
         return self.workers.get(name)
