@@ -13,6 +13,8 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import DO_NOT_SCHEDULE, PodSpec, TopologySpreadConstraint
 from karpenter_tpu.api.provisioner import Constraints, PodIncompatibleError, Provisioner
@@ -58,6 +60,52 @@ class TopologyGroup:
         self.counts[winner] += 1
         return winner
 
+    def assign_many(self, n: int) -> List[str]:
+        """n sequential next_domain() picks, computed in closed form.
+
+        The greedy loop is O(n x domains) Python — a real cost when a 50k-pod
+        deployment carries one spread constraint. Observation: assigning a
+        pod to domain d for the (j+1)-th time happens at "level" counts[d]+j,
+        and greedy always takes the globally smallest (level, name); so the
+        whole sequence is the first n slots of {(counts[d]+j, d)} in
+        (level, name) order — water-filling + one lexsort, bit-identical to
+        the sequential walk (the tensor-style reformulation of
+        topologygroup.go:54-68's mutating argmin)."""
+        if n <= 0 or not self.counts:
+            return []
+        names = sorted(self.counts)
+        counts = np.array([self.counts[d] for d in names], dtype=np.int64)
+        # Smallest water level L with sum(max(0, L - c_d)) >= n.
+        lo, hi = int(counts.min()) + 1, int(counts.max()) + n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if int(np.maximum(0, mid - counts).sum()) >= n:
+                hi = mid
+            else:
+                lo = mid + 1
+        level = lo
+        full = np.maximum(0, (level - 1) - counts)  # slots strictly below L-1
+        remaining = n - int(full.sum())
+        takes = full.copy()
+        # The last `remaining` picks happen at level L-1, in name order among
+        # domains that have a slot there.
+        for i in range(len(names)):
+            if remaining == 0:
+                break
+            if counts[i] + full[i] == level - 1:  # next untaken slot is L-1
+                takes[i] += 1
+                remaining -= 1
+        # Per-pod sequence: lexsort the taken slots by (level, name rank).
+        domain_idx = np.repeat(np.arange(len(names)), takes)
+        levels = np.concatenate(
+            [np.arange(counts[i], counts[i] + takes[i]) for i in range(len(names))]
+        )
+        order = np.lexsort((domain_idx, levels))
+        sequence = [names[i] for i in domain_idx[order]]
+        for i, name in enumerate(names):
+            self.counts[name] += int(takes[i])
+        return sequence
+
 
 class Topology:
     """Injects topology-spread decisions as node selectors
@@ -76,10 +124,17 @@ class Topology:
                 self._compute_hostname(group, members)
             else:
                 self._compute_zonal(group, constraints, members)
-            for pod in members:
-                domain = group.next_domain(
-                    self._allowed_domains_for_pod(pod, group)
-                )
+            allowed_per_pod = [
+                self._allowed_domains_for_pod(pod, group) for pod in members
+            ]
+            if group.counts and all(a is None for a in allowed_per_pod):
+                # Homogeneous fast path: no pod restricts its domains, so the
+                # whole group's greedy sequence computes in closed form.
+                for pod, domain in zip(members, group.assign_many(len(members))):
+                    pod.node_selector[constraint.topology_key] = domain
+                continue
+            for pod, allowed in zip(members, allowed_per_pod):
+                domain = group.next_domain(allowed)
                 if domain is not None:
                     pod.node_selector[constraint.topology_key] = domain
 
