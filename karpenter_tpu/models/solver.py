@@ -25,6 +25,7 @@ from karpenter_tpu.cloudprovider import InstanceType
 from karpenter_tpu.ops import ffd
 from karpenter_tpu.ops.encode import InstanceFleet, PodGroups, build_fleet, group_pods
 from karpenter_tpu.ops.pack_kernel import bucket_size, pack_kernel, pad_to
+from karpenter_tpu.ops.pallas_kernels import dominance_prices
 from karpenter_tpu.ops.score_kernel import (
     feasibility_mask,
     lp_relax_solve,
@@ -130,13 +131,10 @@ def _cost_fused_kernel(
     dominating-type minimum price — the price the realization will actually
     pay, not t's own list price. The [T, T] dominance reduction is tensor
     math, so it rides along in the same compiled computation."""
-    dominates = (
-        capacity[None, :, :] >= capacity[:, None, :] - 1e-6
-    ).all(axis=2)  # [T, T'] — t' can host any node packed for t
     valid_prices = jnp.where(valid, prices, jnp.inf)
-    effective_prices = jnp.where(dominates, valid_prices[None, :], jnp.inf).min(
-        axis=1
-    )
+    # [T, T'] dominance + masked min as a VMEM-resident pallas kernel on TPU
+    # (ops/pallas_kernels.py), XLA formulation elsewhere.
+    effective_prices = dominance_prices(capacity, valid_prices)
     rounds_ffd = pack_kernel(
         vectors, counts, capacity, total, valid, effective_prices,
         quirk=False, mode="ffd",
