@@ -70,6 +70,10 @@ class PodSpec:
     node_selector: Dict[str, str] = field(default_factory=dict)
     # Required node affinity: OR over terms, AND within a term.
     required_terms: List[List[Requirement]] = field(default_factory=list)
+    # matchFields terms are modeled only so selection can reject them
+    # (ref: selection/controller.go validate:108-159 — the provisioning path
+    # doesn't support field selectors).
+    match_fields_terms: List[dict] = field(default_factory=list)
     preferred_terms: List[PreferredTerm] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)

@@ -80,6 +80,8 @@ class SelectionController:
             raise UnsupportedPodError("pod affinity is not supported")
         if pod.pod_anti_affinity_terms:
             raise UnsupportedPodError("pod anti-affinity is not supported")
+        if pod.match_fields_terms:
+            raise UnsupportedPodError("node affinity matchFields is not supported")
         for constraint in pod.topology_spread:
             if constraint.topology_key not in SUPPORTED_TOPOLOGY_KEYS:
                 raise UnsupportedPodError(

@@ -95,3 +95,17 @@ class TestSelection:
         for worker in h.provisioning.workers.values():
             worker.provision()
         h.expect_scheduled(pod)
+
+
+class TestMatchFields:
+    def test_match_fields_rejected(self):
+        """Ref: selection/controller.go validate:108-159 rejects matchFields."""
+        from karpenter_tpu.api.provisioner import Provisioner
+
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default"))
+        pod = fixtures.pod(
+            match_fields_terms=[{"key": "metadata.name", "operator": "In", "values": ["n"]}]
+        )
+        h.provision(pod)
+        h.expect_not_scheduled(pod)
