@@ -163,14 +163,16 @@ class ProvisionerWorker:
     def provision(self) -> ProvisionStats:
         stats = ProvisionStats()
         batch = self._drain()
-        # Re-fetch and drop pods bound/terminated since batching
-        # (ref: provisioner.go:169-185).
+        # Re-fetch to drop pods bound/terminated since batching, but keep
+        # scheduling the BATCH copy — it may carry relaxed preferences the
+        # stored spec deliberately doesn't ("Do not mutate the pod in case
+        # the scheduler relaxed constraints", ref: provisioner.go:169-185).
         pods = []
         for pod in batch:
             live = self.cluster.try_get_pod(pod.namespace, pod.name)
             if live is None or not live.is_provisionable():
                 continue
-            pods.append(live)
+            pods.append(pod)
         if not pods:
             return stats
 

@@ -9,15 +9,18 @@ matching provisioner in alphabetical order.
 
 from __future__ import annotations
 
-from typing import List, Optional
+import copy
+from typing import List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
-from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.pods import PodSpec, PreferredTerm
 from karpenter_tpu.api.provisioner import PodIncompatibleError
-from karpenter_tpu.api.requirements import SUPPORTED_OPERATORS
+from karpenter_tpu.api.requirements import Requirement, SUPPORTED_OPERATORS
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.provisioning import ProvisioningController
 from karpenter_tpu.controllers.scheduling import SUPPORTED_TOPOLOGY_KEYS
+from karpenter_tpu.utils.cache import TtlCache
+from karpenter_tpu.utils.clock import Clock
 
 
 class UnsupportedPodError(Exception):
@@ -25,22 +28,60 @@ class UnsupportedPodError(Exception):
     (ref: selection/controller.go validate:108-159)."""
 
 
-class Preferences:
-    """Iterative relaxation for pods that keep failing to schedule
-    (ref: selection/preferences.go:50-106): first drop the heaviest preferred
-    term, then drop leading required OR-terms so later alternatives get
-    tried. Pods are live objects in our store, so relaxation mutates the pod
-    instead of maintaining the reference's UID-keyed TTL cache."""
+# One pod's relaxation state: (preferred terms left, required OR-terms left).
+_RelaxState = Tuple[List[PreferredTerm], List[List[Requirement]]]
 
-    def relax(self, pod: PodSpec) -> bool:
-        if pod.preferred_terms:
-            heaviest = max(pod.preferred_terms, key=lambda term: term.weight)
-            pod.preferred_terms.remove(heaviest)
-            return True
-        if len(pod.required_terms) > 1:
-            pod.required_terms.pop(0)
-            return True
-        return False
+
+class Preferences:
+    """UID-keyed relaxation side-cache for pods that keep failing to schedule
+    (ref: selection/preferences.go:40-106): first drop the heaviest preferred
+    term, then drop leading required OR-terms so later alternatives get tried.
+
+    The stored pod spec is never mutated — relaxation lives in this cache and
+    the selection path schedules a detached copy carrying the relaxed terms.
+    Like the reference's go-cache, the TTL refreshes only when a relax step
+    actually happens (Set, not Get): a pod stuck for five minutes gets its
+    full preferences back and the relaxation cycle restarts."""
+
+    TTL_SECONDS = 300.0
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self._cache = TtlCache(self.TTL_SECONDS, clock)
+
+    def current(self, pod: PodSpec) -> PodSpec:
+        """The pod as the provisioning path should see it right now: either
+        the pod itself (never relaxed) or a detached copy carrying the cached
+        relaxation."""
+        state = self._cache.get(pod.uid)
+        if state is None:
+            return pod
+        return self._with_terms(pod, state)
+
+    def advance(self, pod: PodSpec) -> bool:
+        """Relax one more step after a failed scheduling attempt
+        (ref: preferences.go:64-106 relax). Returns False when only the last
+        required term remains — that one is never dropped."""
+        preferred, required = self._cache.get(pod.uid) or self._copy_terms(pod)
+        if preferred:
+            heaviest = max(preferred, key=lambda term: term.weight)
+            preferred = [term for term in preferred if term is not heaviest]
+        elif len(required) > 1:
+            required = required[1:]
+        else:
+            return False
+        self._cache.set(pod.uid, (preferred, required))
+        return True
+
+    @staticmethod
+    def _copy_terms(pod: PodSpec) -> _RelaxState:
+        return list(pod.preferred_terms), [list(term) for term in pod.required_terms]
+
+    @staticmethod
+    def _with_terms(pod: PodSpec, state: _RelaxState) -> PodSpec:
+        shadow = copy.copy(pod)
+        shadow.preferred_terms = list(state[0])
+        shadow.required_terms = [list(term) for term in state[1]]
+        return shadow
 
 
 class SelectionController:
@@ -51,7 +92,7 @@ class SelectionController:
     def __init__(self, cluster: Cluster, provisioning: ProvisioningController):
         self.cluster = cluster
         self.provisioning = provisioning
-        self.preferences = Preferences()
+        self.preferences = Preferences(cluster.clock)
 
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
         pod = self.cluster.try_get_pod(namespace, name)
@@ -62,16 +103,19 @@ class SelectionController:
         except UnsupportedPodError:
             return None  # ignored; kube-scheduler owns it (ref: :70-75)
 
-        matched, enqueued = self._select_and_enqueue(pod)
-        if enqueued:
-            return self.REQUEUE_SECONDS
+        # Schedule the pod at its current relaxation level. The stored spec
+        # is never touched: workers receive a detached relaxed copy
+        # (ref: preferences.go keeps relaxation in a UID-keyed TTL cache and
+        # provisioner.go:172 deliberately batches the in-memory relaxed pod).
+        relaxed = self.preferences.current(pod)
+        matched, enqueued = self._select_and_enqueue(relaxed)
         if matched:
-            # A provisioner tolerates the pod but its batch is full — retry
-            # without corrupting the pod's preferences (relaxation is only
-            # for genuine incompatibility; ref: preferences.go:50-63).
+            # Enqueued (re-verify in 1s, ref: :77) — or the batch was full:
+            # retry without relaxing further (relaxation is only for genuine
+            # incompatibility; ref: preferences.go:50-63).
             return self.REQUEUE_SECONDS
-        # No provisioner matched: relax and retry if anything was relaxable.
-        if self.preferences.relax(pod):
+        # No provisioner matched: relax one step and retry if possible.
+        if self.preferences.advance(pod):
             return self.REQUEUE_SECONDS
         return None
 

@@ -17,11 +17,18 @@ _MISSING = object()
 
 
 class TtlCache:
+    # Expired entries are only reaped when their key is looked up, so a
+    # churn-heavy keyspace (e.g. pod UIDs) would otherwise grow without
+    # bound; every SWEEP_INTERVAL-th set() purges all expired entries
+    # (go-cache runs a janitor goroutine for the same reason).
+    SWEEP_INTERVAL = 256
+
     def __init__(self, ttl: float, clock: Optional[Clock] = None):
         self.ttl = ttl
         self.clock = clock or Clock()
         self._entries: Dict[Hashable, Tuple[float, Any]] = {}
         self._lock = threading.Lock()
+        self._sets_since_sweep = 0
 
     def get(self, key: Hashable, default: Any = None) -> Any:
         with self._lock:
@@ -41,7 +48,13 @@ class TtlCache:
         """Store (or refresh the TTL of) key. The reference notes the same
         refresh-on-set semantics for ICE blackouts (instancetypes.go:181)."""
         with self._lock:
-            self._entries[key] = (self.clock.now() + self.ttl, value)
+            now = self.clock.now()
+            self._entries[key] = (now + self.ttl, value)
+            self._sets_since_sweep += 1
+            if self._sets_since_sweep >= self.SWEEP_INTERVAL:
+                self._sets_since_sweep = 0
+                for stale in [k for k, (exp, _) in self._entries.items() if exp <= now]:
+                    del self._entries[stale]
 
     def delete(self, key: Hashable) -> None:
         with self._lock:

@@ -97,6 +97,62 @@ class TestSelection:
         h.expect_scheduled(pod)
 
 
+class TestPreferencesSideCache:
+    """Ref: selection/preferences.go:40-106 — relaxation lives in a UID-keyed
+    5-minute TTL cache; the stored pod spec is never mutated."""
+
+    def _impossible_preference(self):
+        return PreferredTerm(
+            weight=10,
+            requirements=[Requirement.in_(wellknown.ZONE_LABEL, ["mars-1a"])],
+        )
+
+    def test_relaxed_then_scheduled_pod_keeps_original_affinity(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        pod = fixtures.pod(preferred_terms=[self._impossible_preference()])
+        h.provision(pod)
+        h.expect_not_scheduled(pod)  # preference blocks the first pass
+        h.selection.reconcile(pod.namespace, pod.name)  # retry: relaxed copy
+        for worker in h.provisioning.workers.values():
+            worker.provision()
+        h.expect_scheduled(pod)
+        live = h.cluster.get_pod(pod.namespace, pod.name)
+        assert len(live.preferred_terms) == 1  # the user's spec is untouched
+        assert live.preferred_terms[0].weight == 10
+
+    def test_required_terms_never_mutated_in_store(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        pod = fixtures.pod(
+            required_terms=[
+                [Requirement.in_(wellknown.ZONE_LABEL, ["nowhere"])],
+                [Requirement.in_(wellknown.ZONE_LABEL, ["test-zone-2"])],
+            ]
+        )
+        from tests.test_scheduling import provision_with_retries
+
+        live = provision_with_retries(h, pod)
+        assert live.node_name is not None
+        assert len(live.required_terms) == 2  # both OR-terms survive in store
+
+    def test_relaxation_expires_after_ttl(self):
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        pod = fixtures.pod(preferred_terms=[self._impossible_preference()])
+        h.cluster.apply_pod(pod)
+        h.selection.reconcile(pod.namespace, pod.name)  # fails, relaxes
+        relaxed = h.selection.preferences.current(
+            h.cluster.get_pod(pod.namespace, pod.name)
+        )
+        assert relaxed.preferred_terms == []  # relaxation is active
+        h.clock.advance(301.0)
+        restored = h.selection.preferences.current(
+            h.cluster.get_pod(pod.namespace, pod.name)
+        )
+        assert len(restored.preferred_terms) == 1  # forgotten after 5 min
+
+
 class TestMatchFields:
     def test_match_fields_rejected(self):
         """Ref: selection/controller.go validate:108-159 rejects matchFields."""

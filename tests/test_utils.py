@@ -1,0 +1,37 @@
+"""Utility-layer tests (ref: pkg/utils — functional/suite_test.go is the
+reference's analogue of plain unit coverage for the helper packages)."""
+
+from karpenter_tpu.utils.cache import TtlCache
+from karpenter_tpu.utils.clock import FakeClock
+
+
+class TestTtlCache:
+    def test_expiry(self):
+        clock = FakeClock()
+        cache = TtlCache(ttl=10.0, clock=clock)
+        cache.set("a", 1)
+        assert cache.get("a") == 1
+        clock.advance(11.0)
+        assert cache.get("a") is None
+
+    def test_set_refreshes_ttl(self):
+        clock = FakeClock()
+        cache = TtlCache(ttl=10.0, clock=clock)
+        cache.set("a", 1)
+        clock.advance(8.0)
+        cache.set("a", 2)
+        clock.advance(8.0)
+        assert cache.get("a") == 2
+
+    def test_periodic_sweep_bounds_memory(self):
+        """Expired entries for keys never looked up again must not accumulate
+        (pod-UID keyspaces churn; go-cache solves this with a janitor)."""
+        clock = FakeClock()
+        cache = TtlCache(ttl=10.0, clock=clock)
+        for i in range(TtlCache.SWEEP_INTERVAL):
+            cache.set(f"old-{i}", i)
+        clock.advance(11.0)
+        # These sets trigger a sweep that purges every expired old-* entry.
+        for i in range(TtlCache.SWEEP_INTERVAL):
+            cache.set(f"new-{i}", i)
+        assert len(cache._entries) <= TtlCache.SWEEP_INTERVAL + 1
