@@ -110,6 +110,37 @@ def make_workload(num_pods=50_000, num_types=400, seed=0):
     return pods, catalog, market
 
 
+def bench_bind(num_pods=10_000, pods_per_node=100):
+    """Bind-stage benchmark: register nodes and bind 10k pods through the
+    parallel fan-out (ref: provisioner.go:239-247). Store-backed, so this
+    measures the framework overhead floor; with an apiserver backend each
+    bind is an RPC and the fan-out is what keeps the stage off the critical
+    path."""
+    from karpenter_tpu.api.pods import PodSpec
+    from karpenter_tpu.api.provisioner import Provisioner
+    from karpenter_tpu.cloudprovider import NodeSpec
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.cluster import Cluster
+    from karpenter_tpu.controllers.provisioning import ProvisionerWorker
+
+    cluster = Cluster()
+    pods = [PodSpec(name=f"bind-{i}", unschedulable=True) for i in range(num_pods)]
+    for pod in pods:
+        cluster.apply_pod(pod)
+    worker = ProvisionerWorker(
+        Provisioner(name="bind-bench"), cluster, FakeCloudProvider()
+    )
+    start = time.perf_counter()
+    for n in range(0, num_pods, pods_per_node):
+        worker._register_and_bind(
+            NodeSpec(name=f"bench-node-{n}"), pods[n : n + pods_per_node]
+        )
+    elapsed_ms = (time.perf_counter() - start) * 1e3
+    bound = sum(1 for p in pods if cluster.get_pod(p.namespace, p.name).node_name)
+    assert bound == num_pods, f"only {bound}/{num_pods} pods bound"
+    return elapsed_ms
+
+
 def main():
     from karpenter_tpu.api.provisioner import Constraints
     from karpenter_tpu.models.solver import CostSolver, GreedySolver
@@ -240,6 +271,7 @@ def main():
                 "warmup_compile_s": round(warmup_s, 1),
                 "device_fetch_floor_ms": round(device_fetch_floor_ms, 1),
                 "batch8_schedules_ms": round(batch8_ms, 1),
+                "bind_10k_ms": round(bench_bind(), 1),
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),

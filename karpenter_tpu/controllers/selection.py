@@ -108,7 +108,7 @@ class SelectionController:
         # (ref: preferences.go keeps relaxation in a UID-keyed TTL cache and
         # provisioner.go:172 deliberately batches the in-memory relaxed pod).
         relaxed = self.preferences.current(pod)
-        matched, enqueued = self._select_and_enqueue(relaxed)
+        matched, _ = self._select_and_enqueue(relaxed)
         if matched:
             # Enqueued (re-verify in 1s, ref: :77) — or the batch was full:
             # retry without relaxing further (relaxation is only for genuine

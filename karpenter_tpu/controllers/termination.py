@@ -8,6 +8,7 @@ deleted at the cloud provider before the finalizer is removed.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
@@ -15,6 +16,7 @@ from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.cloudprovider import CloudProvider, NodeSpec
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.errors import PDBViolationError
+from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.workqueue import BackoffQueue
 
 CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
@@ -22,18 +24,29 @@ CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
 
 class EvictionQueue:
     """Async rate-limited eviction worker (ref: termination/eviction.go:45-109):
-    set-deduped, exponential backoff 100ms -> 10s, PDB violations retry."""
+    set-deduped, exponential backoff 100ms -> 10s, PDB violations retry.
+
+    The queue drains from its OWN pump thread (start()/stop()), independent of
+    any termination reconcile — the reference runs a standalone worker
+    goroutine (eviction.go:45-57), so queued evictions survive a node whose
+    reconcile stops requeueing. Tests without a runtime call drain_once()."""
+
+    PUMP_INTERVAL_SECONDS = 0.1
 
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
         self.queue = BackoffQueue(base_delay=0.1, max_delay=10.0, clock=cluster.clock)
+        self.log = klog.named("eviction")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
 
     def add(self, pods: List[PodSpec]) -> None:
         for pod in pods:
             self.queue.add((pod.namespace, pod.name))
 
     def drain_once(self) -> int:
-        """Pump the queue once (the runtime loops this; tests call directly)."""
+        """Pump the queue once (the pump thread loops this; tests call it
+        directly)."""
 
         def evict(key) -> bool:
             namespace, name = key
@@ -47,6 +60,32 @@ class EvictionQueue:
                 return False  # 429-equivalent: retry with backoff
 
         return self.queue.process(evict)
+
+    def start(self) -> None:
+        """Start the standalone pump thread (idempotent). Each pump owns its
+        stop Event: a pump that outlived its stop()'s join timeout keeps its
+        already-set Event and still exits, instead of being revived by the
+        next start()."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, args=(self._stop,), name="eviction-queue", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _pump(self, stop: threading.Event) -> None:
+        while not stop.wait(timeout=self.PUMP_INTERVAL_SECONDS):
+            try:
+                self.drain_once()
+            except Exception:  # noqa: BLE001 — the pump must survive store errors
+                self.log.exception("eviction drain failed")
 
 
 class Terminator:
@@ -122,7 +161,9 @@ class TerminationController:
             return None
         self.terminator.cordon(node)
         if not self.terminator.drain(node):
-            self.evictions.drain_once()
+            # Evictions drain from the EvictionQueue's own pump thread
+            # (ref: eviction.go:45-57) — the reconcile only requeues to
+            # observe progress.
             return self.REQUEUE_SECONDS
         self.terminator.terminate(node)
         return None

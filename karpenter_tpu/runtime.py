@@ -311,6 +311,9 @@ class Manager:
         self.cluster.watch(self._on_event)
         for loop in self.loops.values():
             loop.start()
+        # Standalone eviction pump (ref: termination/eviction.go:45-57): the
+        # queue drains even when no termination reconcile is in flight.
+        self.termination.evictions.start()
         threading.Thread(target=self._batch_loop, daemon=True).start()
         threading.Thread(target=self._requeue_loop, daemon=True).start()
         # Seed existing state.
@@ -327,6 +330,7 @@ class Manager:
         self._stop.set()
         for loop in self.loops.values():
             loop.stop()
+        self.termination.evictions.stop()
         self.ready.clear()
 
     def healthy(self) -> bool:

@@ -252,3 +252,30 @@ class TestCapacityFeedback:
         for pod in followers:
             node = h.expect_scheduled(pod)
             assert node.labels[wellknown.INSTANCE_TYPE_LABEL] == "type-b"
+
+
+class TestParallelBind:
+    """Ref: provisioner.go:239-247 — pod binds fan out concurrently."""
+
+    def test_many_pods_bound_to_one_node(self):
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        pods = fixtures.pods(200)
+        h.provision(*pods)
+        for pod in pods:
+            assert h.cluster.get_pod(pod.namespace, pod.name).node_name is not None
+
+    def test_failed_bind_is_not_fatal(self):
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        h = Harness()
+        h.apply_provisioner(default_provisioner())
+        worker = h.provisioning.workers["default"]
+        applied = fixtures.pods(3)
+        for pod in applied:
+            h.cluster.apply_pod(pod)
+        ghost = PodSpec(name="never-applied")  # bind raises NotFoundError
+        node = NodeSpec(name="bind-test-node")
+        worker._register_and_bind(node, [*applied, ghost])
+        for pod in applied:
+            assert h.cluster.get_pod(pod.namespace, pod.name).node_name == node.name
