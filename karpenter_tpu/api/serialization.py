@@ -133,6 +133,12 @@ def pod_to_dict(pod: PodSpec) -> Dict[str, Any]:
             "requiredTerms": [
                 [requirement_to_dict(r) for r in term] for term in pod.required_terms
             ],
+            # matchFields terms round-trip so selection can REJECT pods using
+            # them (ref: selection/controller.go validate:108-159) — dropping
+            # them here would silently accept what the reference refuses.
+            "matchFieldsTerms": [dict(t) for t in pod.match_fields_terms],
+            "podAffinityTerms": [dict(t) for t in pod.pod_affinity_terms],
+            "podAntiAffinityTerms": [dict(t) for t in pod.pod_anti_affinity_terms],
             "preferredTerms": [
                 {
                     "weight": term.weight,
@@ -184,6 +190,11 @@ def pod_from_dict(data: Dict[str, Any]) -> PodSpec:
         required_terms=[
             [requirement_from_dict(r) for r in term]
             for term in spec.get("requiredTerms", [])
+        ],
+        match_fields_terms=[dict(t) for t in spec.get("matchFieldsTerms", [])],
+        pod_affinity_terms=[dict(t) for t in spec.get("podAffinityTerms", [])],
+        pod_anti_affinity_terms=[
+            dict(t) for t in spec.get("podAntiAffinityTerms", [])
         ],
         preferred_terms=[
             PreferredTerm(

@@ -46,7 +46,11 @@ def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     identity = f"{os.uname().nodename}-{os.getpid()}"
     # Two layers of mutual exclusion: the host-level file lock guards
     # multiple processes on one machine; the store-level lease guards
-    # replicas sharing a cluster store (in production the kube API).
+    # replicas ONLY when they share a cluster store. With the default
+    # in-memory store each replica holds its own private lease, so there is
+    # no cross-replica exclusion — the chart pins replicas to 1 for exactly
+    # this reason (values.yaml). An apiserver-backed store makes the lease a
+    # real coordination.k8s.io Lease and lifts that restriction.
     file_lock = LeaderLock()
     elector = LeaderElector(cluster, identity, on_lost=on_lost_lease)
     if options.leader_election:

@@ -60,10 +60,9 @@ def _dominance_kernel(capacity_ref, capacity_t_ref, prices_ref, out_ref):
 
 
 @jax.jit
-def dominance_prices(capacity: jnp.ndarray, prices: jnp.ndarray) -> jnp.ndarray:
-    """Effective (dominance-minimum) prices: Pallas on TPU, XLA elsewhere."""
-    if jax.default_backend() != "tpu":
-        return _dominance_prices_ref(capacity, prices)
+def _dominance_prices_pallas(
+    capacity: jnp.ndarray, prices: jnp.ndarray
+) -> jnp.ndarray:
     from jax.experimental import pallas as pl
 
     num_types = capacity.shape[0]
@@ -72,3 +71,46 @@ def dominance_prices(capacity: jnp.ndarray, prices: jnp.ndarray) -> jnp.ndarray:
         out_shape=jax.ShapeDtypeStruct((1, num_types), capacity.dtype),
     )(capacity, capacity.T, prices.reshape(num_types, 1))
     return out.reshape(num_types)
+
+
+# Mosaic-lowering probe result: None = not yet probed.
+_pallas_usable_cache = None
+
+
+def _pallas_usable() -> bool:
+    """Probe the Pallas/Mosaic lowering ONCE, eagerly, at the north-star
+    padded shape ([512, 8]). dominance_prices is traced inside the fused
+    solve kernel, so a lowering failure there would surface as a compile
+    error propagating out of CostSolver.solve with no way to catch it at
+    trace time — this probe runs outside any trace and permanently routes
+    dominance pricing through the XLA formulation if the kernel doesn't
+    compile on this backend/generation."""
+    global _pallas_usable_cache
+    if _pallas_usable_cache is None:
+        try:
+            probe = jax.block_until_ready(
+                _dominance_prices_pallas(
+                    jnp.ones((512, 8), jnp.float32), jnp.ones((512,), jnp.float32)
+                )
+            )
+            _pallas_usable_cache = bool(probe.shape == (512,))
+        except Exception as err:  # noqa: BLE001 — any lowering failure
+            from karpenter_tpu.utils import logging as klog
+
+            klog.named("pallas").warning(
+                "pallas dominance kernel unusable on %s (%s); "
+                "using the XLA formulation",
+                jax.default_backend(),
+                err,
+            )
+            _pallas_usable_cache = False
+    return _pallas_usable_cache
+
+
+def dominance_prices(capacity: jnp.ndarray, prices: jnp.ndarray) -> jnp.ndarray:
+    """Effective (dominance-minimum) prices: Pallas on TPU when the lowering
+    probe passes, XLA formulation elsewhere. The branch is trace-time Python,
+    so this is safe to call under an outer jit."""
+    if jax.default_backend() == "tpu" and _pallas_usable():
+        return _dominance_prices_pallas(capacity, prices)
+    return _dominance_prices_ref(capacity, prices)

@@ -35,6 +35,22 @@ from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.options import Options
 
+# Reconcile-loop health metrics, mirroring what the reference's controllers
+# dashboard graphs (grafana-dashboards/karpenter-controllers.json reads
+# workqueue_depth, controller_runtime_reconcile_total, and the reconcile
+# duration histogram that controller-runtime exports for every controller).
+WORKQUEUE_DEPTH = REGISTRY.gauge(
+    "workqueue_depth", "Items queued per reconcile loop", ["name"]
+)
+RECONCILE_TOTAL = REGISTRY.counter(
+    "reconcile_total",
+    "Reconciles per loop by outcome (success|requeue|error)",
+    ["controller", "result"],
+)
+RECONCILE_DURATION = REGISTRY.histogram(
+    "reconcile_time_seconds", "Reconcile latency per loop", ["controller"]
+)
+
 
 class ReconcileLoop:
     """A keyed reconcile queue with delayed requeue — the controller-runtime
@@ -62,6 +78,7 @@ class ReconcileLoop:
             self._queued.add(key)
             self._seq += 1
             heapq.heappush(self._heap, (_time.monotonic() + delay, self._seq, key))
+            WORKQUEUE_DEPTH.set(len(self._heap), self.name)
             self._cv.notify()
 
     def start(self) -> None:
@@ -93,11 +110,18 @@ class ReconcileLoop:
                     return
                 _, _, key = heapq.heappop(self._heap)
                 self._queued.discard(key)
-            try:
-                result = self.reconcile(key)
-            except Exception:  # noqa: BLE001 — a reconcile error must not kill the loop
-                self.log.exception("reconcile %r failed", key)
-                result = 1.0
+                WORKQUEUE_DEPTH.set(len(self._heap), self.name)
+            outcome = "success"
+            with RECONCILE_DURATION.measure(self.name):
+                try:
+                    result = self.reconcile(key)
+                    if result is not None:
+                        outcome = "requeue"
+                except Exception:  # noqa: BLE001 — must not kill the loop
+                    self.log.exception("reconcile %r failed", key)
+                    result = 1.0
+                    outcome = "error"
+            RECONCILE_TOTAL.inc(self.name, outcome)
             if result is not None:
                 self.enqueue(key, delay=float(result))
 
@@ -109,7 +133,11 @@ class LeaderElector:
     renews it at RENEW_SECONDS; rivals CAS-acquire and win only after the
     holder's LEASE_SECONDS expire without renewal. Losing a held lease (e.g.
     a renewal pause longer than the TTL) fires on_lost — production wiring
-    stops the manager, matching the reference's exit-on-lost-lease."""
+    stops the manager, matching the reference's exit-on-lost-lease.
+
+    Scope note: mutual exclusion spans exactly the processes sharing this
+    Cluster store. Over the in-memory store that is one process (the chart
+    pins replicas=1); an apiserver-backed store extends it cluster-wide."""
 
     LEASE_NAME = "karpenter-tpu-leader"
     LEASE_SECONDS = 15.0
@@ -122,12 +150,14 @@ class LeaderElector:
         self.is_leader = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_renew: Optional[float] = None
 
     def try_acquire(self) -> bool:
         won = self.cluster.acquire_lease(
             self.LEASE_NAME, self.identity, self.LEASE_SECONDS
         )
         if won:
+            self._last_renew = self.cluster.clock.now()
             self.is_leader.set()
         return won
 
@@ -145,10 +175,24 @@ class LeaderElector:
 
     def _renew_once(self) -> bool:
         """One renewal attempt; on failure (someone took our expired lease)
-        drops leadership and fires on_lost."""
+        drops leadership and fires on_lost.
+
+        Fencing: if more than LEASE_SECONDS elapsed since our last successful
+        renewal (a pause longer than the TTL — GC, suspend, store outage),
+        the lease may have expired and a rival may have acquired it; re-CASing
+        could steal it back mid-term, so leadership is declared lost WITHOUT
+        attempting the CAS. The reference's leaderelection library likewise
+        treats a missed renew deadline as lost leadership."""
+        now = self.cluster.clock.now()
+        if self._last_renew is None or now - self._last_renew > self.LEASE_SECONDS:
+            self.is_leader.clear()
+            if self.on_lost is not None:
+                self.on_lost()
+            return False
         if self.cluster.acquire_lease(
             self.LEASE_NAME, self.identity, self.LEASE_SECONDS
         ):
+            self._last_renew = self.cluster.clock.now()
             return True
         self.is_leader.clear()
         if self.on_lost is not None:

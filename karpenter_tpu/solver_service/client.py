@@ -39,6 +39,12 @@ BLACKOUT_SECONDS = 30.0
 # Generous per-solve deadline: the 50k x 400 north-star config solves in
 # ~110ms; anything past this is a wedged sidecar, not a slow solve.
 DEFAULT_TIMEOUT_SECONDS = 10.0
+# Stream deadline: base covers compile-on-first-shape, then a small per-item
+# increment, hard-capped — a wedged sidecar must degrade to host fallback in
+# seconds regardless of batch size (timeout_s * len(items) let a large pass
+# block provisioning for minutes).
+STREAM_PER_ITEM_SECONDS = 0.25
+STREAM_TIMEOUT_CAP_SECONDS = 30.0
 
 _RPC_HISTOGRAM = REGISTRY.histogram(
     "solver_rpc_duration_seconds",
@@ -125,10 +131,14 @@ class RemoteSolver(Solver):
             "solver.rpc.stream", endpoint=self.endpoint, solves=len(items)
         ) as span:
             try:
+                deadline = min(
+                    STREAM_TIMEOUT_CAP_SECONDS,
+                    self.timeout_s + STREAM_PER_ITEM_SECONDS * len(items),
+                )
                 responses = list(
                     self._stream_rpc(
                         iter(request for request, _ in built),
-                        timeout=self.timeout_s * len(items),
+                        timeout=deadline,
                     )
                 )
                 span.set(outcome="ok")
@@ -146,8 +156,13 @@ class RemoteSolver(Solver):
             )
             return self.fallback.solve_encoded_many(items)
         _RPC_HISTOGRAM.observe(self.clock() - start, "ok")
+        # A per-request "error" marker means the sidecar isolated a failure
+        # to that item (server solve_stream); host-solve it alone instead of
+        # failing or blacking out the whole batch.
         return [
-            self._decode(response, groups, fleet, zones)
+            self.fallback.solve_encoded(groups, fleet)
+            if response.solver == "error"
+            else self._decode(response, groups, fleet, zones)
             for response, (groups, fleet), (_, zones) in zip(
                 responses, items, built
             )

@@ -33,6 +33,32 @@ from karpenter_tpu.utils.tracing import TRACER
 log = klog.named("solver-server")
 
 
+class _RequestAbort(Exception):
+    def __init__(self, code, details):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class _RequestScopedContext:
+    """abort() raises instead of killing the stream: one malformed request in
+    a SolveStream batch must not tear down every other in-flight response and
+    trip the client's 30s blackout + whole-batch fallback."""
+
+    def abort(self, code, details):
+        raise _RequestAbort(code, details)
+
+
+def _error_response(detail: str) -> pb.SolveResponse:
+    """Per-request failure marker inside a stream: the client host-solves
+    this item and keeps the rest of the batch."""
+    log.warning("stream request failed, marking for client fallback: %s", detail)
+    response = pb.SolveResponse()
+    response.solver = "error"
+    response.fallback = True
+    return response
+
+
 def _host_rounds(vectors, counts, capacity, total, quirk):
     """Compiled-host FFD with pure-Python fallback — the no-accelerator path."""
     result = native.ffd_pack_rounds(
@@ -171,54 +197,77 @@ class _Handler:
             # the path that consumes the data.
             num_groups = (list(request.group_vectors.shape) or [0])[0]
             num_types = (list(request.capacity.shape) or [0])[0]
-            if mode != "cost" or num_groups == 0 or num_types == 0:
-                ready[order] = self.solve(request, context)
-            else:
-                start = time.perf_counter()
-                vectors = wire.decode_tensor(request.group_vectors)
-                counts = wire.decode_tensor(request.group_counts)
-                capacity = wire.decode_tensor(request.capacity)
-                total = wire.decode_tensor(request.total)
-                prices = wire.decode_tensor(request.prices)
-                pool_prices = wire.decode_tensor(request.pool_prices)
-                fused = solver_models.cost_solve_dispatch(
-                    vectors,
-                    counts,
-                    capacity,
-                    total,
-                    prices,
-                    int(request.lp_steps) or 300,
-                )
-                pending.append(
-                    (order, start, fused, vectors, counts, capacity, total,
-                     prices, pool_prices)
-                )
+            try:
+                if mode != "cost" or num_groups == 0 or num_types == 0:
+                    # Request-scoped context: an unknown mode aborts THIS
+                    # request only, not the whole stream.
+                    ready[order] = self.solve(request, _RequestScopedContext())
+                else:
+                    start = time.perf_counter()
+                    vectors = wire.decode_tensor(request.group_vectors)
+                    counts = wire.decode_tensor(request.group_counts)
+                    capacity = wire.decode_tensor(request.capacity)
+                    total = wire.decode_tensor(request.total)
+                    prices = wire.decode_tensor(request.prices)
+                    pool_prices = wire.decode_tensor(request.pool_prices)
+                    fused = solver_models.cost_solve_dispatch(
+                        vectors,
+                        counts,
+                        capacity,
+                        total,
+                        prices,
+                        int(request.lp_steps) or 300,
+                    )
+                    pending.append(
+                        (order, start, fused, vectors, counts, capacity, total,
+                         prices, pool_prices)
+                    )
+            except _RequestAbort as err:
+                ready[order] = _error_response(err.details)
+            except Exception as err:  # noqa: BLE001 — isolate malformed input
+                ready[order] = _error_response(repr(err))
             order += 1
 
         if pending:
-            with TRACER.span("solver.serve.stream", solves=len(pending)):
-                fetched_all = solver_models._to_host(
-                    [entry[2] for entry in pending]
-                )
-            for (
-                (slot, start, _, vectors, counts, capacity, total, prices,
-                 pool_prices),
-                fetched,
-            ) in zip(pending, fetched_all):
-                response = pb.SolveResponse()
-                dense = solver_models.cost_solve_finish(
-                    fetched, vectors, counts, capacity, total, prices, pool_prices
-                )
-                unschedulable = self._encode_cost(
-                    response, dense, vectors, counts, capacity, total
-                )
-                response.unschedulable.CopyFrom(
-                    wire.encode_tensor(np.asarray(unschedulable, dtype=np.int64))
-                )
-                response.solve_ms = (time.perf_counter() - start) * 1e3
-                with self._lock:
-                    self.solves += 1
-                ready[slot] = response
+            # The finish phase is isolated per request too: a poisoned batch
+            # fetch marks every pending slot for client fallback, and a
+            # per-item finish failure marks only that slot — completed
+            # responses always reach the client.
+            fetched_all = None
+            try:
+                with TRACER.span("solver.serve.stream", solves=len(pending)):
+                    fetched_all = solver_models._to_host(
+                        [entry[2] for entry in pending]
+                    )
+            except Exception as err:  # noqa: BLE001
+                for entry in pending:
+                    ready[entry[0]] = _error_response(f"batch fetch: {err!r}")
+            if fetched_all is not None:
+                for (
+                    (slot, start, _, vectors, counts, capacity, total, prices,
+                     pool_prices),
+                    fetched,
+                ) in zip(pending, fetched_all):
+                    try:
+                        response = pb.SolveResponse()
+                        dense = solver_models.cost_solve_finish(
+                            fetched, vectors, counts, capacity, total, prices,
+                            pool_prices,
+                        )
+                        unschedulable = self._encode_cost(
+                            response, dense, vectors, counts, capacity, total
+                        )
+                        response.unschedulable.CopyFrom(
+                            wire.encode_tensor(
+                                np.asarray(unschedulable, dtype=np.int64)
+                            )
+                        )
+                        response.solve_ms = (time.perf_counter() - start) * 1e3
+                        with self._lock:
+                            self.solves += 1
+                    except Exception as err:  # noqa: BLE001
+                        response = _error_response(repr(err))
+                    ready[slot] = response
 
         for slot in range(order):
             yield ready[slot]

@@ -24,6 +24,8 @@ LabelValues = Tuple[str, ...]
 
 
 class Gauge:
+    metric_type = "gauge"
+
     def __init__(self, name: str, help_text: str, labels: Sequence[str] = ()):
         self.name = name
         self.help = help_text
@@ -56,7 +58,10 @@ class Gauge:
                 del self._values[key]
 
     def render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.metric_type}",
+        ]
         with self._lock:
             for label_values, value in sorted(self._values.items()):
                 labels = ",".join(
@@ -64,6 +69,16 @@ class Gauge:
                 )
                 lines.append(f"{self.name}{{{labels}}} {value}")
         return lines
+
+
+class Counter(Gauge):
+    """Monotonic counter: inc() only, rendered with the counter type so
+    rate()/increase() work in PromQL."""
+
+    metric_type = "counter"
+
+    def set(self, value: float, *label_values: str) -> None:
+        raise TypeError(f"{self.name} is a Counter; use inc(), not set()")
 
 
 class Histogram:
@@ -137,6 +152,12 @@ class Registry:
 
     def gauge(self, name: str, help_text: str, labels: Sequence[str] = ()) -> Gauge:
         metric = Gauge(f"{NAMESPACE}_{name}", help_text, labels)
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_text: str, labels: Sequence[str] = ()) -> Counter:
+        metric = Counter(f"{NAMESPACE}_{name}", help_text, labels)
         with self._lock:
             self._metrics.append(metric)
         return metric

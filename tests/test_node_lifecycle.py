@@ -174,3 +174,39 @@ class TestMetrics:
         text = REGISTRY.render()
         assert "karpenter_nodes_by_zone" in text
         assert "# TYPE" in text
+
+    def test_ready_vs_total_split(self):
+        """Ref: metrics/nodes.go:33-96 — total node_count by provisioner plus
+        ready_node_* splits; a not-yet-ready node counts in total only."""
+        from karpenter_tpu.controllers.metrics import (
+            NODE_COUNT,
+            READY_NODE_COUNT,
+            READY_NODE_COUNT_BY_OS,
+        )
+
+        h = Harness()
+        node, _ = provision_node(h)
+        h.metrics.reconcile("default")
+        assert NODE_COUNT.get("default") == 1
+        assert READY_NODE_COUNT.get("default", node.zone) == 0  # not ready yet
+
+        node.ready = True
+        h.metrics.reconcile("default")
+        assert READY_NODE_COUNT.get("default", node.zone) == 1
+        os_name = node.labels.get(wellknown.OS_LABEL, "")
+        if os_name:
+            assert READY_NODE_COUNT_BY_OS.get(os_name, "default", node.zone) == 1
+
+    def test_stale_ready_series_cleared(self):
+        from karpenter_tpu.controllers.metrics import READY_NODE_COUNT
+
+        h = Harness()
+        node, _ = provision_node(h)
+        node.ready = True
+        h.metrics.reconcile("default")
+        assert READY_NODE_COUNT.get("default", node.zone) == 1
+        zone = node.zone
+        h.cluster.delete_node(node.name)
+        h.reconcile_terminations()
+        h.metrics.reconcile("default")
+        assert READY_NODE_COUNT.get("default", zone) == 0

@@ -263,3 +263,24 @@ class TestSolveStream:
 
     def test_empty_batch(self, remote):
         assert remote.solve_encoded_many([]) == []
+
+    def test_stream_isolates_malformed_request(self, server, constraints):
+        """One bad request in a stream must not abort the whole batch
+        (ADVICE r1: context.abort inside SolveStream killed every in-flight
+        response and tripped the client blackout)."""
+        client = RemoteSolver(f"127.0.0.1:{server.port}")
+        pods, types = make_pods(10), make_instance_types(3)
+        good, _ = client._build_request(
+            group_pods(pods), build_fleet(types, constraints, pods)
+        )
+        bad = pb.SolveRequest()
+        bad.CopyFrom(good)
+        bad.mode = "quantum"  # unknown mode: unary solve would abort
+        responses = list(
+            client._stream_rpc(iter([good, bad, good]), timeout=30.0)
+        )
+        client.close()
+        assert len(responses) == 3  # the stream survived
+        assert responses[0].solver != "error"
+        assert responses[2].solver != "error"
+        assert responses[1].solver == "error" and responses[1].fallback
