@@ -2,28 +2,73 @@
 
 Ref: cmd/webhook/main.go:44-96 — the reference runs knative admission
 webhooks for CRD defaulting, CRD validation, and logging-config validation.
-Here the same three behaviors are exposed as an HTTP service:
+Here the same behaviors are served over the Kubernetes AdmissionReview v1
+protocol (so a real apiserver can call them) with a plain-JSON fallback:
 
-  POST /default   — provisioner JSON in, defaulted provisioner JSON out
-  POST /validate  — provisioner JSON in, 200 or 422 with reasons
+  POST /default   — AdmissionReview in → AdmissionReview out with a base64
+                    JSONPatch applying CRD defaulting (a mutating webhook);
+                    plain provisioner JSON in → defaulted JSON out.
+  POST /validate  — AdmissionReview in → AdmissionReview out with
+                    allowed=true/false + status message (validating webhook);
+                    plain JSON in → 200 or 422 with reasons.
   POST /config    — {"level": "..."} live log-level reload
                     (ref: the config-logging ConfigMap validation webhook)
+
+TLS: pass --tls-cert-file/--tls-key-file (the chart mounts them from a
+secret) — the apiserver only calls HTTPS webhook endpoints
+(ref: cmd/webhook/main.go:44-62 knative's cert rotation; here certs are
+operator-supplied, e.g. cert-manager).
 
 Run: python -m karpenter_tpu.cmd.webhook --cluster-name my-cluster
 """
 
 from __future__ import annotations
 
+import base64
 import http.server
 import json
+import ssl
 import sys
 import threading
+from typing import List, Optional
 
 from karpenter_tpu.api import validation
 from karpenter_tpu.api.serialization import provisioner_from_dict, provisioner_to_dict
 from karpenter_tpu.cloudprovider import registry
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils import options as options_pkg
+
+
+def admission_response(uid: str, allowed: bool, message: str = "", patch=None):
+    """Build an AdmissionReview v1 response envelope."""
+    response = {"uid": uid, "allowed": allowed}
+    if message:
+        response["status"] = {"code": 200 if allowed else 422, "message": message}
+    if patch:
+        response["patchType"] = "JSONPatch"
+        response["patch"] = base64.b64encode(json.dumps(patch).encode()).decode()
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+
+def defaulting_patch(obj: dict) -> Optional[List[dict]]:
+    """JSONPatch ops applying CRD defaulting to the admitted object.
+
+    Defaulting only touches spec, so the patch is a single op carrying the
+    defaulted spec. RFC 6902 'add' REPLACES an existing member, so the op is
+    valid whether or not the original request carried a spec at all. The
+    diff is taken against the object's own normalized round-trip, so pure
+    serialization churn (quantity parsing etc.) produces no patch."""
+    provisioner = provisioner_from_dict(obj)
+    base = provisioner_to_dict(provisioner)  # snapshot before mutation
+    validation.default_provisioner(provisioner)
+    defaulted = provisioner_to_dict(provisioner)
+    if defaulted.get("spec") == base.get("spec"):
+        return None
+    return [{"op": "add", "path": "/spec", "value": defaulted["spec"]}]
 
 
 class WebhookHandler(http.server.BaseHTTPRequestHandler):
@@ -39,11 +84,41 @@ class WebhookHandler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _handle_admission_review(self, data) -> None:
+        """AdmissionReview v1 (the protocol a real apiserver speaks).
+        Admission outcomes ride inside a 200 envelope; only a malformed
+        envelope is an HTTP error."""
+        request = data.get("request") or {}
+        uid = request.get("uid", "")
+        obj = request.get("object")
+        if not isinstance(obj, dict):
+            self._respond(400, {"error": "AdmissionReview without request.object"})
+            return
+        if self.path == "/default":
+            try:
+                self._respond(
+                    200, admission_response(uid, True, patch=defaulting_patch(obj))
+                )
+            except Exception as error:  # noqa: BLE001
+                self._respond(200, admission_response(uid, False, str(error)))
+        elif self.path == "/validate":
+            try:
+                provisioner = provisioner_from_dict(obj)
+                validation.validate_provisioner(provisioner)
+                self._respond(200, admission_response(uid, True))
+            except Exception as error:  # noqa: BLE001 — invalid spec or parse
+                self._respond(200, admission_response(uid, False, str(error)))
+        else:
+            self._respond(404, {"error": "not found"})
+
     def do_POST(self):  # noqa: N802
         try:
             data = self._read_json()
         except (ValueError, json.JSONDecodeError) as error:
             self._respond(400, {"error": f"invalid JSON: {error}"})
+            return
+        if isinstance(data, dict) and data.get("kind") == "AdmissionReview":
+            self._handle_admission_review(data)
             return
         if self.path == "/default":
             try:
@@ -80,26 +155,89 @@ class WebhookHandler(http.server.BaseHTTPRequestHandler):
         pass
 
 
-def main(argv=None, port: int = 8443, block: bool = True, address: str = ""):
-    # --port belongs to this binary, not the shared options envelope
-    # (the chart passes it; options.parse would reject the unknown flag).
+class _TLSHTTPServer(http.server.ThreadingHTTPServer):
+    """HTTPS server that performs the TLS handshake in the PER-CONNECTION
+    thread, with a timeout. Wrapping the listening socket instead would run
+    handshakes inside the single accept loop — one idle TCP connection (port
+    scanner, TCP health check) would wedge every admission call behind it."""
+
+    HANDSHAKE_TIMEOUT_SECONDS = 10.0
+
+    def __init__(self, addr, handler, context: ssl.SSLContext):
+        super().__init__(addr, handler)
+        self._tls_context = context
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        # Defer the handshake: it runs in finish_request, on this
+        # connection's own thread.
+        return (
+            self._tls_context.wrap_socket(
+                sock, server_side=True, do_handshake_on_connect=False
+            ),
+            addr,
+        )
+
+    def finish_request(self, request, client_address):
+        request.settimeout(self.HANDSHAKE_TIMEOUT_SECONDS)
+        request.do_handshake()
+        request.settimeout(None)
+        super().finish_request(request, client_address)
+
+    def handle_error(self, request, client_address):
+        # Handshake failures (scanners, health checks, truncated conns) are
+        # expected noise — one quiet line, not a stderr traceback.
+        klog.named("webhook").debug(
+            "connection error from %s: %s", client_address, sys.exc_info()[1]
+        )
+
+
+def _extract_flag(argv: list, name: str) -> Optional[str]:
+    """Pop --name=value / --name value from argv; returns the value."""
+    for i, arg in enumerate(list(argv)):
+        if arg.startswith(f"--{name}="):
+            argv.pop(i)
+            return arg.split("=", 1)[1]
+        if arg == f"--{name}" and i + 1 < len(argv):
+            value = argv[i + 1]
+            del argv[i : i + 2]
+            return value
+    return None
+
+
+def main(
+    argv=None,
+    port: int = 8443,
+    block: bool = True,
+    address: str = "",
+    tls_cert_file: Optional[str] = None,
+    tls_key_file: Optional[str] = None,
+):
+    # These flags belong to this binary, not the shared options envelope
+    # (the chart passes them; options.parse would reject unknown flags).
     if argv:
         argv = list(argv)
-        for i, arg in enumerate(list(argv)):
-            if arg.startswith("--port="):
-                port = int(arg.split("=", 1)[1])
-                argv.pop(i)
-                break
-            if arg == "--port" and i + 1 < len(argv):
-                port = int(argv[i + 1])
-                del argv[i : i + 2]
-                break
+        port_arg = _extract_flag(argv, "port")
+        if port_arg is not None:
+            port = int(port_arg)
+        tls_cert_file = _extract_flag(argv, "tls-cert-file") or tls_cert_file
+        tls_key_file = _extract_flag(argv, "tls-key-file") or tls_key_file
     options = options_pkg.parse(argv)
     klog.setup(options.log_level)
     registry.new_cloud_provider(options.cloud_provider)  # installs hooks
-    server = http.server.ThreadingHTTPServer((address, port), WebhookHandler)
+    scheme = "http"
+    if tls_cert_file and tls_key_file:
+        # The apiserver only calls HTTPS webhooks; certs are mounted from a
+        # secret (chart webhook.tlsSecretName), rotated by re-deploying —
+        # the knative reference rotates in-process (main.go:44-62).
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        context.load_cert_chain(tls_cert_file, tls_key_file)
+        server = _TLSHTTPServer((address, port), WebhookHandler, context)
+        scheme = "https"
+    else:
+        server = http.server.ThreadingHTTPServer((address, port), WebhookHandler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    klog.named("webhook").info("webhook serving on :%d", port)
+    klog.named("webhook").info("webhook serving %s on :%d", scheme, port)
     if block:
         try:
             threading.Event().wait()

@@ -25,6 +25,7 @@ from karpenter_tpu.cloudprovider import InstanceType
 from karpenter_tpu.ops import ffd
 from karpenter_tpu.ops.encode import InstanceFleet, PodGroups, build_fleet, group_pods
 from karpenter_tpu.ops.pack_kernel import bucket_size, pack_kernel, pad_to
+from karpenter_tpu.ops import pallas_kernels
 from karpenter_tpu.ops.pallas_kernels import dominance_prices
 from karpenter_tpu.ops.score_kernel import (
     feasibility_mask,
@@ -531,6 +532,10 @@ def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int 
     fetch + cost_solve_finish. Splitting dispatch from finish lets a batch of
     schedules share ONE device->host round trip (the dominant latency on
     tunneled accelerators) instead of paying it per solve."""
+    # Probe the pallas dominance kernel EAGERLY before the fused kernel
+    # traces — under the trace the probe can't run and the XLA formulation
+    # would be baked in untested (ops/pallas_kernels.ensure_probed).
+    pallas_kernels.ensure_probed()
     return _cost_fused_kernel(
         *pad_kernel_args(vectors, counts, capacity, total, prices),
         lp_steps=lp_steps,

@@ -77,16 +77,34 @@ def _dominance_prices_pallas(
 _pallas_usable_cache = None
 
 
-def _pallas_usable() -> bool:
+def _in_active_trace() -> bool:
+    """True while jax is tracing — everything staged here becomes part of
+    the outer jaxpr, so an eager probe is impossible in this state."""
+    try:
+        from jax._src import core as _core
+
+        return not _core.trace_state_clean()
+    except Exception:  # noqa: BLE001 — private API moved; fall back to probe
+        return isinstance(jnp.zeros(()), jax.core.Tracer)
+
+
+def ensure_probed() -> bool:
     """Probe the Pallas/Mosaic lowering ONCE, eagerly, at the north-star
     padded shape ([512, 8]). dominance_prices is traced inside the fused
     solve kernel, so a lowering failure there would surface as a compile
     error propagating out of CostSolver.solve with no way to catch it at
-    trace time — this probe runs outside any trace and permanently routes
-    dominance pricing through the XLA formulation if the kernel doesn't
-    compile on this backend/generation."""
+    trace time — this probe runs outside any trace (dispatch sites call it
+    before invoking their jitted kernels) and permanently routes dominance
+    pricing through the XLA formulation if the kernel doesn't compile on
+    this backend/generation.
+
+    Called while tracing, it does NOT probe (the ops would stage into the
+    outer jaxpr and "succeed" untested) — it reports unusable for that
+    compile and leaves the cache unset so a later eager call still probes."""
     global _pallas_usable_cache
     if _pallas_usable_cache is None:
+        if _in_active_trace():
+            return False
         try:
             probe = jax.block_until_ready(
                 _dominance_prices_pallas(
@@ -110,7 +128,9 @@ def _pallas_usable() -> bool:
 def dominance_prices(capacity: jnp.ndarray, prices: jnp.ndarray) -> jnp.ndarray:
     """Effective (dominance-minimum) prices: Pallas on TPU when the lowering
     probe passes, XLA formulation elsewhere. The branch is trace-time Python,
-    so this is safe to call under an outer jit."""
-    if jax.default_backend() == "tpu" and _pallas_usable():
+    so this is safe to call under an outer jit — dispatch sites should call
+    ensure_probed() eagerly first, or the first compile conservatively bakes
+    the XLA path."""
+    if jax.default_backend() == "tpu" and ensure_probed():
         return _dominance_prices_pallas(capacity, prices)
     return _dominance_prices_ref(capacity, prices)

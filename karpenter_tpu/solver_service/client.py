@@ -158,7 +158,17 @@ class RemoteSolver(Solver):
         _RPC_HISTOGRAM.observe(self.clock() - start, "ok")
         # A per-request "error" marker means the sidecar isolated a failure
         # to that item (server solve_stream); host-solve it alone instead of
-        # failing or blacking out the whole batch.
+        # failing or blacking out the whole batch. But a batch where EVERY
+        # item errored (e.g. the server's batched fetch is poisoned) is a
+        # sidecar failure in a well-formed envelope — arm the blackout like
+        # an RPC failure so the next passes don't repeat the doomed trip.
+        if responses and all(r.solver == "error" for r in responses):
+            self._blackout_until = self.clock() + self.blackout_s
+            log.warning(
+                "sidecar %s errored every stream item; host fallback for %.0fs",
+                self.endpoint,
+                self.blackout_s,
+            )
         return [
             self.fallback.solve_encoded(groups, fleet)
             if response.solver == "error"

@@ -126,6 +126,24 @@ class TestChart:
         )
         assert values["solver"]["port"] == 9090
 
+    def test_webhook_registration_matches_served_endpoints(self):
+        """The chart's (Mutating|Validating)WebhookConfiguration must point
+        at paths the binary serves with AdmissionReview v1, and the TLS
+        wiring must exist for the apiserver to call them."""
+        templates = ROOT / "deploy/chart/karpenter-tpu/templates"
+        config = (templates / "webhook-config.yaml").read_text()
+        assert "MutatingWebhookConfiguration" in config
+        assert "ValidatingWebhookConfiguration" in config
+        assert "path: /default" in config and "path: /validate" in config
+        assert "admissionReviewVersions: [v1]" in config
+        deployment = (templates / "webhook-deployment.yaml").read_text()
+        assert "--tls-cert-file=/certs/tls.crt" in deployment
+        assert "--tls-key-file=/certs/tls.key" in deployment
+        values = yaml.safe_load(
+            (ROOT / "deploy/chart/karpenter-tpu/values.yaml").read_text()
+        )
+        assert "tlsSecretName" in values["webhook"]
+
     def test_templates_reference_real_entrypoints(self):
         templates = ROOT / "deploy/chart/karpenter-tpu/templates"
         text = "".join(p.read_text() for p in templates.glob("*.yaml"))

@@ -186,6 +186,171 @@ class TestManager:
             server.shutdown()
 
 
+def _admission_review(obj, uid="test-uid-1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "operation": "CREATE",
+            "resource": {
+                "group": "karpenter.tpu",
+                "version": "v1alpha1",
+                "resource": "provisioners",
+            },
+            "object": obj,
+        },
+    }
+
+
+def _post_json(url, payload, context=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    return json.load(urllib.request.urlopen(req, context=context))
+
+
+def _self_signed_cert(tmp_path):
+    """Serving cert for 127.0.0.1, the shape cert-manager would mount."""
+    import datetime
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=1))
+        .not_valid_after(now + datetime.timedelta(hours=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / "tls.crt"
+    key_path = tmp_path / "tls.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path)
+
+
+class TestAdmissionReview:
+    """Ref: cmd/webhook/main.go:44-84 — the apiserver speaks AdmissionReview
+    v1 to HTTPS webhook endpoints; defaulting answers with a JSONPatch."""
+
+    @pytest.fixture()
+    def webhook(self):
+        from karpenter_tpu.cmd.webhook import main as webhook_main
+
+        server = webhook_main(["--cluster-name", "test"], port=18445, block=False)
+        yield "http://127.0.0.1:18445"
+        server.shutdown()
+
+    def test_validate_allows_good_provisioner(self, webhook):
+        obj = provisioner_to_dict(Provisioner(name="default", spec=ProvisionerSpec()))
+        review = _post_json(f"{webhook}/validate", _admission_review(obj))
+        assert review["kind"] == "AdmissionReview"
+        assert review["response"]["uid"] == "test-uid-1"
+        assert review["response"]["allowed"] is True
+
+    def test_validate_rejects_bad_provisioner_in_envelope(self, webhook):
+        """Rejection rides inside a 200 AdmissionReview, not an HTTP error."""
+        obj = provisioner_to_dict(Provisioner(name="x" * 80, spec=ProvisionerSpec()))
+        review = _post_json(f"{webhook}/validate", _admission_review(obj))
+        assert review["response"]["allowed"] is False
+        assert review["response"]["status"]["message"]
+
+    def test_default_emits_base64_jsonpatch(self, webhook):
+        import base64
+
+        obj = provisioner_to_dict(Provisioner(name="default", spec=ProvisionerSpec()))
+        review = _post_json(f"{webhook}/default", _admission_review(obj))
+        response = review["response"]
+        assert response["allowed"] is True
+        assert response["patchType"] == "JSONPatch"
+        ops = json.loads(base64.b64decode(response["patch"]))
+        assert ops and ops[0]["path"] == "/spec"
+        keys = {r["key"] for r in ops[0]["value"]["requirements"]}
+        assert "karpenter.sh/capacity-type" in keys  # provider hook defaulting
+
+    def test_default_noop_when_already_defaulted(self, webhook):
+        import base64
+
+        obj = provisioner_to_dict(Provisioner(name="default", spec=ProvisionerSpec()))
+        first = _post_json(f"{webhook}/default", _admission_review(obj))
+        patched = dict(obj)
+        patched["spec"] = json.loads(
+            base64.b64decode(first["response"]["patch"])
+        )[0]["value"]
+        second = _post_json(f"{webhook}/default", _admission_review(patched))
+        assert second["response"]["allowed"] is True
+        assert "patch" not in second["response"]  # fixed point: no patch
+
+    def test_malformed_envelope_is_http_error(self, webhook):
+        bad = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview"}
+        req = urllib.request.Request(
+            f"{webhook}/validate", data=json.dumps(bad).encode(), method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 400
+
+    def test_tls_serving(self, tmp_path):
+        """With mounted certs the webhook terminates TLS itself — the shape
+        the chart's webhook.tlsSecretName wiring produces."""
+        import ssl
+
+        from karpenter_tpu.cmd.webhook import main as webhook_main
+
+        cert_file, key_file = _self_signed_cert(tmp_path)
+        server = webhook_main(
+            [
+                "--cluster-name",
+                "test",
+                "--tls-cert-file",
+                cert_file,
+                "--tls-key-file",
+                key_file,
+            ],
+            port=18446,
+            block=False,
+        )
+        try:
+            context = ssl.create_default_context(cafile=cert_file)
+            obj = provisioner_to_dict(
+                Provisioner(name="default", spec=ProvisionerSpec())
+            )
+            review = _post_json(
+                "https://127.0.0.1:18446/validate",
+                _admission_review(obj),
+                context=context,
+            )
+            assert review["response"]["allowed"] is True
+        finally:
+            server.shutdown()
+
+
 class TestWebhook:
     def test_validate_and_default(self):
         from karpenter_tpu.cmd.webhook import main as webhook_main
