@@ -21,12 +21,40 @@ from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils import options as options_pkg
 
 
+def build_cluster(options) -> Cluster:
+    """Select the cluster-store backend (ref: cmd/controller/main.go:61-99 —
+    the reference always reconciles a live apiserver; --cluster-store wires
+    the same here, with the in-memory store for standalone/dev runs)."""
+    if options.cluster_store == "memory":
+        return Cluster()
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+    from karpenter_tpu.kubeapi.client import HttpTransport
+
+    if options.cluster_store == "incluster":
+        transport = HttpTransport.in_cluster()
+    else:
+        transport = HttpTransport(
+            options.cluster_store,
+            token=os.environ.get("KUBE_TOKEN", ""),
+            ca_file=os.environ.get("KUBE_CA_FILE") or None,
+            insecure=os.environ.get("KUBE_INSECURE", "") == "true",
+        )
+    client = KubeClient(
+        transport, qps=options.kube_client_qps, burst=options.kube_client_burst
+    )
+    return ApiServerCluster(client).start()
+
+
 def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     options = options_pkg.parse(argv)
     log = klog.setup(options.log_level)
-    log.info("starting karpenter-tpu controller for cluster %s", options.cluster_name)
+    log.info(
+        "starting karpenter-tpu controller for cluster %s (store=%s)",
+        options.cluster_name,
+        options.cluster_store,
+    )
 
-    cluster = cluster if cluster is not None else Cluster()
+    cluster = cluster if cluster is not None else build_cluster(options)
     cloud = registry.new_cloud_provider(options.cloud_provider)
     # Manager is constructed (but not started) before the campaign so the
     # lease-loss callback has something concrete to stop — no window where a

@@ -203,6 +203,13 @@ class Cluster:
         with self._lock:
             return sorted(self._provisioners.values(), key=lambda p: p.name)
 
+    def update_provisioner_status(self, provisioner: Provisioner) -> None:
+        """Persist a status mutation (resources/conditions/lastScaleTime).
+        In-memory the object IS the store so this only notifies; the
+        apiserver backend PATCHes the CRD status subresource — controllers
+        must route status writes through here to survive either backend."""
+        self._notify("provisioner", provisioner)
+
     def delete_provisioner(self, name: str) -> None:
         with self._lock:
             provisioner = self._provisioners.pop(name, None)

@@ -22,6 +22,9 @@ class CounterController:
             == provisioner_name
             and n.deletion_timestamp is None
         )
-        provisioner.status.resources = add_resources(
-            *[node.capacity for node in nodes]
-        )
+        resources = add_resources(*[node.capacity for node in nodes])
+        # Write-through only on change: a status write emits a watch event
+        # which re-enqueues this reconcile — unconditional writes would spin.
+        if resources != provisioner.status.resources:
+            provisioner.status.resources = resources
+            self.cluster.update_provisioner_status(provisioner)

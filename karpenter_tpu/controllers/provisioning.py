@@ -248,6 +248,7 @@ class ProvisionerWorker:
             live = self.cluster.try_get_provisioner(self.provisioner.name)
             if live is not None:
                 live.status.last_scale_time = self.cluster.clock.now()
+                self.cluster.update_provisioner_status(live)
         return stats
 
     def _daemon_schedules_here(self, template: PodSpec) -> bool:
@@ -374,7 +375,9 @@ class ProvisioningController:
         # A provisioner with a running worker is ready to scale — the Active
         # status condition (ref: provisioner_status.go:40-50 knative
         # conditions; the v0.5.x reference defines but barely drives it).
-        provisioner.status.conditions["Active"] = True
+        if provisioner.status.conditions.get("Active") is not True:
+            provisioner.status.conditions["Active"] = True
+            self.cluster.update_provisioner_status(provisioner)
 
     def worker(self, name: str) -> Optional[ProvisionerWorker]:
         return self.workers.get(name)

@@ -114,10 +114,13 @@ class SelectionController:
             # retry without relaxing further (relaxation is only for genuine
             # incompatibility; ref: preferences.go:50-63).
             return self.REQUEUE_SECONDS
-        # No provisioner matched: relax one step and retry if possible.
-        if self.preferences.advance(pod):
-            return self.REQUEUE_SECONDS
-        return None
+        # No provisioner matched: relax one step if possible, then retry.
+        # The retry happens EVEN when relaxation is exhausted — the reference
+        # returns the match error so controller-runtime keeps requeueing
+        # (selectProvisioner:80-102), which is what heals a pod whose
+        # provisioner appears (or widens) later.
+        self.preferences.advance(pod)
+        return self.REQUEUE_SECONDS
 
     def _validate(self, pod: PodSpec) -> None:
         if pod.pod_affinity_terms:

@@ -35,6 +35,14 @@ class Options:
     cloud_provider: str = "fake"
     leader_election: bool = True
     log_level: str = "info"
+    # Cluster-store backend (ref: manager.go:33-66 — the reference always
+    # talks to a live apiserver; we also keep the in-memory store for tests
+    # and standalone runs):
+    #   memory     — in-process store (the envtest analogue)
+    #   incluster  — apiserver via the mounted serviceaccount
+    #   <URL>      — apiserver at an explicit base URL (kubeconfig-less dev;
+    #                token from KUBE_TOKEN, CA from KUBE_CA_FILE)
+    cluster_store: str = "memory"
 
     def validate(self) -> None:
         errors: List[str] = []
@@ -46,6 +54,12 @@ class Options:
             errors.append(f"unknown solver {self.solver!r}")
         if self.solver == "remote" and not self.solver_endpoint:
             errors.append("solver=remote requires --solver-endpoint")
+        if self.cluster_store != "memory" and self.cluster_store != "incluster" and not self.cluster_store.startswith(
+            ("http://", "https://")
+        ):
+            errors.append(
+                f"cluster-store must be memory | incluster | URL, got {self.cluster_store!r}"
+            )
         if errors:
             raise OptionsError("; ".join(errors))
 
@@ -74,6 +88,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         default=_env("LEADER_ELECTION", "true").lower() == "false",
     )
     parser.add_argument("--log-level", default=_env("LOG_LEVEL", "info"))
+    parser.add_argument(
+        "--cluster-store", default=_env("CLUSTER_STORE", "memory")
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -87,6 +104,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         cloud_provider=args.cloud_provider,
         leader_election=not args.no_leader_election,
         log_level=args.log_level,
+        cluster_store=args.cluster_store,
     )
     options.validate()
     return options

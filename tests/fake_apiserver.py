@@ -1,0 +1,400 @@
+"""An in-process kube-apiserver double — the envtest analogue for the
+apiserver-backed Cluster.
+
+Ref: pkg/test/environment.go boots a real apiserver via envtest; here a
+minimal REST implementation of the verbs ApiServerCluster issues: CRUD with
+resourceVersion optimistic concurrency, the binding / eviction / status
+subresources (eviction enforces PDBs with 429, exactly what the reference's
+eviction queue retries on), finalizer-aware deletion, Lease CAS, and
+line-delimited watch streams.
+
+Two transports drive it: DirectTransport (no sockets — fast enough to run
+whole controller suites against) and, for wire-level coverage, serve_http()
+exposes the same handler over real HTTP for the HttpTransport tests.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import queue
+import re
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from karpenter_tpu.kubeapi.client import Transport
+
+# (kind, namespace?, name?, subresource?) patterns, matched in order.
+_ROUTES = [
+    (r"^/api/v1/namespaces/(?P<ns>[^/]+)/pods(?:/(?P<name>[^/]+))?"
+     r"(?:/(?P<sub>binding|eviction))?$", "pods"),
+    (r"^/api/v1/pods$", "pods"),
+    (r"^/api/v1/nodes(?:/(?P<name>[^/]+))?$", "nodes"),
+    (r"^/apis/apps/v1/namespaces/(?P<ns>[^/]+)/daemonsets(?:/(?P<name>[^/]+))?$",
+     "daemonsets"),
+    (r"^/apis/apps/v1/daemonsets$", "daemonsets"),
+    (r"^/apis/karpenter\.tpu/v1alpha1/provisioners(?:/(?P<name>[^/]+))?"
+     r"(?:/(?P<sub>status))?$", "provisioners"),
+    (r"^/apis/coordination\.k8s\.io/v1/namespaces/(?P<ns>[^/]+)/leases"
+     r"(?:/(?P<name>[^/]+))?$", "leases"),
+    (r"^/apis/policy/v1/namespaces/(?P<ns>[^/]+)/poddisruptionbudgets"
+     r"(?:/(?P<name>[^/]+))?$", "pdbs"),
+]
+
+NAMESPACED = {"pods", "daemonsets", "leases", "pdbs"}
+
+
+def _status_error(code: int, message: str) -> Tuple[int, dict]:
+    return code, {"kind": "Status", "code": code, "message": message}
+
+
+def _merge_patch(target: dict, patch: dict) -> dict:
+    """RFC 7386 JSON merge patch (what Content-Type merge-patch+json means)."""
+    out = dict(target)
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        elif isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _merge_patch(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+class FakeApiServer:
+    def __init__(self, clock=None):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[Tuple[str, str], dict]] = {}
+        self._rv = 0
+        self._watchers: Dict[str, List[queue.Queue]] = {}
+        self._clock = clock  # stamps deletionTimestamps; None = wall clock
+
+    def _now_rfc3339(self) -> str:
+        import datetime
+
+        if self._clock is not None:
+            return (
+                datetime.datetime.fromtimestamp(
+                    self._clock.now(), tz=datetime.timezone.utc
+                )
+                .isoformat()
+                .replace("+00:00", "Z")
+            )
+        return (
+            datetime.datetime.now(datetime.timezone.utc)
+            .isoformat()
+            .replace("+00:00", "Z")
+        )
+
+    # --- store helpers ------------------------------------------------------
+
+    def _bump(self, obj: dict) -> dict:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        return obj
+
+    def _emit(self, kind: str, event_type: str, obj: dict) -> None:
+        event = {"type": event_type, "object": copy.deepcopy(obj)}
+        for q in list(self._watchers.get(kind, [])):
+            q.put(event)
+
+    def _collection(self, kind: str) -> Dict[Tuple[str, str], dict]:
+        return self._objects.setdefault(kind, {})
+
+    def seed(self, kind: str, obj: dict) -> None:
+        """Test helper: place an object directly (e.g. a kubelet-owned pod)."""
+        with self._lock:
+            metadata = obj.setdefault("metadata", {})
+            key = (metadata.get("namespace", ""), metadata.get("name", ""))
+            self._bump(obj)
+            self._collection(kind)[key] = obj
+            self._emit(kind, "ADDED", obj)
+
+    def get_object(self, kind: str, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            obj = self._collection(kind).get((namespace, name))
+            return copy.deepcopy(obj) if obj else None
+
+    # --- request handling ---------------------------------------------------
+
+    def handle(
+        self, method: str, path: str, query: str = "", body: Optional[dict] = None
+    ) -> Tuple[int, dict]:
+        route = None
+        for pattern, kind in _ROUTES:
+            match = re.match(pattern, path)
+            if match:
+                route = (kind, match.groupdict())
+                break
+        if route is None:
+            return _status_error(404, f"unknown path {path}")
+        kind, groups = route
+        namespace = groups.get("ns") or ("" if kind not in NAMESPACED else "default")
+        name = groups.get("name") or ""
+        sub = groups.get("sub") or ""
+
+        with self._lock:
+            if sub == "binding" and method == "POST":
+                return self._bind(namespace, name, body or {})
+            if sub == "eviction" and method == "POST":
+                return self._evict(namespace, name)
+            if sub == "status" and method == "PATCH":
+                return self._patch(kind, namespace, name, body or {})
+            if method == "GET":
+                if name:
+                    obj = self._collection(kind).get((namespace, name))
+                    if obj is None:
+                        return _status_error(404, f"{kind}/{name} not found")
+                    return 200, copy.deepcopy(obj)
+                items = [
+                    copy.deepcopy(obj) for obj in self._collection(kind).values()
+                ]
+                return 200, {"kind": "List", "items": items}
+            if method == "POST":
+                return self._create(kind, namespace, body or {})
+            if method == "PUT":
+                return self._update(kind, namespace, name, body or {})
+            if method == "PATCH":
+                return self._patch(kind, namespace, name, body or {})
+            if method == "DELETE":
+                return self._delete(kind, namespace, name)
+        return _status_error(405, f"{method} not supported on {path}")
+
+    def _create(self, kind, namespace, body) -> Tuple[int, dict]:
+        metadata = body.setdefault("metadata", {})
+        if kind in NAMESPACED:
+            metadata.setdefault("namespace", namespace or "default")
+        key = (metadata.get("namespace", ""), metadata.get("name", ""))
+        if key in self._collection(kind):
+            return _status_error(409, f"{kind}/{key[1]} already exists")
+        if not metadata.get("uid"):
+            metadata["uid"] = f"uid-{kind}-{self._rv + 1}"
+        self._bump(body)
+        self._collection(kind)[key] = body
+        self._emit(kind, "ADDED", body)
+        return 201, copy.deepcopy(body)
+
+    def _update(self, kind, namespace, name, body) -> Tuple[int, dict]:
+        key = (namespace if kind in NAMESPACED else "", name)
+        existing = self._collection(kind).get(key)
+        if existing is None:
+            return _status_error(404, f"{kind}/{name} not found")
+        sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+        current_rv = existing.get("metadata", {}).get("resourceVersion")
+        if sent_rv is not None and sent_rv != current_rv:
+            return _status_error(
+                409, f"resourceVersion conflict: sent {sent_rv}, have {current_rv}"
+            )
+        body.setdefault("metadata", {})["uid"] = existing["metadata"].get("uid")
+        body["metadata"]["namespace"] = existing["metadata"].get("namespace", "")
+        self._bump(body)
+        self._collection(kind)[key] = body
+        self._emit(kind, "MODIFIED", body)
+        return 200, copy.deepcopy(body)
+
+    def _patch(self, kind, namespace, name, patch) -> Tuple[int, dict]:
+        key = (namespace if kind in NAMESPACED else "", name)
+        existing = self._collection(kind).get(key)
+        if existing is None:
+            return _status_error(404, f"{kind}/{name} not found")
+        merged = _merge_patch(existing, patch)
+        # Arrays replace wholesale under merge patch — finalizer removal
+        # arrives as the full remaining list.
+        merged["metadata"]["resourceVersion"] = existing["metadata"].get(
+            "resourceVersion"
+        )
+        self._bump(merged)
+        self._collection(kind)[key] = merged
+        self._emit(kind, "MODIFIED", merged)
+        # Finalizer protocol: a deleting object whose finalizers emptied goes
+        # away now.
+        metadata = merged.get("metadata", {})
+        if metadata.get("deletionTimestamp") and not metadata.get("finalizers"):
+            del self._collection(kind)[key]
+            self._emit(kind, "DELETED", merged)
+        return 200, copy.deepcopy(merged)
+
+    def _delete(self, kind, namespace, name) -> Tuple[int, dict]:
+        key = (namespace if kind in NAMESPACED else "", name)
+        existing = self._collection(kind).get(key)
+        if existing is None:
+            return _status_error(404, f"{kind}/{name} not found")
+        metadata = existing.setdefault("metadata", {})
+        if metadata.get("finalizers"):
+            # Finalizers block actual removal: stamp deletionTimestamp only
+            # (the protocol driving the termination controller, SURVEY §3.4).
+            if not metadata.get("deletionTimestamp"):
+                metadata["deletionTimestamp"] = self._now_rfc3339()
+                self._bump(existing)
+                self._emit(kind, "MODIFIED", existing)
+            return 200, copy.deepcopy(existing)
+        del self._collection(kind)[key]
+        self._emit(kind, "DELETED", existing)
+        return 200, copy.deepcopy(existing)
+
+    def _bind(self, namespace, name, body) -> Tuple[int, dict]:
+        pod = self._collection("pods").get((namespace, name))
+        if pod is None:
+            return _status_error(404, f"pod {namespace}/{name} not found")
+        target = (body.get("target") or {}).get("name", "")
+        if pod.get("spec", {}).get("nodeName"):
+            return _status_error(409, f"pod {name} already bound")
+        pod.setdefault("spec", {})["nodeName"] = target
+        # Binding resolves the scheduling condition.
+        conditions = pod.setdefault("status", {}).setdefault("conditions", [])
+        pod["status"]["conditions"] = [
+            c for c in conditions if c.get("type") != "PodScheduled"
+        ]
+        self._bump(pod)
+        self._emit("pods", "MODIFIED", pod)
+        return 201, {"kind": "Status", "code": 201}
+
+    def _evict(self, namespace, name) -> Tuple[int, dict]:
+        pod = self._collection("pods").get((namespace, name))
+        if pod is None:
+            return _status_error(404, f"pod {namespace}/{name} not found")
+        if not self._pdb_allows(pod):
+            return _status_error(
+                429, "Cannot evict pod as it would violate the pod's disruption budget."
+            )
+        metadata = pod.setdefault("metadata", {})
+        if not metadata.get("deletionTimestamp"):
+            metadata["deletionTimestamp"] = self._now_rfc3339()
+        self._bump(pod)
+        self._emit("pods", "MODIFIED", pod)
+        return 201, {"kind": "Status", "code": 201}
+
+    def _pdb_allows(self, pod: dict) -> bool:
+        labels = pod.get("metadata", {}).get("labels") or {}
+        for pdb in self._collection("pdbs").values():
+            spec = pdb.get("spec", {})
+            selector = (spec.get("selector") or {}).get("matchLabels") or {}
+            if not all(labels.get(k) == v for k, v in selector.items()):
+                continue
+            healthy = [
+                p
+                for p in self._collection("pods").values()
+                if not p.get("metadata", {}).get("deletionTimestamp")
+                and all(
+                    (p.get("metadata", {}).get("labels") or {}).get(k) == v
+                    for k, v in selector.items()
+                )
+            ]
+            if len(healthy) - 1 < int(spec.get("minAvailable", 0)):
+                return False
+        return True
+
+    # --- watches ------------------------------------------------------------
+
+    def subscribe(self, kind: str) -> queue.Queue:
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(q)
+        return q
+
+    def unsubscribe(self, kind: str, q: queue.Queue) -> None:
+        with self._lock:
+            watchers = self._watchers.get(kind, [])
+            if q in watchers:
+                watchers.remove(q)
+
+    def kind_for_path(self, path: str) -> Optional[str]:
+        for pattern, kind in _ROUTES:
+            if re.match(pattern, path):
+                return kind
+        return None
+
+
+class DirectTransport(Transport):
+    """Socket-free transport: requests call FakeApiServer.handle directly;
+    watch streams block on a subscriber queue."""
+
+    def __init__(self, server: FakeApiServer):
+        self.server = server
+        self.closed = threading.Event()
+
+    def request(self, method, path, query="", body=None):
+        return self.server.handle(method, path, query, body)
+
+    def close(self):
+        self.closed.set()
+
+    def stream(self, path, query="") -> Iterator[dict]:
+        kind = self.server.kind_for_path(path)
+        if kind is None:
+            raise ValueError(f"unknown watch path {path}")
+        q = self.server.subscribe(kind)
+        try:
+            while not self.closed.is_set():
+                try:
+                    yield q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+        finally:
+            self.server.unsubscribe(kind, q)
+
+
+def serve_http(server: FakeApiServer, port: int = 0):
+    """Expose the fake over real HTTP (for HttpTransport wire tests)."""
+    import http.server as http_server
+
+    class Handler(http_server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _dispatch(self, method):
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length)) if length else None
+            path, _, query = self.path.partition("?")
+            if method == "GET" and "watch=true" in query:
+                return self._watch(path)
+            status, payload = server.handle(method, path, query, body)
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _watch(self, path):
+            kind = server.kind_for_path(path)
+            q = server.subscribe(kind)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    try:
+                        event = q.get(timeout=0.5)
+                    except queue.Empty:
+                        continue
+                    line = json.dumps(event).encode() + b"\n"
+                    self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                server.unsubscribe(kind, q)
+
+        def do_GET(self):  # noqa: N802
+            self._dispatch("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._dispatch("POST")
+
+        def do_PUT(self):  # noqa: N802
+            self._dispatch("PUT")
+
+        def do_PATCH(self):  # noqa: N802
+            self._dispatch("PATCH")
+
+        def do_DELETE(self):  # noqa: N802
+            self._dispatch("DELETE")
+
+        def log_message(self, *args):
+            pass
+
+    httpd = http_server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd

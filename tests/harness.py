@@ -23,17 +23,51 @@ from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
 from karpenter_tpu.models.solver import Solver
 from karpenter_tpu.utils.clock import FakeClock
 
+# Apiserver-backed harnesses run watch pump threads; tests don't tear down
+# Harness objects, so the parity suite's autouse fixture drains this.
+_live_harnesses: List["Harness"] = []
+
+
+def close_live_harnesses() -> None:
+    while _live_harnesses:
+        harness = _live_harnesses.pop()
+        try:
+            harness.cluster.close()
+        except Exception:  # noqa: BLE001
+            pass
+
 
 class Harness:
+    # "memory" = the in-memory Cluster store; "apiserver" = ApiServerCluster
+    # against an in-process FakeApiServer (tests/fake_apiserver.py) over the
+    # socket-free DirectTransport. test_backend_parity.py re-runs the
+    # controller suites with this flipped — controllers must not be able to
+    # tell the backends apart.
+    DEFAULT_BACKEND = "memory"
+
     def __init__(
         self,
         instance_types=None,
         solver: Optional[Solver] = None,
         clock: Optional[FakeClock] = None,
         cloud=None,
+        backend: Optional[str] = None,
     ):
         self.clock = clock or FakeClock()
-        self.cluster = Cluster(clock=self.clock)
+        self.backend = backend or self.DEFAULT_BACKEND
+        if self.backend == "apiserver":
+            from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+            from tests.fake_apiserver import DirectTransport, FakeApiServer
+
+            self.apiserver = FakeApiServer(clock=self.clock)
+            self.cluster = ApiServerCluster(
+                KubeClient(DirectTransport(self.apiserver), qps=1e6, burst=10**6),
+                clock=self.clock,
+            ).start()
+            _live_harnesses.append(self)
+        else:
+            self.apiserver = None
+            self.cluster = Cluster(clock=self.clock)
         self.cloud = cloud or FakeCloudProvider(
             instance_types=instance_types, clock=self.clock
         )

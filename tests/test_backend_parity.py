@@ -1,0 +1,99 @@
+"""Backend parity: the existing controller suites re-run against the
+apiserver-backed Cluster (ApiServerCluster + FakeApiServer over the direct
+transport). Controllers must not be able to tell the backends apart — this
+is the round-2 'done' criterion for the apiserver backend (VERDICT r1 #1:
+"the existing controller suites pass against both backends (run them
+parameterized)").
+
+Each reused class below inherits every test method from its memory-backed
+original; the autouse fixture flips Harness.DEFAULT_BACKEND for the
+duration and closes the watch pumps the apiserver harnesses start.
+"""
+
+import pytest
+
+from tests import harness as harness_mod
+from tests import test_node_lifecycle as lifecycle
+from tests import test_provisioning as provisioning
+from tests import test_scheduling as scheduling
+from tests import test_selection as selection
+from tests import test_termination as termination
+
+
+@pytest.fixture(autouse=True)
+def _apiserver_backend(monkeypatch):
+    monkeypatch.setattr(harness_mod.Harness, "DEFAULT_BACKEND", "apiserver")
+    yield
+    harness_mod.close_live_harnesses()
+
+
+class TestProvisioningOnApiserver(provisioning.TestProvisioning):
+    pass
+
+
+class TestProvisionerLifecycleOnApiserver(provisioning.TestProvisionerLifecycle):
+    pass
+
+
+class TestCapacityFeedbackOnApiserver(provisioning.TestCapacityFeedback):
+    pass
+
+
+class TestParallelBindOnApiserver(provisioning.TestParallelBind):
+    pass
+
+
+class TestSelectionOnApiserver(selection.TestSelection):
+    pass
+
+
+class TestPreferencesSideCacheOnApiserver(selection.TestPreferencesSideCache):
+    pass
+
+
+class TestTerminationOnApiserver(termination.TestTermination):
+    pass
+
+
+class TestReadinessOnApiserver(lifecycle.TestReadiness):
+    pass
+
+
+class TestLivenessOnApiserver(lifecycle.TestLiveness):
+    pass
+
+
+class TestEmptinessOnApiserver(lifecycle.TestEmptiness):
+    pass
+
+
+class TestExpirationOnApiserver(lifecycle.TestExpiration):
+    pass
+
+
+class TestFinalizerOnApiserver(lifecycle.TestFinalizer):
+    pass
+
+
+class TestCounterOnApiserver(lifecycle.TestCounter):
+    pass
+
+
+class TestMetricsOnApiserver(lifecycle.TestMetrics):
+    pass
+
+
+class TestZonalTopologyOnApiserver(scheduling.TestZonalTopology):
+    pass
+
+
+class TestHostnameTopologyOnApiserver(scheduling.TestHostnameTopology):
+    pass
+
+
+class TestPreferentialFallbackOnApiserver(scheduling.TestPreferentialFallback):
+    pass
+
+
+class TestWellKnownLabelsOnApiserver(scheduling.TestWellKnownLabels):
+    pass
