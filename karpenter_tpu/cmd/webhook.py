@@ -27,6 +27,7 @@ from __future__ import annotations
 import base64
 import http.server
 import json
+import socket
 import ssl
 import sys
 import threading
@@ -186,10 +187,18 @@ class _TLSHTTPServer(http.server.ThreadingHTTPServer):
 
     def handle_error(self, request, client_address):
         # Handshake failures (scanners, health checks, truncated conns) are
-        # expected noise — one quiet line, not a stderr traceback.
-        klog.named("webhook").debug(
-            "connection error from %s: %s", client_address, sys.exc_info()[1]
-        )
+        # expected noise — one quiet line. Anything else escaping request
+        # handling is a real admission-path bug and must be loud.
+        error = sys.exc_info()[1]
+        if isinstance(error, (ssl.SSLError, socket.timeout, TimeoutError,
+                              ConnectionResetError, BrokenPipeError)):
+            klog.named("webhook").debug(
+                "connection error from %s: %s", client_address, error
+            )
+        else:
+            klog.named("webhook").exception(
+                "unhandled error serving %s", client_address
+            )
 
 
 def _extract_flag(argv: list, name: str) -> Optional[str]:

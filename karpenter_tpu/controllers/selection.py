@@ -88,11 +88,20 @@ class SelectionController:
     """Ref: selection/controller.go:55-102."""
 
     REQUEUE_SECONDS = 1.0  # re-verify after handing off (ref: :77)
+    # Exponential backoff for pods no provisioner matches, mirroring
+    # workqueue.DefaultControllerRateLimiter (5ms→1000s) that the reference
+    # gets for free when it returns the match error. Our reconcile loop tick
+    # floors the base at 1s; the cap matches the reference's 1000s.
+    BACKOFF_BASE_SECONDS = 1.0
+    BACKOFF_MAX_SECONDS = 1000.0
 
     def __init__(self, cluster: Cluster, provisioning: ProvisioningController):
         self.cluster = cluster
         self.provisioning = provisioning
         self.preferences = Preferences(cluster.clock)
+        # UID → consecutive no-match failures; entries expire on their own so
+        # deleted pods don't leak state.
+        self._failures = TtlCache(2 * self.BACKOFF_MAX_SECONDS, cluster.clock)
 
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
         pod = self.cluster.try_get_pod(namespace, name)
@@ -113,14 +122,28 @@ class SelectionController:
             # Enqueued (re-verify in 1s, ref: :77) — or the batch was full:
             # retry without relaxing further (relaxation is only for genuine
             # incompatibility; ref: preferences.go:50-63).
+            self._failures.delete(pod.uid)
             return self.REQUEUE_SECONDS
         # No provisioner matched: relax one step if possible, then retry.
         # The retry happens EVEN when relaxation is exhausted — the reference
         # returns the match error so controller-runtime keeps requeueing
         # (selectProvisioner:80-102), which is what heals a pod whose
-        # provisioner appears (or widens) later.
-        self.preferences.advance(pod)
-        return self.REQUEUE_SECONDS
+        # provisioner appears (or widens) later — but with exponential
+        # backoff, so a permanently-unschedulable pod isn't polled at 1 Hz
+        # forever.
+        if self.preferences.advance(pod):
+            # A fresh relaxation level is a new scheduling attempt worth
+            # retrying promptly.
+            self._failures.delete(pod.uid)
+            return self.REQUEUE_SECONDS
+        failures = self._failures.get(pod.uid) or 0
+        self._failures.set(pod.uid, failures + 1)
+        # min() on the exponent too: the counter keeps growing for a pod
+        # that never schedules, and 2.0**1024 overflows.
+        return min(
+            self.BACKOFF_BASE_SECONDS * (2.0 ** min(failures, 16)),
+            self.BACKOFF_MAX_SECONDS,
+        )
 
     def _validate(self, pod: PodSpec) -> None:
         if pod.pod_affinity_terms:

@@ -112,7 +112,13 @@ class HttpTransport(Transport):
 
     def stream(self, path, query=""):
         url = self.base_url + path + (f"?{query}" if query else "")
-        response = self._request("GET", url, None, timeout=None)
+        try:
+            response = self._request("GET", url, None, timeout=None)
+        except urllib.error.HTTPError as error:
+            # A watch opened with an expired resourceVersion answers 410 Gone
+            # at the HTTP layer; surface it so the reflector can re-LIST.
+            detail = error.read().decode(errors="replace")
+            raise ApiError(error.code, detail) from None
         try:
             for line in response:
                 line = line.strip()
@@ -175,6 +181,14 @@ class KubeClient:
     def list(self, path: str) -> list:
         return self._call("GET", path).get("items", [])
 
+    def list_with_rv(self, path: str) -> Tuple[list, str]:
+        """LIST returning (items, collection resourceVersion). The collection
+        rv is what the first watch must resume from — resuming from '' (or
+        from an item rv) loses events in the list-to-watch window."""
+        payload = self._call("GET", path)
+        rv = (payload.get("metadata") or {}).get("resourceVersion", "")
+        return payload.get("items", []), rv
+
     def create(self, path: str, obj: dict) -> dict:
         return self._call("POST", path, body=obj)
 
@@ -203,22 +217,64 @@ class KubeClient:
         on_event: Callable[[str, dict], None],
         stop: threading.Event,
         resource_version: str = "",
+        relist: Optional[Callable[[], str]] = None,
     ) -> None:
-        """Consume watch events ({type, object} lines) until stop is set,
-        reconnecting from the last seen resourceVersion (the informer
-        re-list/re-watch loop)."""
+        """Consume watch events ({type, object} lines) until stop is set —
+        the reflector loop of a client-go informer:
+
+        - reconnect from the last seen resourceVersion on stream drops;
+        - on 410 Gone (an in-stream ERROR Status event or an HTTP 410 on
+          reconnect — what the apiserver sends once etcd compaction has
+          discarded the resumption point), call `relist` to rebuild state
+          from a fresh LIST and resume from the collection rv it returns.
+          Without a relist callback the watch restarts from 'now' ('' rv),
+          accepting the gap rather than hot-looping on 410 forever.
+        """
         rv = resource_version
         while not stop.is_set():
-            query = "watch=true" + (f"&resourceVersion={rv}" if rv else "")
+            # Bookmarks keep rv fresh on idle kinds, shrinking the 410 window.
+            query = "watch=true&allowWatchBookmarks=true" + (
+                f"&resourceVersion={rv}" if rv else ""
+            )
+            expired = False
             try:
                 for event in self.transport.stream(path, query):
                     if stop.is_set():
                         return
+                    event_type = event.get("type", "")
                     obj = event.get("object") or {}
+                    if event_type == "ERROR":
+                        # k8s signals watch errors in-band as a Status object.
+                        try:
+                            code = int(obj.get("code", 0) or 0)
+                        except (TypeError, ValueError):
+                            code = 0
+                        expired = code == 410
+                        break
+                    if event_type == "BOOKMARK":
+                        new_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                        if new_rv:
+                            rv = new_rv
+                        continue
                     new_rv = (obj.get("metadata") or {}).get("resourceVersion")
                     if new_rv:
                         rv = new_rv
-                    on_event(event.get("type", ""), obj)
+                    on_event(event_type, obj)
+            except ApiError as error:
+                expired = error.status == 410
             except Exception:  # noqa: BLE001 — watch drop: back off, re-watch
-                if stop.wait(timeout=0.2):
-                    return
+                pass
+            if expired:
+                if relist is not None:
+                    try:
+                        rv = relist()
+                    except Exception:  # noqa: BLE001 — apiserver flake: retry
+                        if stop.wait(timeout=0.5):
+                            return
+                else:
+                    rv = ""
+            elif stop.wait(timeout=0.2):
+                # Non-410 stream end (incl. a non-410 ERROR Status): back off
+                # before reconnecting from the last rv, so a persistently
+                # erroring server isn't hot-looped.
+                return

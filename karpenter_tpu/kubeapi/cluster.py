@@ -61,21 +61,26 @@ class ApiServerCluster(Cluster):
         super().__init__(clock)
         self.api = client
         self._rv: Dict[Tuple[str, object], int] = {}
+        self._rv_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
+        self.resync_count = 0  # 410-triggered re-LISTs (observability + tests)
 
     # --- lifecycle ----------------------------------------------------------
 
     def start(self) -> "ApiServerCluster":
         """Initial LIST of every watched resource, then start watch pumps.
-        Controllers constructed after start() see a warm cache."""
+        Controllers constructed after start() see a warm cache. Each watch
+        resumes from its LIST's collection resourceVersion so no event in
+        the list-to-watch window is lost (the client-go reflector contract,
+        ref: pkg/controllers/manager.go:33-40 via controller-runtime)."""
         for kind, path in self.WATCHES:
-            items = self.api.list(path)
+            items, rv = self.api.list_with_rv(path)
             for obj in items:
                 self._apply_remote(kind, obj)
             thread = threading.Thread(
                 target=self._pump,
-                args=(kind, path),
+                args=(kind, path, rv),
                 name=f"watch-{kind}",
                 daemon=True,
             )
@@ -90,12 +95,67 @@ class ApiServerCluster(Cluster):
             thread.join(timeout=2.0)
         self._threads.clear()
 
-    def _pump(self, kind: str, path: str) -> None:
+    def _pump(self, kind: str, path: str, resource_version: str) -> None:
         self.api.watch(
             path,
             lambda event_type, obj: self._on_watch(kind, event_type, obj),
             self._stop,
+            resource_version=resource_version,
+            relist=lambda: self._relist(kind, path),
         )
+
+    def _relist(self, kind: str, path: str) -> str:
+        """410-recovery: replace the cache snapshot for `kind` from a fresh
+        LIST — apply every live object, delete cached objects that vanished
+        during the watch gap — and return the new collection rv to resume
+        the watch from."""
+        items, rv = self.api.list_with_rv(path)
+        live = {self._key(kind, obj) for obj in items}
+        try:
+            list_rv = int(rv)
+        except (TypeError, ValueError):
+            list_rv = 0
+        with self._lock:
+            if kind == "pod":
+                cached = list(self._pods.keys())
+            elif kind == "node":
+                cached = list(self._nodes.keys())
+            elif kind == "provisioner":
+                cached = list(self._provisioners.keys())
+            else:
+                cached = list(self._daemonsets.keys())
+        for key in cached:
+            if key in live:
+                continue
+            # The guard and the removal must be one atomic step: holding
+            # _rv_lock across both means a write-through re-create either
+            # fully precedes the guard (its newer rv skips the sweep) or
+            # blocks at _record_rv until the sweep is done and then
+            # re-inserts the object — no interleaving can delete a live
+            # object and then have its watch replay suppressed by _newer.
+            with self._rv_lock:
+                # Write-through can land an object between our LIST and this
+                # sweep; its rv is newer than the LIST's collection rv, so it
+                # is not a ghost — leave it for the resumed watch to confirm.
+                if list_rv and self._rv.get((kind, key), 0) > list_rv:
+                    continue
+                self._rv.pop((kind, key), None)
+                if kind == "pod":
+                    namespace, name = key
+                    ghost = {"metadata": {"namespace": namespace, "name": name}}
+                else:
+                    ghost = {"metadata": {"name": key}}
+                self._remove_local(kind, ghost)
+        for obj in items:
+            # Gate on rv like _on_watch does: a write-through landing between
+            # our LIST and this apply has a newer rv, and overwriting it with
+            # the LIST's older copy would stick (the watch echo of the newer
+            # write is deduplicated by _newer).
+            if self._newer(kind, obj):
+                self._apply_remote(kind, obj)
+        self.resync_count += 1
+        log.warning("watch for %s expired (410); re-listed %d objects", kind, len(items))
+        return rv
 
     # --- cache application ---------------------------------------------------
 
@@ -117,15 +177,23 @@ class ApiServerCluster(Cluster):
         except (TypeError, ValueError):
             return True
         key = (kind, self._key(kind, obj))
-        if rv <= self._rv.get(key, 0):
-            return False
-        self._rv[key] = rv
+        # Locked check-then-set: watch pumps and write-through callers (incl.
+        # the bind fan-out) race on this dict; unlocked, an older event could
+        # be applied after a newer one.
+        with self._rv_lock:
+            if rv <= self._rv.get(key, 0):
+                return False
+            self._rv[key] = rv
         return True
 
     def _on_watch(self, kind: str, event_type: str, obj: dict) -> None:
         try:
             if event_type == "DELETED":
                 self._remove_local(kind, obj)
+                # Drop the rv entry with the object, or pod churn leaks one
+                # dict entry per pod ever observed.
+                with self._rv_lock:
+                    self._rv.pop((kind, self._key(kind, obj)), None)
             elif self._newer(kind, obj):
                 self._apply_remote(kind, obj)
         except Exception:  # noqa: BLE001 — one bad event must not kill the pump

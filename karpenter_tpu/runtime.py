@@ -64,6 +64,7 @@ class ReconcileLoop:
         self.log = klog.named(name)
         self._heap: list = []  # (due_time, seq, key)
         self._queued: set = set()
+        self._due: dict = {}  # key -> earliest pending due time
         self._cv = threading.Condition()
         self._seq = 0
         self._stop = False
@@ -73,12 +74,19 @@ class ReconcileLoop:
         import time as _time
 
         with self._cv:
-            if key in self._queued and delay == 0.0:
-                return  # collapse duplicate immediate enqueues
+            due = _time.monotonic() + delay
+            if key in self._queued and due >= self._due.get(key, float("inf")):
+                # An entry already due at-or-before this one covers it. An
+                # EARLIER enqueue (e.g. a watch event while the key sits in a
+                # long backoff) must pull the work forward, like workqueue.Add
+                # during rate-limited backoff — the old entry is lazily
+                # dropped when it pops.
+                return
             self._queued.add(key)
+            self._due[key] = due
             self._seq += 1
-            heapq.heappush(self._heap, (_time.monotonic() + delay, self._seq, key))
-            WORKQUEUE_DEPTH.set(len(self._heap), self.name)
+            heapq.heappush(self._heap, (due, self._seq, key))
+            WORKQUEUE_DEPTH.set(len(self._queued), self.name)
             self._cv.notify()
 
     def start(self) -> None:
@@ -108,9 +116,12 @@ class ReconcileLoop:
                     self._cv.wait(timeout=timeout)
                 if self._stop:
                     return
-                _, _, key = heapq.heappop(self._heap)
+                popped_due, _, key = heapq.heappop(self._heap)
+                WORKQUEUE_DEPTH.set(len(self._queued), self.name)
+                if key not in self._queued or self._due.get(key) != popped_due:
+                    continue  # superseded by an earlier enqueue: stale entry
                 self._queued.discard(key)
-                WORKQUEUE_DEPTH.set(len(self._heap), self.name)
+                self._due.pop(key, None)
             outcome = "success"
             with RECONCILE_DURATION.measure(self.name):
                 try:

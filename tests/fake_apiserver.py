@@ -62,12 +62,18 @@ def _merge_patch(target: dict, patch: dict) -> dict:
 
 
 class FakeApiServer:
-    def __init__(self, clock=None):
+    def __init__(self, clock=None, history_limit: int = 4096):
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[Tuple[str, str], dict]] = {}
         self._rv = 0
         self._watchers: Dict[str, List[queue.Queue]] = {}
         self._clock = clock  # stamps deletionTimestamps; None = wall clock
+        # Watch history window — like etcd, only events newer than the
+        # compaction point can be replayed; a watch resuming from an older
+        # resourceVersion gets 410 Gone (the informer re-list trigger).
+        self._history_limit = history_limit
+        self._history: Dict[str, List[Tuple[int, dict]]] = {}
+        self._trimmed: Dict[str, int] = {}  # rv at/below which history is gone
 
     def _now_rfc3339(self) -> str:
         import datetime
@@ -95,8 +101,38 @@ class FakeApiServer:
 
     def _emit(self, kind: str, event_type: str, obj: dict) -> None:
         event = {"type": event_type, "object": copy.deepcopy(obj)}
+        try:
+            event_rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            event_rv = self._rv
+        history = self._history.setdefault(kind, [])
+        history.append((event_rv, event))
+        while len(history) > self._history_limit:
+            dropped_rv, _ = history.pop(0)
+            self._trimmed[kind] = max(self._trimmed.get(kind, 0), dropped_rv)
         for q in list(self._watchers.get(kind, [])):
             q.put(event)
+
+    def drop_watch_connections(self) -> None:
+        """Test hook simulating a network partition: every open watch stream
+        errors out (clients see a dropped connection and reconnect from their
+        last seen rv), and no further events are delivered to them."""
+        with self._lock:
+            for watchers in self._watchers.values():
+                for q in watchers:
+                    q.put({"__disconnect__": True})
+            self._watchers.clear()
+
+    def expire_history(self, kind: Optional[str] = None) -> None:
+        """Test hook simulating etcd compaction: discard all replayable
+        history so any watch resuming from a pre-expiry rv gets 410."""
+        with self._lock:
+            kinds = [kind] if kind else list(self._history) or [
+                "pods", "nodes", "provisioners", "daemonsets"
+            ]
+            for k in kinds:
+                self._history[k] = []
+                self._trimmed[k] = self._rv
 
     def _collection(self, kind: str) -> Dict[Tuple[str, str], dict]:
         return self._objects.setdefault(kind, {})
@@ -149,7 +185,13 @@ class FakeApiServer:
                 items = [
                     copy.deepcopy(obj) for obj in self._collection(kind).values()
                 ]
-                return 200, {"kind": "List", "items": items}
+                # Collection resourceVersion: where a subsequent watch must
+                # resume from to see everything after this LIST.
+                return 200, {
+                    "kind": "List",
+                    "metadata": {"resourceVersion": str(self._rv)},
+                    "items": items,
+                }
             if method == "POST":
                 return self._create(kind, namespace, body or {})
             if method == "PUT":
@@ -286,9 +328,31 @@ class FakeApiServer:
 
     # --- watches ------------------------------------------------------------
 
-    def subscribe(self, kind: str) -> queue.Queue:
+    def subscribe(self, kind: str, resource_version: str = "") -> queue.Queue:
+        """Register a watcher. With a resourceVersion: replay retained events
+        newer than it, or deliver a single 410 ERROR Status event when the
+        resumption point has been compacted away ('' = live from now)."""
         q: queue.Queue = queue.Queue()
         with self._lock:
+            if resource_version:
+                try:
+                    rv = int(resource_version)
+                except (TypeError, ValueError):
+                    rv = 0
+                if rv < self._trimmed.get(kind, 0):
+                    q.put({
+                        "type": "ERROR",
+                        "object": {
+                            "kind": "Status",
+                            "code": 410,
+                            "reason": "Expired",
+                            "message": f"too old resource version: {rv}",
+                        },
+                    })
+                    return q  # not registered: stream ends after the ERROR
+                for event_rv, event in self._history.get(kind, []):
+                    if event_rv > rv:
+                        q.put(copy.deepcopy(event))
             self._watchers.setdefault(kind, []).append(q)
         return q
 
@@ -303,6 +367,12 @@ class FakeApiServer:
             if re.match(pattern, path):
                 return kind
         return None
+
+
+def _query_rv(query: str) -> str:
+    import urllib.parse
+
+    return (urllib.parse.parse_qs(query).get("resourceVersion") or [""])[0]
 
 
 class DirectTransport(Transport):
@@ -323,13 +393,18 @@ class DirectTransport(Transport):
         kind = self.server.kind_for_path(path)
         if kind is None:
             raise ValueError(f"unknown watch path {path}")
-        q = self.server.subscribe(kind)
+        q = self.server.subscribe(kind, _query_rv(query))
         try:
             while not self.closed.is_set():
                 try:
-                    yield q.get(timeout=0.1)
+                    event = q.get(timeout=0.1)
                 except queue.Empty:
                     continue
+                if event.get("__disconnect__"):
+                    raise ConnectionError("watch connection dropped")
+                yield event
+                if event.get("type") == "ERROR":
+                    return  # stream ends after an error Status, like the real server
         finally:
             self.server.unsubscribe(kind, q)
 
@@ -346,7 +421,7 @@ def serve_http(server: FakeApiServer, port: int = 0):
             body = json.loads(self.rfile.read(length)) if length else None
             path, _, query = self.path.partition("?")
             if method == "GET" and "watch=true" in query:
-                return self._watch(path)
+                return self._watch(path, query)
             status, payload = server.handle(method, path, query, body)
             data = json.dumps(payload).encode()
             self.send_response(status)
@@ -355,9 +430,9 @@ def serve_http(server: FakeApiServer, port: int = 0):
             self.end_headers()
             self.wfile.write(data)
 
-        def _watch(self, path):
+        def _watch(self, path, query):
             kind = server.kind_for_path(path)
-            q = server.subscribe(kind)
+            q = server.subscribe(kind, _query_rv(query))
             try:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -368,9 +443,15 @@ def serve_http(server: FakeApiServer, port: int = 0):
                         event = q.get(timeout=0.5)
                     except queue.Empty:
                         continue
+                    if event.get("__disconnect__"):
+                        return  # drop the connection mid-stream
                     line = json.dumps(event).encode() + b"\n"
                     self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                     self.wfile.flush()
+                    if event.get("type") == "ERROR":
+                        self.wfile.write(b"0\r\n\r\n")  # final chunk: end the stream
+                        self.wfile.flush()
+                        return
             except (BrokenPipeError, ConnectionResetError, OSError):
                 pass
             finally:

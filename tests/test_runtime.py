@@ -133,6 +133,53 @@ def wait_until(predicate, timeout=10.0, interval=0.05):
     return False
 
 
+class TestReconcileLoop:
+    def test_immediate_enqueue_pulls_key_out_of_backoff(self):
+        """A watch event for a key sitting in a long delayed requeue must
+        reconcile promptly, like workqueue.Add during rate-limited backoff —
+        not wait out the backoff entry."""
+        import time
+
+        from karpenter_tpu.runtime import ReconcileLoop
+
+        seen = []
+        loop = ReconcileLoop("test", lambda key: seen.append(key) and None)
+        loop.start()
+        try:
+            loop.enqueue("pod-a", delay=600.0)  # deep backoff
+            loop.enqueue("pod-a", delay=0.0)  # watch event: pull forward
+            deadline = time.monotonic() + 5.0
+            while not seen and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert seen == ["pod-a"], "immediate enqueue was swallowed by backoff"
+            # The stale far-future entry must not reconcile the key again.
+            time.sleep(0.2)
+            assert seen == ["pod-a"]
+        finally:
+            loop.stop()
+
+    def test_duplicate_immediate_enqueues_still_collapse(self):
+        import time
+
+        from karpenter_tpu.runtime import ReconcileLoop
+
+        gate = __import__("threading").Event()
+        seen = []
+        loop = ReconcileLoop("test", lambda key: (gate.wait(5), seen.append(key), None)[-1])
+        loop.start()
+        try:
+            # First pops immediately and blocks in reconcile; the rest land
+            # while the key is NOT queued… so enqueue while still queued:
+            loop.enqueue("k", delay=0.05)
+            loop.enqueue("k", delay=0.0)
+            loop.enqueue("k", delay=0.0)
+            gate.set()
+            time.sleep(0.3)
+            assert len(seen) == 1
+        finally:
+            loop.stop()
+
+
 class TestManager:
     def test_end_to_end_provisioning(self, manager):
         cluster = manager.cluster

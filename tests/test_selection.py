@@ -153,6 +153,53 @@ class TestPreferencesSideCache:
         assert len(restored.preferred_terms) == 1  # forgotten after 5 min
 
 
+class TestNoMatchBackoff:
+    """A pod no provisioner matches must not be polled at 1 Hz forever: the
+    requeue delay grows exponentially (the reference gets 5ms→1000s from
+    workqueue.DefaultControllerRateLimiter when selectProvisioner errors)."""
+
+    def test_backoff_grows_then_caps(self):
+        h = Harness()  # no provisioners at all
+        pod = fixtures.pod()
+        h.cluster.apply_pod(pod)
+        delays = [h.selection.reconcile(pod.namespace, pod.name) for _ in range(12)]
+        assert delays[:4] == [1.0, 2.0, 4.0, 8.0]
+        assert delays[-1] == h.selection.BACKOFF_MAX_SECONDS
+        assert all(b >= a for a, b in zip(delays, delays[1:]))
+
+    def test_backoff_resets_when_provisioner_appears(self):
+        h = Harness()
+        pod = fixtures.pod()
+        h.cluster.apply_pod(pod)
+        for _ in range(5):
+            h.selection.reconcile(pod.namespace, pod.name)
+        h.apply_provisioner(provisioner("default"))
+        assert h.selection.reconcile(pod.namespace, pod.name) == 1.0  # healed
+        # And if that provisioner vanishes, backoff starts over from 1s.
+        h.cluster.delete_provisioner("default")
+        h.provisioning.workers.clear()
+        assert h.selection.reconcile(pod.namespace, pod.name) == 1.0
+
+    def test_relaxation_steps_requeue_promptly(self):
+        """Each relaxation level is a fresh attempt — backoff only kicks in
+        once relaxation is exhausted."""
+        h = Harness()  # no provisioner: relaxation alone can't help
+        pod = fixtures.pod(
+            preferred_terms=[
+                PreferredTerm(
+                    weight=1,
+                    requirements=[Requirement.in_(wellknown.ZONE_LABEL, ["mars-1a"])],
+                )
+            ]
+        )
+        h.cluster.apply_pod(pod)
+        first = h.selection.reconcile(pod.namespace, pod.name)
+        assert first == 1.0  # dropped the preferred term: retry promptly
+        second = h.selection.reconcile(pod.namespace, pod.name)
+        third = h.selection.reconcile(pod.namespace, pod.name)
+        assert (second, third) == (1.0, 2.0)  # exhausted → exponential
+
+
 class TestMatchFields:
     def test_match_fields_rejected(self):
         """Ref: selection/controller.go validate:108-159 rejects matchFields."""
