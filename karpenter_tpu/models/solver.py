@@ -29,7 +29,7 @@ from karpenter_tpu.ops import pallas_kernels
 from karpenter_tpu.ops.pallas_kernels import dominance_prices
 from karpenter_tpu.ops.score_kernel import (
     feasibility_mask,
-    lp_relax_solve,
+    lp_relax_body,
     round_assignment,
 )
 from karpenter_tpu.utils.tracing import TRACER, device_profile
@@ -117,9 +117,8 @@ class NativeSolver(Solver):
         return _decode_rounds(round_list, unschedulable_counts, groups, fleet)
 
 
-@functools.partial(jax.jit, static_argnames=("lp_steps",))
-def _cost_fused_kernel(
-    vectors, counts, capacity, total, valid, prices, *, lp_steps: int
+def _cost_fused_body(
+    vectors, counts, capacity, total, valid, prices, *, lp_steps: int, constrain=None
 ):
     """All three CostSolver candidates as ONE XLA computation: greedy-FFD
     rounds, cost-greedy rounds, and the LP relaxation. Fusing them means a
@@ -131,7 +130,12 @@ def _cost_fused_kernel(
     feasible pools, _cheapest_feasible_pools), so the cost objective sees the
     dominating-type minimum price — the price the realization will actually
     pay, not t's own list price. The [T, T] dominance reduction is tensor
-    math, so it rides along in the same compiled computation."""
+    math, so it rides along in the same compiled computation.
+
+    `constrain` shards the LP's [G, T] tensors over a device mesh on the
+    multi-chip path (see _sharded_fused_kernel); the sequential pack rounds
+    stay replicated — they are lax.while_loop state machines with no
+    parallelizable [G, T] bulk."""
     valid_prices = jnp.where(valid, prices, jnp.inf)
     # [T, T'] dominance + masked min as a VMEM-resident pallas kernel on TPU
     # (ops/pallas_kernels.py), XLA formulation elsewhere.
@@ -146,18 +150,79 @@ def _cost_fused_kernel(
     )
     feasible_any = feasibility_mask(vectors, capacity, valid).any(axis=1)
     solvable = jnp.where(feasible_any, counts, 0)
-    lp = lp_relax_solve(
-        vectors, solvable, capacity, valid, effective_prices, steps=lp_steps
+    lp = lp_relax_body(
+        vectors, solvable, capacity, valid, effective_prices,
+        steps=lp_steps, constrain=constrain,
     )
     return rounds_ffd, rounds_cost, lp.assignment, feasible_any, lp.objective
 
 
-def pad_kernel_args(vectors, counts, capacity, total, prices):
+_cost_fused_kernel = functools.partial(
+    jax.jit(_cost_fused_body, static_argnames=("lp_steps", "constrain")),
+    constrain=None,
+)
+
+
+_SHARDED_KERNEL_CACHE: Dict[Tuple, Tuple] = {}
+
+
+def _sharded_fused_kernel(mesh=None):
+    """The fused kernel compiled for a multi-device mesh: identical math to
+    _cost_fused_kernel, but every [G, T] LP tensor carries a
+    with_sharding_constraint over the ("groups", "types") mesh so GSPMD
+    partitions the softmax/einsum/Adam bulk across chips over ICI, while the
+    sequential pack rounds replicate. Returns (kernel, (g_mult, t_mult)):
+    callers must pad G/T to those multiples on top of the bucket ladder.
+
+    One executable, one dispatch, one fetch — the multi-chip path keeps the
+    single-round-trip property of the single-chip path (SURVEY.md §2.7:
+    "sharded across TPU devices over ICI when the problem exceeds one chip")."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from karpenter_tpu.parallel.mesh import GROUPS_AXIS, TYPES_AXIS, make_mesh
+
+    mesh = mesh or make_mesh()
+    key = tuple(d.id for d in mesh.devices.flat)
+    cached = _SHARDED_KERNEL_CACHE.get(key)
+    if cached is not None:
+        return cached
+    gt_sharding = NamedSharding(mesh, P(GROUPS_AXIS, TYPES_AXIS))
+
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(x, gt_sharding)
+
+    kernel = functools.partial(
+        jax.jit(_cost_fused_body, static_argnames=("lp_steps", "constrain")),
+        constrain=constrain,
+    )
+    groups_size, types_size = mesh.devices.shape
+    cached = (kernel, (int(groups_size), int(types_size)))
+    _SHARDED_KERNEL_CACHE[key] = cached
+    return cached
+
+
+def solve_mesh():
+    """The production mesh policy: shard the fused solve when the runtime has
+    more than one accelerator (KARPENTER_SHARDED_SOLVE=0 forces the
+    single-device path). Returns a Mesh or None."""
+    import os
+
+    if os.environ.get("KARPENTER_SHARDED_SOLVE", "").lower() in ("0", "false", "off"):
+        return None
+    if jax.device_count() < 2:
+        return None
+    from karpenter_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()
+
+
+def pad_kernel_args(vectors, counts, capacity, total, prices, g_mult=1, t_mult=1):
     """Bucket-pad the six dense kernel inputs — THE padding/valid-mask
     convention, shared by every dispatch site (in-process ffd/cost paths and
-    the sidecar) so they can't drift apart."""
-    g_pad = bucket_size(int(vectors.shape[0]))
-    t_pad = bucket_size(int(capacity.shape[0]))
+    the sidecar) so they can't drift apart. g_mult/t_mult round the buckets up
+    to mesh-divisible sizes on the sharded path (power-of-two buckets already
+    divide power-of-two mesh factors; the lcm covers odd device counts)."""
+    g_pad = _pad_multiple(bucket_size(int(vectors.shape[0])), g_mult)
+    t_pad = _pad_multiple(bucket_size(int(capacity.shape[0])), t_mult)
     return (
         pad_to(vectors, g_pad),
         pad_to(counts.astype(np.int32), g_pad),
@@ -166,6 +231,12 @@ def pad_kernel_args(vectors, counts, capacity, total, prices):
         pad_to(np.ones(int(capacity.shape[0]), bool), t_pad),
         pad_to(prices, t_pad),
     )
+
+
+def _pad_multiple(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return n
+    return ((n + multiple - 1) // multiple) * multiple
 
 
 def run_kernel_dense(vectors, counts, capacity, total, prices, mode: str, quirk: bool):
@@ -531,13 +602,26 @@ def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int 
     """Dispatch the fused kernel asynchronously; pair with a (batchable)
     fetch + cost_solve_finish. Splitting dispatch from finish lets a batch of
     schedules share ONE device->host round trip (the dominant latency on
-    tunneled accelerators) instead of paying it per solve."""
+    tunneled accelerators) instead of paying it per solve.
+
+    On a multi-chip runtime (solve_mesh() non-None) the SAME entry dispatches
+    the mesh-sharded fused kernel — production callers (CostSolver, the gRPC
+    sidecar) get the sharded path with no code of their own."""
     # Probe the pallas dominance kernel EAGERLY before the fused kernel
     # traces — under the trace the probe can't run and the XLA formulation
     # would be baked in untested (ops/pallas_kernels.ensure_probed).
     pallas_kernels.ensure_probed()
-    return _cost_fused_kernel(
-        *pad_kernel_args(vectors, counts, capacity, total, prices),
+    mesh = solve_mesh()
+    if mesh is None:
+        return _cost_fused_kernel(
+            *pad_kernel_args(vectors, counts, capacity, total, prices),
+            lp_steps=lp_steps,
+        )
+    kernel, (g_mult, t_mult) = _sharded_fused_kernel(mesh)
+    return kernel(
+        *pad_kernel_args(
+            vectors, counts, capacity, total, prices, g_mult=g_mult, t_mult=t_mult
+        ),
         lp_steps=lp_steps,
     )
 

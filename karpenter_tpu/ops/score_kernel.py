@@ -64,22 +64,31 @@ def lp_objective(
     return jnp.sum(prices * nodes)
 
 
-@functools.partial(jax.jit, static_argnames=("steps",))
-def lp_relax_solve(
+def lp_relax_body(
     vectors,  # [G, R] f32
     counts,  # [G] i32/f32
     capacity,  # [T, R] f32
     valid_types,  # [T] bool
     prices,  # [T] f32
     steps: int = 300,
+    constrain=None,
 ) -> LPResult:
+    """Traceable LP-relaxation body. `constrain` is an optional hook applied
+    to every [G, T] tensor (feasibility mask, logits init, the scan carry,
+    and the final assignment): the multi-chip path passes
+    `lax.with_sharding_constraint(·, P("groups", "types"))` so GSPMD shards
+    the big tensors over the mesh while this math stays topology-agnostic
+    (parallel/sharded_solver.py; SURVEY.md §2.7)."""
+    gt = (lambda x: x) if constrain is None else constrain
     counts_f = counts.astype(jnp.float32)
-    feasible = feasibility_mask(vectors, capacity, valid_types)
+    feasible = gt(feasibility_mask(vectors, capacity, valid_types))
     # Initialize biased toward price-efficient types: -price per unit of the
     # type's bottleneck capacity.
     density = prices / jnp.maximum(jnp.max(capacity, axis=1), 1.0)
-    logits0 = jnp.broadcast_to(-jnp.log(density + 1e-9), feasible.shape).astype(
-        jnp.float32
+    logits0 = gt(
+        jnp.broadcast_to(-jnp.log(density + 1e-9), feasible.shape).astype(
+            jnp.float32
+        )
     )
 
     optimizer = optax.adam(0.25)
@@ -90,13 +99,13 @@ def lp_relax_solve(
         logits, opt_state = carry
         grads = grad_fn(logits, vectors, counts_f, capacity, prices, feasible)
         updates, opt_state = optimizer.update(grads, opt_state, logits)
-        return (optax.apply_updates(logits, updates), opt_state), ()
+        return (gt(optax.apply_updates(logits, updates)), opt_state), ()
 
     (logits, _), _ = jax.lax.scan(step, (logits0, opt_state), None, length=steps)
 
     masked = jnp.where(feasible, logits, -1e9)
     x = counts_f[:, None] * jax.nn.softmax(masked, axis=1)
-    x = jnp.where(feasible, x, 0.0)
+    x = gt(jnp.where(feasible, x, 0.0))
     demand = jnp.einsum("gt,gr->tr", x, vectors)
     nodes = jnp.max(demand / jnp.maximum(capacity, 1e-3), axis=1)
     return LPResult(
@@ -104,6 +113,18 @@ def lp_relax_solve(
         fractional_nodes=nodes,
         objective=jnp.sum(prices * nodes),
     )
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def lp_relax_solve(
+    vectors,  # [G, R] f32
+    counts,  # [G] i32/f32
+    capacity,  # [T, R] f32
+    valid_types,  # [T] bool
+    prices,  # [T] f32
+    steps: int = 300,
+) -> LPResult:
+    return lp_relax_body(vectors, counts, capacity, valid_types, prices, steps)
 
 
 def round_assignment(assignment: np.ndarray, counts: np.ndarray) -> np.ndarray:
