@@ -20,7 +20,7 @@ import numpy as np
 ZONES = ("z-1a", "z-1b", "z-1c")
 
 
-def make_workload(num_pods=50_000, num_types=400, seed=0):
+def make_workload(num_pods=50_000, num_types=400, seed=0, **market_kwargs):
     from karpenter_tpu.api.pods import PodSpec
     from karpenter_tpu.cloudprovider import InstanceType, Offering
     from karpenter_tpu.cloudprovider.market import generate_market
@@ -81,7 +81,7 @@ def make_workload(num_pods=50_000, num_types=400, seed=0):
         kube_reserved_cpu_millis,
     )
 
-    market = generate_market(names, ZONES, seed=seed + 1)
+    market = generate_market(names, ZONES, seed=seed + 1, **market_kwargs)
     catalog = []
     for name in names:
         offerings = []
@@ -159,9 +159,13 @@ def main():
 
     # Headline: latency at the solver boundary (densified specs in, packing
     # plan out) — the operation the <200ms p50 north-star targets. Encoding
-    # is amortized over the 1-10s batch window by the controller.
+    # is measured separately (encode_ms) and also charged in end_to_end_ms.
+    start = time.perf_counter()
     groups = group_pods(pods)
-    fleet = build_fleet(catalog, constraints, pods)
+    fleet = build_fleet(
+        catalog, constraints, pods, pods_need=groups.vectors.max(axis=0)
+    )
+    encode_ms = (time.perf_counter() - start) * 1e3
     latencies = []
     for _ in range(10):
         start = time.perf_counter()
@@ -255,6 +259,44 @@ def main():
         cost_result.projected_cost() / greedy_ideal if greedy_ideal else 1.0
     )
 
+    # Sensitivity sweep: the realized-cost win must not be an artifact of the
+    # market simulator's assumed parameters. Re-run the cost comparison over a
+    # grid of depth-slack (how best-effort EC2's spot priority honoring is)
+    # × price↔depth anti-correlation (on/off), 8 seeds each; report per-cell
+    # means. A defensible win keeps every cell ≤ the BASELINE.md ≥15% target.
+    sweep_slacks = (0.1, 0.25, 0.5)
+    sweep_correlations = (0.0, 0.4)
+    sweep_seeds = range(8)
+    sweep_cells = {}
+    for corr in sweep_correlations:
+        per_seed = {slack: [] for slack in sweep_slacks}
+        for seed in sweep_seeds:
+            s_pods, s_catalog, s_market = make_workload(
+                seed=seed, price_depth_correlation=corr
+            )
+            s_groups = group_pods(s_pods)
+            s_fleet = build_fleet(
+                s_catalog, constraints, s_pods,
+                pods_need=s_groups.vectors.max(axis=0),
+            )
+            s_ours = solver.solve_encoded(s_groups, s_fleet)
+            s_greedy = baseline_solver.solve_encoded(s_groups, s_fleet)
+            for slack in sweep_slacks:
+                g = simulate_plan_cost(
+                    s_greedy, constraints, s_market, ZONES, depth_slack=slack
+                )
+                o = simulate_plan_cost(
+                    s_ours, constraints, s_market, ZONES, depth_slack=slack
+                )
+                per_seed[slack].append(o / g if g else 1.0)
+        for slack in sweep_slacks:
+            ratios_cell = per_seed[slack]
+            sweep_cells[f"corr{corr}_slack{slack}"] = {
+                "mean": round(float(np.mean(ratios_cell)), 4),
+                "max": round(float(np.max(ratios_cell)), 4),
+            }
+    sweep_worst_mean = max(cell["mean"] for cell in sweep_cells.values())
+
     print(
         json.dumps(
             {
@@ -264,6 +306,7 @@ def main():
                 "vs_baseline": round(baseline_ms / p50, 3) if p50 else 0.0,
                 "p99_ms": round(p99, 3),
                 "end_to_end_ms": round(end_to_end_ms, 3),
+                "encode_ms": round(encode_ms, 3),
                 "baseline_ms": round(baseline_ms, 3),
                 "baseline_impl": "native-cxx"
                 if native_mod.available()
@@ -275,6 +318,8 @@ def main():
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),
+                "cost_ratio_sweep": sweep_cells,
+                "cost_ratio_sweep_worst_mean": round(sweep_worst_mean, 4),
                 "pods": len(pods),
                 "types": len(catalog),
             }

@@ -123,10 +123,15 @@ def allocate(
     capacity_type: str,
     market: Optional[SpotMarket] = None,
     excluded: Iterable[Pool] = (),
+    depth_slack: float = DEPTH_SLACK,
 ) -> Optional[PoolOffer]:
     """One node's pool under the reference's fleet strategies
     (instance.go:129-132): lowest-price for on-demand;
-    capacity-optimized-prioritized for spot."""
+    capacity-optimized-prioritized for spot. depth_slack parameterizes how
+    "best-effort" EC2's priority honoring is (0 = pure capacity-optimized,
+    ignore priorities entirely unless depths tie; 1 = pure priority order) —
+    the bench sweeps it to show the cost win isn't an artifact of one
+    assumed value."""
     excluded = set(excluded)
     usable = [o for o in offers if (o.instance_type, o.zone) not in excluded]
     if not usable:
@@ -137,7 +142,7 @@ def allocate(
     equivalent = [
         o
         for o in usable
-        if market.pool_depth((o.instance_type, o.zone)) >= deepest * (1.0 - DEPTH_SLACK)
+        if market.pool_depth((o.instance_type, o.zone)) >= deepest * (1.0 - depth_slack)
     ]
     return min(equivalent, key=lambda o: o.priority)
 
@@ -227,6 +232,7 @@ def simulate_plan_cost(
     constraints,
     market: Optional[SpotMarket] = None,
     zones: Sequence[str] = (),
+    depth_slack: float = DEPTH_SLACK,
 ) -> float:
     """Total realized $/hr of a PackResult when every node is bought through
     the reference's fleet strategies against one shared market state."""
@@ -236,7 +242,7 @@ def simulate_plan_cost(
     for packing in result.packings:
         capacity_type = capacity_type_for(constraints, packing.instance_type_options)
         offers = plan_offers(packing, zone_filter, capacity_type, market)
-        chosen = allocate(offers, capacity_type, market)
+        chosen = allocate(offers, capacity_type, market, depth_slack=depth_slack)
         if chosen is None:
             # No purchasable pool: price at the best advertised offering so an
             # infeasible plan still costs rather than silently zeroes.

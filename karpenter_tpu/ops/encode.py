@@ -24,8 +24,24 @@ from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider import InstanceType
 
 
+# Content-keyed memo for resource_vector: pod batches repeat a handful of
+# request shapes thousands of times (a 50k-pod batch has ~16 distinct
+# shapes), so the dict→vector conversion runs once per distinct content
+# instead of once per pod. Entries are read-only so sharing is safe; the
+# bound guards a long-running controller against unbounded distinct shapes.
+_VEC_MEMO: Dict[Tuple, np.ndarray] = {}
+_VEC_MEMO_MAX = 65536
+
+
 def resource_vector(resources: Mapping[str, float]) -> np.ndarray:
-    """ResourceList -> dense [R] float32 vector in kernel units."""
+    """ResourceList -> dense [R] float32 vector in kernel units.
+
+    Returns a cached READ-ONLY array shared across calls with equal content —
+    copy before mutating."""
+    key = tuple(sorted(resources.items()))
+    vec = _VEC_MEMO.get(key)
+    if vec is not None:
+        return vec
     vec = np.zeros(wellknown.NUM_RESOURCE_DIMS, dtype=np.float32)
     for name, value in resources.items():
         index = wellknown.RESOURCE_DIM_INDEX.get(name)
@@ -36,6 +52,10 @@ def resource_vector(resources: Mapping[str, float]) -> np.ndarray:
         elif name == wellknown.RESOURCE_MEMORY:
             value = value * wellknown.MEMORY_SCALE
         vec[index] = value
+    vec.flags.writeable = False
+    if len(_VEC_MEMO) >= _VEC_MEMO_MAX:
+        _VEC_MEMO.clear()
+    _VEC_MEMO[key] = vec
     return vec
 
 
@@ -59,18 +79,27 @@ class PodGroups:
 
 
 def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
-    buckets: Dict[Tuple, List[PodSpec]] = {}
-    vectors: Dict[Tuple, np.ndarray] = {}
+    buckets: Dict[bytes, List[PodSpec]] = {}
+    vectors: Dict[bytes, np.ndarray] = {}
     for pod in pods:
-        vec = resource_vector(pod.requests)
-        key = tuple(vec.tolist())
-        buckets.setdefault(key, []).append(pod)
-        vectors[key] = vec
+        vec = resource_vector(pod.requests)  # memoized: ~1 parse per shape
+        key = vec.tobytes()
+        members = buckets.get(key)
+        if members is None:
+            buckets[key] = [pod]
+            vectors[key] = vec
+        else:
+            members.append(pod)
     cpu = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
     mem = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_MEMORY]
     # Desc by cpu, then memory, then the full vector for determinism.
     keys = sorted(
-        buckets.keys(), key=lambda k: (-k[cpu], -k[mem], tuple(-x for x in k))
+        buckets.keys(),
+        key=lambda k: (
+            -vectors[k][cpu],
+            -vectors[k][mem],
+            tuple(-x for x in vectors[k].tolist()),
+        ),
     )
     return PodGroups(
         vectors=np.stack([vectors[k] for k in keys])
@@ -161,16 +190,22 @@ def build_fleet(
     constraints: Constraints,
     pods: Sequence[PodSpec],
     daemons: Sequence[PodSpec] = (),
+    pods_need: Optional[np.ndarray] = None,
 ) -> InstanceFleet:
     """Filter + densify instance types for one schedule's constraints
     (ref: PackablesFor packable.go:45-93): constraint envelope filters,
     accelerator anti-waste, kubelet overhead reservation, daemonset overhead
-    packing, then ascending sort by (accelerators, cpu, memory)."""
-    pods_need = (
-        np.max([resource_vector(p.requests) for p in pods], axis=0)
-        if pods
-        else np.zeros(wellknown.NUM_RESOURCE_DIMS, np.float32)
-    )
+    packing, then ascending sort by (accelerators, cpu, memory).
+
+    pods_need is the [R] elementwise max of the pods' request vectors; pass
+    it when the caller already grouped the pods (Solver.solve does) so the
+    50k-pod batch isn't re-walked here."""
+    if pods_need is None:
+        pods_need = (
+            np.max([resource_vector(p.requests) for p in pods], axis=0)
+            if pods
+            else np.zeros(wellknown.NUM_RESOURCE_DIMS, np.float32)
+        )
     daemon_groups = group_pods(list(daemons))
 
     allowed_zones = constraints.effective_requirements().allowed(wellknown.ZONE_LABEL)
