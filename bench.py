@@ -232,55 +232,39 @@ def main():
     # simulator (lowest-price for on-demand, capacity-optimized-prioritized
     # for spot — ref: instance.go:116-133) against one market state. The
     # reference plan offers its price-blind ascending-size window with
-    # size-priority; ours offers price-ranked feasible pools. Averaged over
-    # several workload/market draws so one lucky or unlucky market doesn't
-    # set the headline (seed 0's draw is in fact the least favorable).
-    ratios = []
-    for seed in range(4):
-        if seed == 0:
-            # Seed 0's encode and both solves already happened above — reuse.
-            seed_market = market
-            seed_ours, seed_greedy = cost_result, greedy_result
-        else:
-            seed_pods, seed_catalog, seed_market = make_workload(seed=seed)
-            seed_groups = group_pods(seed_pods)
-            seed_fleet = build_fleet(seed_catalog, constraints, seed_pods)
-            seed_ours = solver.solve_encoded(seed_groups, seed_fleet)
-            seed_greedy = baseline_solver.solve_encoded(seed_groups, seed_fleet)
-        greedy_cost = simulate_plan_cost(seed_greedy, constraints, seed_market, ZONES)
-        ours_cost = simulate_plan_cost(seed_ours, constraints, seed_market, ZONES)
-        ratios.append(ours_cost / greedy_cost if greedy_cost else 1.0)
-    cost_ratio = float(np.mean(ratios))
-    # Secondary, optimistic accounting on the seed-0 draw: every node at its
-    # cheapest advertised offering (assumes lowest-price allocation even for
-    # spot).
-    greedy_ideal = greedy_result.projected_cost()
-    lowest_price_ratio = (
-        cost_result.projected_cost() / greedy_ideal if greedy_ideal else 1.0
-    )
-
-    # Sensitivity sweep: the realized-cost win must not be an artifact of the
-    # market simulator's assumed parameters. Re-run the cost comparison over a
-    # grid of depth-slack (how best-effort EC2's spot priority honoring is)
-    # × price↔depth anti-correlation (on/off), 8 seeds each; report per-cell
-    # means. A defensible win keeps every cell ≤ the BASELINE.md ≥15% target.
+    # size-priority; ours offers price-ranked feasible pools.
+    #
+    # Sensitivity sweep: the win must not be an artifact of the simulator's
+    # assumed parameters, so the comparison runs over a grid of depth-slack
+    # (how best-effort EC2's spot priority honoring is) × price↔depth
+    # anti-correlation (on/off) × 8 workload/market seeds. A defensible win
+    # keeps every cell's mean ≤ the BASELINE.md ≥15% target. The headline
+    # cost_ratio is the default-assumptions cell (corr 0.4, slack 0.25),
+    # seeds 0-3 (compatible with prior rounds' 4-seed headline).
     sweep_slacks = (0.1, 0.25, 0.5)
     sweep_correlations = (0.0, 0.4)
     sweep_seeds = range(8)
+    default_corr, default_slack = 0.4, 0.25
     sweep_cells = {}
+    headline_ratios = []
     for corr in sweep_correlations:
         per_seed = {slack: [] for slack in sweep_slacks}
         for seed in sweep_seeds:
-            s_pods, s_catalog, s_market = make_workload(
-                seed=seed, price_depth_correlation=corr
-            )
-            s_groups = group_pods(s_pods)
-            s_fleet = build_fleet(
-                s_catalog, constraints, s_pods,
-                pods_need=s_groups.vectors.max(axis=0),
-            )
-            s_ours = solver.solve_encoded(s_groups, s_fleet)
-            s_greedy = baseline_solver.solve_encoded(s_groups, s_fleet)
+            if corr == default_corr and seed == 0:
+                # The main workload above IS (seed 0, default corr): reuse
+                # its market and both already-computed plans.
+                s_market, s_ours, s_greedy = market, cost_result, greedy_result
+            else:
+                s_pods, s_catalog, s_market = make_workload(
+                    seed=seed, price_depth_correlation=corr
+                )
+                s_groups = group_pods(s_pods)
+                s_fleet = build_fleet(
+                    s_catalog, constraints, s_pods,
+                    pods_need=s_groups.vectors.max(axis=0),
+                )
+                s_ours = solver.solve_encoded(s_groups, s_fleet)
+                s_greedy = baseline_solver.solve_encoded(s_groups, s_fleet)
             for slack in sweep_slacks:
                 g = simulate_plan_cost(
                     s_greedy, constraints, s_market, ZONES, depth_slack=slack
@@ -295,7 +279,18 @@ def main():
                 "mean": round(float(np.mean(ratios_cell)), 4),
                 "max": round(float(np.max(ratios_cell)), 4),
             }
+        if corr == default_corr:
+            headline_ratios = per_seed[default_slack][:4]
     sweep_worst_mean = max(cell["mean"] for cell in sweep_cells.values())
+    ratios = headline_ratios
+    cost_ratio = float(np.mean(ratios))
+    # Secondary, optimistic accounting on the seed-0 draw: every node at its
+    # cheapest advertised offering (assumes lowest-price allocation even for
+    # spot).
+    greedy_ideal = greedy_result.projected_cost()
+    lowest_price_ratio = (
+        cost_result.projected_cost() / greedy_ideal if greedy_ideal else 1.0
+    )
 
     print(
         json.dumps(

@@ -290,9 +290,12 @@ POOL_PRICE_BAND = 0.05
 MIN_POOL_ROWS = 4
 # Hard ceiling on any offered row relative to the cheapest feasible pool:
 # capacity-optimized allocation may land on ANY offered row, so every row is
-# a price we are willing to pay. 1.15 empirically dominates 1.3 across the
-# bench's market-sensitivity grid (every mean improves, worst-seed realized
-# ratio drops ~6pts) while still leaving MIN_POOL_ROWS-worth of ICE headroom.
+# a price we are willing to pay. The ceiling OVERRIDES the MIN_POOL_ROWS
+# floor — when the 2nd-cheapest feasible pool already exceeds it, we offer a
+# single row and rely on the ICE blackout/retry machinery rather than
+# overpay. 1.15 empirically dominates 1.3 across the bench's
+# market-sensitivity grid (every cell mean improves, worst-seed realized
+# ratio drops ~6pts).
 MAX_POOL_PRICE_RATIO = 1.15
 
 

@@ -29,9 +29,16 @@ from karpenter_tpu.cloudprovider.ec2.vendor import (
 from karpenter_tpu.utils.clock import FakeClock
 
 
+def make_api():
+    """The Ec2Api backend under test. tests/test_aws_http.py re-runs this
+    whole suite with the wire binding (AwsHttpEc2Api over a wire-level fake)
+    swapped in here, so every scenario exercises both backends."""
+    return FakeEc2()
+
+
 def make_provider(clock=None):
     clock = clock or FakeClock()
-    api = FakeEc2()
+    api = make_api()
     return Ec2CloudProvider(api=api, clock=clock), api, clock
 
 
@@ -409,7 +416,7 @@ class TestEndToEnd:
         from karpenter_tpu.utils.clock import FakeClock
 
         clock = FakeClock()
-        provider = Ec2CloudProvider(api=FakeEc2(), clock=clock)
+        provider = Ec2CloudProvider(api=make_api(), clock=clock)
         validation.DEFAULT_HOOK = provider.default
         validation.VALIDATE_HOOK = provider.validate
         try:
