@@ -25,20 +25,12 @@ def build_cluster(options) -> Cluster:
     """Select the cluster-store backend (ref: cmd/controller/main.go:61-99 —
     the reference always reconciles a live apiserver; --cluster-store wires
     the same here, with the in-memory store for standalone/dev runs)."""
-    if options.cluster_store == "memory":
-        return Cluster()
     from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
     from karpenter_tpu.kubeapi.client import HttpTransport
 
-    if options.cluster_store == "incluster":
-        transport = HttpTransport.in_cluster()
-    else:
-        transport = HttpTransport(
-            options.cluster_store,
-            token=os.environ.get("KUBE_TOKEN", ""),
-            ca_file=os.environ.get("KUBE_CA_FILE") or None,
-            insecure=os.environ.get("KUBE_INSECURE", "") == "true",
-        )
+    transport = HttpTransport.for_store(options.cluster_store)
+    if transport is None:
+        return Cluster()
     client = KubeClient(
         transport, qps=options.kube_client_qps, burst=options.kube_client_burst
     )

@@ -14,10 +14,14 @@ protocol (so a real apiserver can call them) with a plain-JSON fallback:
   POST /config    — {"level": "..."} live log-level reload
                     (ref: the config-logging ConfigMap validation webhook)
 
-TLS: pass --tls-cert-file/--tls-key-file (the chart mounts them from a
-secret) — the apiserver only calls HTTPS webhook endpoints
-(ref: cmd/webhook/main.go:44-62 knative's cert rotation; here certs are
-operator-supplied, e.g. cert-manager).
+TLS (the apiserver only calls HTTPS webhook endpoints), either:
+  * --tls-self-signed [--tls-dns-names a,b,c] — self-provision a serving
+    cert at startup, rotate it in-process before expiry, and inject the
+    caBundle into the webhook configurations through the apiserver
+    (ref: cmd/webhook/main.go:44-62 — knative's certificate controller;
+    the chart's default, no operator secret needed), or
+  * --tls-cert-file/--tls-key-file — operator-supplied certs mounted from
+    a secret (e.g. cert-manager; chart webhook.tlsSecretName).
 
 Run: python -m karpenter_tpu.cmd.webhook --cluster-name my-cluster
 """
@@ -202,16 +206,31 @@ class _TLSHTTPServer(http.server.ThreadingHTTPServer):
 
 
 def _extract_flag(argv: list, name: str) -> Optional[str]:
-    """Pop --name=value / --name value from argv; returns the value."""
+    """Pop --name=value / --name value / bare --name from argv. Returns the
+    value, "" for a bare flag (Go-style boolean), None when absent — a
+    following argument that is itself a flag is never consumed as a value."""
     for i, arg in enumerate(list(argv)):
         if arg.startswith(f"--{name}="):
             argv.pop(i)
             return arg.split("=", 1)[1]
-        if arg == f"--{name}" and i + 1 < len(argv):
-            value = argv[i + 1]
-            del argv[i : i + 2]
-            return value
+        if arg == f"--{name}":
+            if i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+                value = argv[i + 1]
+                del argv[i : i + 2]
+                return value
+            argv.pop(i)
+            return ""
     return None
+
+
+def _cluster_kube_client(options):
+    """A KubeClient for the configured apiserver backend, or None for the
+    in-memory store (shared selection: HttpTransport.for_store)."""
+    from karpenter_tpu.kubeapi import KubeClient
+    from karpenter_tpu.kubeapi.client import HttpTransport
+
+    transport = HttpTransport.for_store(options.cluster_store)
+    return None if transport is None else KubeClient(transport)
 
 
 def main(
@@ -221,28 +240,66 @@ def main(
     address: str = "",
     tls_cert_file: Optional[str] = None,
     tls_key_file: Optional[str] = None,
+    tls_self_signed: bool = False,
+    tls_dns_names: Optional[List[str]] = None,
 ):
     # These flags belong to this binary, not the shared options envelope
     # (the chart passes them; options.parse would reject unknown flags).
     if argv:
         argv = list(argv)
         port_arg = _extract_flag(argv, "port")
-        if port_arg is not None:
+        if port_arg:
             port = int(port_arg)
         tls_cert_file = _extract_flag(argv, "tls-cert-file") or tls_cert_file
         tls_key_file = _extract_flag(argv, "tls-key-file") or tls_key_file
+        self_signed_arg = _extract_flag(argv, "tls-self-signed")
+        if self_signed_arg is not None:
+            # Bare --tls-self-signed means true, Go-flag style.
+            tls_self_signed = self_signed_arg.lower() in ("true", "1", "yes", "")
+        dns_arg = _extract_flag(argv, "tls-dns-names")
+        if dns_arg:
+            tls_dns_names = [d.strip() for d in dns_arg.split(",") if d.strip()]
     options = options_pkg.parse(argv)
     klog.setup(options.log_level)
     registry.new_cloud_provider(options.cloud_provider)  # installs hooks
     scheme = "http"
+    cert_manager = None
+    if not (tls_cert_file and tls_key_file) and tls_self_signed:
+        # No operator-supplied secret: self-provision the serving cert,
+        # rotate it in-process before expiry, and inject the caBundle into
+        # the webhook configurations — the knative reference's certificate
+        # controller behavior (ref: cmd/webhook/main.go:44-62).
+        from karpenter_tpu.utils.certs import CertManager, inject_ca_bundle
+
+        names = tls_dns_names or [
+            "karpenter-tpu-webhook",
+            "karpenter-tpu-webhook.karpenter.svc",
+            "karpenter-tpu-webhook.karpenter.svc.cluster.local",
+        ]
+        cert_manager = CertManager(common_name=names[0], dns_names=names)
+        tls_cert_file, tls_key_file = cert_manager.ensure()
+        client = _cluster_kube_client(options)
+        if client is not None:
+            def _inject(ca_b64: str, client=client):
+                inject_ca_bundle(client, ca_b64)
+
+            cert_manager.on_rotate = _inject
+            try:
+                _inject(cert_manager.ca_bundle_b64())
+            except Exception:  # noqa: BLE001 — registration may come later
+                klog.named("webhook").exception("initial caBundle injection failed")
     if tls_cert_file and tls_key_file:
-        # The apiserver only calls HTTPS webhooks; certs are mounted from a
-        # secret (chart webhook.tlsSecretName), rotated by re-deploying —
-        # the knative reference rotates in-process (main.go:44-62).
+        # The apiserver only calls HTTPS webhook endpoints. Certs are either
+        # operator-mounted (chart webhook.tlsSecretName) or self-provisioned
+        # above; self-provisioned contexts hot-reload on rotation.
         context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         context.load_cert_chain(tls_cert_file, tls_key_file)
         server = _TLSHTTPServer((address, port), WebhookHandler, context)
         scheme = "https"
+        if cert_manager is not None:
+            cert_manager.register_context(context)
+            cert_manager.start_rotation_thread()
+            server.cert_manager = cert_manager
     else:
         server = http.server.ThreadingHTTPServer((address, port), WebhookHandler)
     threading.Thread(target=server.serve_forever, daemon=True).start()

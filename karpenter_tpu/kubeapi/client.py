@@ -82,6 +82,25 @@ class HttpTransport(Transport):
             ca_file=f"{SERVICEACCOUNT_DIR}/ca.crt",
         )
 
+    @classmethod
+    def for_store(cls, store: str) -> Optional["HttpTransport"]:
+        """THE --cluster-store selection, shared by every binary (controller,
+        webhook): "memory" -> None (in-memory store), "incluster" ->
+        serviceaccount transport, anything else -> an apiserver URL with
+        KUBE_TOKEN / KUBE_CA_FILE / KUBE_INSECURE env credentials."""
+        if store == "memory":
+            return None
+        if store == "incluster":
+            return cls.in_cluster()
+        import os
+
+        return cls(
+            store,
+            token=os.environ.get("KUBE_TOKEN", ""),
+            ca_file=os.environ.get("KUBE_CA_FILE") or None,
+            insecure=os.environ.get("KUBE_INSECURE", "") == "true",
+        )
+
     def _request(self, method: str, url: str, body: Optional[dict], timeout: float):
         data = None if body is None else json.dumps(body).encode()
         request = urllib.request.Request(url, data=data, method=method)

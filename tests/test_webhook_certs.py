@@ -1,0 +1,217 @@
+"""Webhook cert self-provisioning + rotation + caBundle injection
+(utils/certs.py). Ref: cmd/webhook/main.go:44-62 — knative's certificate
+controller generates/rotates the serving cert and injects the CA bundle;
+these tests hold the rebuilt behavior to that contract."""
+
+import datetime
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.utils.certs import (
+    MUTATING_WEBHOOK_NAME,
+    VALIDATING_WEBHOOK_NAME,
+    CertManager,
+    generate_self_signed,
+    inject_ca_bundle,
+)
+
+
+class TestGenerateSelfSigned:
+    def test_cert_carries_sans_and_validity(self):
+        cert_pem, key_pem = generate_self_signed(
+            "svc.ns.svc", ["svc.ns.svc", "svc.ns.svc.cluster.local", "127.0.0.1"],
+            lifetime=datetime.timedelta(days=30),
+        )
+        from cryptography import x509
+
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        sans = cert.extensions.get_extension_for_class(x509.SubjectAlternativeName)
+        names = sans.value.get_values_for_type(x509.DNSName)
+        assert "svc.ns.svc" in names and "svc.ns.svc.cluster.local" in names
+        ips = sans.value.get_values_for_type(x509.IPAddress)
+        assert [str(ip) for ip in ips] == ["127.0.0.1"]
+        lifetime = cert.not_valid_after_utc - cert.not_valid_before_utc
+        assert datetime.timedelta(days=29) < lifetime < datetime.timedelta(days=31)
+        assert b"PRIVATE KEY" in key_pem
+
+    def test_key_loads_with_cert(self, tmp_path):
+        cert_pem, key_pem = generate_self_signed("x")
+        (tmp_path / "tls.crt").write_bytes(cert_pem)
+        (tmp_path / "tls.key").write_bytes(key_pem)
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        context.load_cert_chain(
+            str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+        )  # raises on mismatch
+
+
+class _ManualClock:
+    def __init__(self):
+        self.now = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+
+    def __call__(self):
+        return self.now
+
+
+class TestCertManager:
+    def test_ensure_provisions_once(self, tmp_path):
+        clock = _ManualClock()
+        manager = CertManager("cn", cert_dir=str(tmp_path), clock=clock)
+        cert_path, key_path = manager.ensure()
+        first = open(cert_path, "rb").read()
+        manager.ensure()  # fresh cert: no regeneration
+        assert open(cert_path, "rb").read() == first
+        assert manager.ca_bundle_b64()
+
+    def test_rotates_when_lifetime_mostly_spent(self, tmp_path):
+        clock = _ManualClock()
+        manager = CertManager(
+            "cn", cert_dir=str(tmp_path),
+            lifetime=datetime.timedelta(days=10), clock=clock,
+        )
+        manager.ensure()
+        first = manager.ca_bundle_b64()
+        assert not manager.due_for_rotation()
+        clock.now += datetime.timedelta(days=7)
+        assert not manager.due_for_rotation()  # 30% remaining: not yet
+        clock.now += datetime.timedelta(days=2)  # 10% remaining
+        assert manager.due_for_rotation()
+        rotated_bundles = []
+        manager.on_rotate = rotated_bundles.append
+        assert manager.rotate_if_due()
+        assert manager.ca_bundle_b64() != first
+        assert rotated_bundles == [manager.ca_bundle_b64()]
+        assert not manager.due_for_rotation()
+
+    def test_rotation_hot_reloads_registered_context(self, tmp_path):
+        clock = _ManualClock()
+        manager = CertManager(
+            "127.0.0.1", cert_dir=str(tmp_path),
+            lifetime=datetime.timedelta(days=10), clock=clock,
+        )
+        cert_path, key_path = manager.ensure()
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        context.load_cert_chain(cert_path, key_path)
+        manager.register_context(context)
+        clock.now += datetime.timedelta(days=9, hours=12)
+        assert manager.rotate_if_due()  # load_cert_chain on the live context
+
+
+class _StubKube:
+    """Records get/update; serves canned webhook-configuration objects."""
+
+    def __init__(self, objects):
+        self.objects = objects
+        self.updates = []
+
+    def try_get(self, path):
+        return self.objects.get(path)
+
+    def update(self, path, obj):
+        self.objects[path] = obj
+        self.updates.append(path)
+        return obj
+
+
+def _webhook_config(name, ca=""):
+    return {
+        "metadata": {"name": name},
+        "webhooks": [
+            {"name": name, "clientConfig": {"caBundle": ca, "service": {"name": "s"}}}
+        ],
+    }
+
+
+MUTATING_PATH = (
+    "/apis/admissionregistration.k8s.io/v1/mutatingwebhookconfigurations/"
+    + MUTATING_WEBHOOK_NAME
+)
+VALIDATING_PATH = (
+    "/apis/admissionregistration.k8s.io/v1/validatingwebhookconfigurations/"
+    + VALIDATING_WEBHOOK_NAME
+)
+
+
+class TestInjectCaBundle:
+    def test_writes_bundle_into_both_configurations(self):
+        kube = _StubKube(
+            {
+                MUTATING_PATH: _webhook_config(MUTATING_WEBHOOK_NAME),
+                VALIDATING_PATH: _webhook_config(VALIDATING_WEBHOOK_NAME),
+            }
+        )
+        assert inject_ca_bundle(kube, "Q0E=") == 2
+        for path in (MUTATING_PATH, VALIDATING_PATH):
+            webhook = kube.objects[path]["webhooks"][0]
+            assert webhook["clientConfig"]["caBundle"] == "Q0E="
+            # Sibling fields survive (read-modify-write, not merge-patch).
+            assert webhook["clientConfig"]["service"] == {"name": "s"}
+
+    def test_idempotent_and_missing_config_skipped(self):
+        kube = _StubKube(
+            {MUTATING_PATH: _webhook_config(MUTATING_WEBHOOK_NAME, ca="Q0E=")}
+        )
+        assert inject_ca_bundle(kube, "Q0E=") == 0  # same bundle: no write
+        assert kube.updates == []
+
+
+class TestFlagParsing:
+    def test_bare_boolean_flag_does_not_eat_next_flag(self):
+        from karpenter_tpu.cmd.webhook import _extract_flag
+
+        argv = ["--tls-self-signed", "--cluster-store", "incluster"]
+        assert _extract_flag(argv, "tls-self-signed") == ""  # bare = true
+        assert argv == ["--cluster-store", "incluster"]
+
+    def test_flag_value_forms(self):
+        from karpenter_tpu.cmd.webhook import _extract_flag
+
+        argv = ["--port=18450", "--tls-dns-names", "a,b"]
+        assert _extract_flag(argv, "port") == "18450"
+        assert _extract_flag(argv, "tls-dns-names") == "a,b"
+        assert _extract_flag(argv, "missing") is None
+
+
+class TestSelfSignedServing:
+    def test_webhook_self_provisions_and_serves_https(self):
+        """The chart's no-secret default: --tls-self-signed provisions the
+        cert and the apiserver-shaped AdmissionReview call succeeds over
+        HTTPS against the generated CA."""
+        from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+        from karpenter_tpu.api.serialization import provisioner_to_dict
+        from karpenter_tpu.cmd.webhook import main as webhook_main
+
+        server = webhook_main(
+            [
+                "--cluster-name", "test",
+                "--tls-self-signed", "true",
+                "--tls-dns-names", "127.0.0.1,localhost",
+            ],
+            port=18447,
+            block=False,
+        )
+        try:
+            manager = server.cert_manager
+            context = ssl.create_default_context(cafile=manager.cert_path)
+            # SAN is 127.0.0.1: hostname verification included.
+            obj = provisioner_to_dict(
+                Provisioner(name="default", spec=ProvisionerSpec())
+            )
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": "u1", "object": obj},
+            }
+            request = urllib.request.Request(
+                "https://127.0.0.1:18447/validate",
+                data=json.dumps(review).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(request, context=context) as resp:
+                payload = json.loads(resp.read())
+            assert payload["response"]["allowed"] is True
+        finally:
+            manager.stop()
+            server.shutdown()
