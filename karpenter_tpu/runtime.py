@@ -301,12 +301,19 @@ class Manager:
         self.ready = threading.Event()
         self._stop = threading.Event()
 
-        # Reconcile loops with the reference's concurrency envelope
-        # (selection 10k in the reference — bounded here by thread cost;
-        # the loop is keyed and collapse-deduped so fewer threads suffice).
+        # Reconcile loops. The reference runs selection at
+        # MaxConcurrentReconciles=10,000 (selection/controller.go:166) where
+        # each reconcile parks on network I/O; here selection reconciles the
+        # informer cache (CPU-bound under the GIL) and the loop is keyed +
+        # collapse-deduped, so the envelope is picked from pod-storm data
+        # (bench.py bench_pod_storm: 10k-pod storm drain is flat from 4 to
+        # 128 threads — batching-window bound, so 8 threads keep up; see
+        # Options.selection_concurrency to raise it).
         self.loops = {
             "selection": ReconcileLoop(
-                "selection", lambda key: self.selection.reconcile(*key), concurrency=8
+                "selection",
+                lambda key: self.selection.reconcile(*key),
+                concurrency=options.selection_concurrency,
             ),
             "provisioning": ReconcileLoop(
                 "provisioning", self.provisioning.reconcile, concurrency=2

@@ -43,6 +43,14 @@ class Options:
     #   <URL>      — apiserver at an explicit base URL (kubeconfig-less dev;
     #                token from KUBE_TOKEN, CA from KUBE_CA_FILE)
     cluster_store: str = "memory"
+    # Selection reconcile threads. The reference runs selection at
+    # MaxConcurrentReconciles=10,000 (selection/controller.go:166) because
+    # each reconcile blocks on network I/O; here reconciles read the
+    # informer cache (CPU-bound under the GIL), and the pod-storm benchmark
+    # (bench.py bench_pod_storm: 10k pods through the running Manager) shows
+    # drain time flat from 4 to 128 threads (~16s, batching-window bound) —
+    # so the envelope is the cheapest setting that keeps up: 8.
+    selection_concurrency: int = 8
 
     def validate(self) -> None:
         errors: List[str] = []
@@ -54,6 +62,10 @@ class Options:
             errors.append(f"unknown solver {self.solver!r}")
         if self.solver == "remote" and not self.solver_endpoint:
             errors.append("solver=remote requires --solver-endpoint")
+        if self.selection_concurrency < 1:
+            errors.append(
+                f"selection-concurrency must be >= 1, got {self.selection_concurrency}"
+            )
         if self.cluster_store != "memory" and self.cluster_store != "incluster" and not self.cluster_store.startswith(
             ("http://", "https://")
         ):
@@ -91,6 +103,10 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument(
         "--cluster-store", default=_env("CLUSTER_STORE", "memory")
     )
+    parser.add_argument(
+        "--selection-concurrency", type=int,
+        default=int(_env("SELECTION_CONCURRENCY", "8")),
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -105,6 +121,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         leader_election=not args.no_leader_election,
         log_level=args.log_level,
         cluster_store=args.cluster_store,
+        selection_concurrency=args.selection_concurrency,
     )
     options.validate()
     return options

@@ -23,3 +23,18 @@ try:
     _xb._backend_factories.pop("axon", None)
 except Exception:  # pragma: no cover — jax internals moved; env var still set
     pass
+
+
+def pytest_collection_modifyitems(config, items):
+    """KARPENTER_RANDOM_ORDER=<seed|auto> shuffles test order — the
+    reference battletest's randomized-spec analogue (ref Makefile:33-38,
+    ginkgo --randomizeAllSpecs). Seed is printed for reproduction; `make
+    battletest` turns this on."""
+    import random
+
+    spec = os.environ.get("KARPENTER_RANDOM_ORDER")
+    if not spec:
+        return
+    seed = int(spec) if spec.isdigit() else random.randrange(1 << 32)
+    print(f"\nKARPENTER_RANDOM_ORDER seed={seed}")
+    random.Random(seed).shuffle(items)
