@@ -113,6 +113,21 @@ class FakeApiServer:
         for q in list(self._watchers.get(kind, [])):
             q.put(event)
 
+    def emit_bookmark(self, kind: str) -> None:
+        """Test hook: send a watch BOOKMARK carrying the current collection
+        rv (a real apiserver sends these ~per-minute when
+        allowWatchBookmarks=true). Clients must advance their resume rv from
+        it so an idle watch survives history compaction without a re-list.
+        Bookmarks are not appended to replayable history — they are
+        ephemeral, exactly like the real thing."""
+        with self._lock:
+            event = {
+                "type": "BOOKMARK",
+                "object": {"metadata": {"resourceVersion": str(self._rv)}},
+            }
+            for q in list(self._watchers.get(kind, [])):
+                q.put(event)
+
     def drop_watch_connections(self) -> None:
         """Test hook simulating a network partition: every open watch stream
         errors out (clients see a dropped connection and reconnect from their

@@ -234,6 +234,35 @@ class TestWatchResync:
         assert cluster.try_get_pod("default", "survivor") is not None
         assert cluster.resync_count >= 1
 
+    def test_bookmark_advances_resume_rv_past_compaction(self, backend):
+        """Watch bookmarks (allowWatchBookmarks=true) carry the current
+        collection rv without any object event; the client must advance its
+        resume point from them so an IDLE watch survives history compaction
+        with a plain reconnect — no 410, no re-list. Ref: the informer
+        bookmark contract the reference inherits via controller-runtime."""
+        server, cluster = backend
+        cluster.apply_pod(PodSpec(name="idle-marker", unschedulable=True))
+        assert wait_until(lambda: cluster.try_get_pod("default", "idle-marker"))
+        # The collection moves on while the pod watch idles (other kinds
+        # churn, advancing the global rv past every pod event)…
+        server.seed("nodes", {"metadata": {"name": "churn-1"}})
+        server.seed("nodes", {"metadata": {"name": "churn-2"}})
+        # …then compaction claims everything up to the CURRENT rv: the pod
+        # rv the client last saw an event for is now strictly too old, so
+        # only a bookmark-advanced resume point avoids the 410.
+        server.expire_history("pods")
+        server.emit_bookmark("pods")
+        time.sleep(0.3)  # let the watch pump consume the bookmark
+        server.drop_watch_connections()
+        server.seed("pods", convert.pod_to_kube(PodSpec(name="after-reconnect")))
+        assert wait_until(
+            lambda: cluster.try_get_pod("default", "after-reconnect") is not None
+        ), "reconnect from the bookmarked rv lost the post-reconnect pod"
+        assert cluster.resync_count == 0, (
+            "idle watch hit 410 despite a fresh bookmark — resume rv did not "
+            "advance from BOOKMARK events"
+        )
+
     def test_410_recovery_over_http(self):
         """Same wedge over the real HTTP wire path."""
         from karpenter_tpu.kubeapi.client import HttpTransport
