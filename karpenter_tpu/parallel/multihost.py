@@ -1,0 +1,106 @@
+"""Multi-host bootstrap: jax.distributed process initialization.
+
+Ref: the reference scales its control plane with one process and leans on
+EC2 Fleet for scale-out; this framework's scale axis is the solver, and a
+TPU pod slice spans HOSTS (e.g. v4-16 = 2 hosts × 4 chips). SURVEY.md §5
+mandates "a distributed communication backend (XLA collectives over
+ICI/DCN) that scales to multi-host the way the reference's NCCL/MPI
+backend does" — in JAX that is `jax.distributed.initialize`: every process
+contacts the coordinator, and `jax.devices()` becomes the GLOBAL device
+set, so `parallel.mesh.make_mesh()` and the mesh-sharded fused kernel
+(models/solver.py) span hosts with zero further code — GSPMD routes
+collectives over ICI within a slice and DCN across slices.
+
+Environment contract (the chart's solver StatefulSet sets these; any
+launcher can):
+  KARPENTER_COORDINATOR        host:port of process 0 (absent = single host)
+  KARPENTER_NUM_PROCESSES      total process count
+  KARPENTER_PROCESS_ID         this process's rank, 0-based
+  KARPENTER_MULTIHOST=auto     instead of the three above: call
+                               jax.distributed.initialize() with no
+                               arguments, which autodetects coordinator and
+                               ranks from the TPU pod-slice metadata
+                               service (only meaningful on TPU pods).
+With none of these set, the process runs single-host.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.utils import logging as klog
+
+log = klog.named("parallel.multihost")
+
+
+@dataclass(frozen=True)
+class DistributedConfig:
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+    @staticmethod
+    def from_env(environ=None) -> Optional["DistributedConfig"]:
+        """None when multi-host is not configured (the common single-host
+        case). Raises ValueError on a partial/inconsistent configuration —
+        silently falling back to single-host would deadlock the other
+        processes of the slice at their first collective."""
+        environ = os.environ if environ is None else environ
+        coordinator = environ.get("KARPENTER_COORDINATOR", "")
+        num_processes = environ.get("KARPENTER_NUM_PROCESSES", "")
+        process_id = environ.get("KARPENTER_PROCESS_ID", "")
+        if not coordinator and not num_processes and not process_id:
+            return None
+        if not (coordinator and num_processes and process_id != ""):
+            raise ValueError(
+                "partial multi-host config: KARPENTER_COORDINATOR, "
+                "KARPENTER_NUM_PROCESSES and KARPENTER_PROCESS_ID must all "
+                f"be set (got coordinator={coordinator!r}, "
+                f"num_processes={num_processes!r}, process_id={process_id!r})"
+            )
+        config = DistributedConfig(
+            coordinator=coordinator,
+            num_processes=int(num_processes),
+            process_id=int(process_id),
+        )
+        if config.num_processes < 1:
+            raise ValueError(f"num_processes must be >= 1, got {config.num_processes}")
+        if not 0 <= config.process_id < config.num_processes:
+            raise ValueError(
+                f"process_id {config.process_id} out of range for "
+                f"{config.num_processes} processes"
+            )
+        return config
+
+
+def init_distributed(environ=None) -> bool:
+    """Initialize jax.distributed from the environment. Returns True when a
+    multi-host runtime came up (jax.devices() is now the global set), False
+    for the single-host case. Idempotent per process (jax raises if
+    initialized twice; we guard)."""
+    import jax
+
+    env = os.environ if environ is None else environ
+    auto = env.get("KARPENTER_MULTIHOST", "").lower() == "auto"
+    config = DistributedConfig.from_env(environ)
+    if config is None and not auto:
+        return False
+    if getattr(init_distributed, "_initialized", False):
+        return True
+    if config is None:
+        # TPU pod slice: coordinator/ranks from the metadata service.
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+    init_distributed._initialized = True
+    log.info(
+        "multi-host runtime up: process %d/%d, %d global devices",
+        config.process_id, config.num_processes, jax.device_count(),
+    )
+    return True

@@ -350,6 +350,13 @@ def main(argv=None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--workers", type=int, default=4)
     args = parser.parse_args(argv)
+    # Multi-host slice (KARPENTER_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID):
+    # join the jax.distributed runtime BEFORE the first device touch, so
+    # jax.devices() is the global set and cost_solve_dispatch auto-selects
+    # the mesh-sharded kernel spanning every host's chips.
+    from karpenter_tpu.parallel.multihost import init_distributed
+
+    init_distributed()
     server = SolverServer(port=args.port, host=args.host, workers=args.workers)
     server.start()
     server.wait()

@@ -123,6 +123,55 @@ class TestProductionShardedPath:
         np.testing.assert_allclose(assignment.sum(), counts.sum(), rtol=1e-3)
 
 
+class TestMultihostConfig:
+    """Multi-host bootstrap env contract (parallel/multihost.py). The
+    distributed runtime itself needs real multi-host hardware; what must be
+    airtight locally is the configuration parsing — a partial config that
+    silently fell back to single-host would deadlock the rest of the slice
+    at its first collective."""
+
+    def test_absent_config_is_single_host(self):
+        from karpenter_tpu.parallel.multihost import DistributedConfig
+
+        assert DistributedConfig.from_env({}) is None
+
+    def test_full_config_parses(self):
+        from karpenter_tpu.parallel.multihost import DistributedConfig
+
+        config = DistributedConfig.from_env(
+            {
+                "KARPENTER_COORDINATOR": "10.0.0.1:8476",
+                "KARPENTER_NUM_PROCESSES": "4",
+                "KARPENTER_PROCESS_ID": "2",
+            }
+        )
+        assert config.coordinator == "10.0.0.1:8476"
+        assert config.num_processes == 4
+        assert config.process_id == 2
+
+    def test_partial_config_raises(self):
+        import pytest
+
+        from karpenter_tpu.parallel.multihost import DistributedConfig
+
+        with pytest.raises(ValueError, match="partial multi-host config"):
+            DistributedConfig.from_env({"KARPENTER_COORDINATOR": "10.0.0.1:8476"})
+
+    def test_rank_out_of_range_raises(self):
+        import pytest
+
+        from karpenter_tpu.parallel.multihost import DistributedConfig
+
+        with pytest.raises(ValueError, match="out of range"):
+            DistributedConfig.from_env(
+                {
+                    "KARPENTER_COORDINATOR": "c:1",
+                    "KARPENTER_NUM_PROCESSES": "2",
+                    "KARPENTER_PROCESS_ID": "2",
+                }
+            )
+
+
 class TestGraftEntry:
     def test_entry_compiles_and_runs(self):
         import __graft_entry__
