@@ -387,6 +387,12 @@ def main():
                 else "python",
                 "warmup_compile_s": round(warmup_s, 1),
                 "device_fetch_floor_ms": round(device_fetch_floor_ms, 1),
+                # p50 net of the tunnel's fixed device->host round trip: the
+                # solve cost on co-located (non-tunneled) TPU hardware,
+                # where the fetch floor is sub-ms.
+                "p50_net_of_fetch_floor_ms": round(
+                    max(p50 - device_fetch_floor_ms, 0.0), 3
+                ),
                 "batch8_schedules_ms": round(batch8_ms, 1),
                 "bind_10k_ms": round(bench_bind(), 1),
                 "pod_storm_10k": pod_storm,

@@ -206,7 +206,14 @@ def _sharded_fused_kernel(mesh=None):
         return jax.lax.with_sharding_constraint(x, gt_sharding)
 
     kernel = functools.partial(
-        jax.jit(_cost_fused_body, static_argnames=("lp_steps", "constrain")),
+        jax.jit(
+            _cost_fused_body,
+            static_argnames=("lp_steps", "constrain"),
+            # Replicated outputs: every process (and every device) holds the
+            # full result, so rank 0 of a multi-host slice can fetch it
+            # without touching non-addressable shards (parallel/spmd.py).
+            out_shardings=NamedSharding(mesh, P()),
+        ),
         constrain=constrain,
     )
     groups_size, types_size = mesh.devices.shape
@@ -638,12 +645,16 @@ def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int 
             lp_steps=lp_steps,
         )
     kernel, (g_mult, t_mult) = _sharded_fused_kernel(mesh)
-    return kernel(
-        *pad_kernel_args(
-            vectors, counts, capacity, total, prices, g_mult=g_mult, t_mult=t_mult
-        ),
-        lp_steps=lp_steps,
+    padded = pad_kernel_args(
+        vectors, counts, capacity, total, prices, g_mult=g_mult, t_mult=t_mult
     )
+    if jax.process_count() > 1:
+        # Multi-host slice: every process must dispatch the same program
+        # (SPMD) — replicate this solve to the followers first.
+        from karpenter_tpu.parallel import spmd
+
+        return spmd.lead_dispatch(kernel, padded, lp_steps)
+    return kernel(*padded, lp_steps=lp_steps)
 
 
 def cost_solve_finish(

@@ -99,8 +99,9 @@ def init_distributed(environ=None) -> bool:
             process_id=config.process_id,
         )
     init_distributed._initialized = True
+    # Read rank/size back from jax: on the auto path there is no config.
     log.info(
         "multi-host runtime up: process %d/%d, %d global devices",
-        config.process_id, config.num_processes, jax.device_count(),
+        jax.process_index(), jax.process_count(), jax.device_count(),
     )
     return True

@@ -1,0 +1,139 @@
+"""SPMD work distribution for the multi-host solver.
+
+Multi-process JAX is single-program-multiple-data: a computation over a
+global mesh must be dispatched by EVERY process, or the first collective
+deadlocks. Only rank 0 receives solve RPCs (the chart pins the Service to
+pod 0), so each solve is replicated to the slice through this module:
+
+  rank 0   lead_dispatch(): broadcast a fixed-shape header
+           [op, G, T, lp_steps], then the padded operand arrays, then run
+           the mesh-sharded fused kernel — the same call every follower
+           makes.
+  rank >0  follower_loop(): block on the next header broadcast, rebuild the
+           operand shapes from it, receive the arrays, run the SAME kernel,
+           and wait for the next header. An OP_STOP header exits the loop
+           (lead_stop() on clean shutdown; a dead coordinator surfaces as a
+           collective error, which also exits).
+
+Broadcasts ride jax.experimental.multihost_utils.broadcast_one_to_all —
+XLA collectives over ICI/DCN, the same fabric as the solve itself; there is
+no side-channel RPC layer to operate. Solves are serialized under a lock on
+rank 0 because collectives must be issued in the same order on every
+process.
+
+Ref: SURVEY.md §5 — "a distributed communication backend (XLA collectives
+over ICI/DCN) that scales to multi-host the way the reference's NCCL/MPI
+backend does". The reference distributes work by running many independent
+EC2 calls; this framework's scale axis is one solve spanning many hosts.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from karpenter_tpu.utils import logging as klog
+
+log = klog.named("parallel.spmd")
+
+OP_STOP = 0
+OP_SOLVE = 1
+
+_LEAD_LOCK = threading.Lock()
+
+
+def _broadcast(value):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)
+
+
+def is_multiprocess() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def _broadcast_operands(padded):
+    """Broadcast the six padded kernel operands as ONE pytree collective
+    (the follower knows every shape from the header). Bool masks ride as
+    uint8 — collective backends are numeric."""
+    vectors, counts, capacity, total, valid, prices = padded
+    out = _broadcast(
+        (
+            np.asarray(vectors, np.float32),
+            np.asarray(counts, np.int32),
+            np.asarray(capacity, np.float32),
+            np.asarray(total, np.float32),
+            np.asarray(valid, np.uint8),
+            np.asarray(prices, np.float32),
+        )
+    )
+    vectors, counts, capacity, total, valid, prices = (
+        np.asarray(leaf) for leaf in out
+    )
+    return vectors, counts, capacity, total, valid.astype(bool), prices
+
+
+def lead_dispatch(kernel, padded, lp_steps: int):
+    """Rank 0: replicate one solve to every process, then dispatch it.
+    Returns the kernel's (async) outputs. Serialized — collective order
+    must match the follower loop's strictly sequential consumption."""
+    g_pad = int(padded[0].shape[0])
+    t_pad = int(padded[2].shape[0])
+    with _LEAD_LOCK:
+        _broadcast(np.array([OP_SOLVE, g_pad, t_pad, lp_steps], np.int32))
+        operands = _broadcast_operands(padded)
+        out = kernel(*operands, lp_steps=lp_steps)
+        # Hold the lock until device completion: the follower blocks on ITS
+        # kernel before the next header, so a second lead dispatch racing
+        # ahead would desynchronize the collective order.
+        import jax
+
+        jax.block_until_ready(out)
+    return out
+
+
+def lead_stop() -> None:
+    """Rank 0, clean shutdown: release every follower from its header wait."""
+    if not is_multiprocess():
+        return
+    with _LEAD_LOCK:
+        _broadcast(np.zeros(4, np.int32))
+
+
+def follower_loop() -> None:
+    """Ranks > 0: mirror every lead dispatch until OP_STOP."""
+    import jax
+
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.ops import pallas_kernels
+
+    # Probe before the first trace, exactly like the lead's dispatch path —
+    # the traced program must be identical on every process.
+    pallas_kernels.ensure_probed()
+    from karpenter_tpu.models.solver import _sharded_fused_kernel
+
+    dims = wellknown.NUM_RESOURCE_DIMS
+    log.info(
+        "SPMD follower %d/%d up (%d global devices)",
+        jax.process_index(), jax.process_count(), jax.device_count(),
+    )
+    while True:
+        header = np.asarray(_broadcast(np.zeros(4, np.int32)))
+        op, g_pad, t_pad, lp_steps = (int(x) for x in header)
+        if op == OP_STOP:
+            log.info("SPMD follower %d stopping", jax.process_index())
+            return
+        padded = (
+            np.zeros((g_pad, dims), np.float32),
+            np.zeros(g_pad, np.int32),
+            np.zeros((t_pad, dims), np.float32),
+            np.zeros((t_pad, dims), np.float32),
+            np.zeros(t_pad, bool),
+            np.zeros(t_pad, np.float32),
+        )
+        operands = _broadcast_operands(padded)
+        kernel, _ = _sharded_fused_kernel()
+        jax.block_until_ready(kernel(*operands, lp_steps=lp_steps))

@@ -350,16 +350,44 @@ def main(argv=None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--workers", type=int, default=4)
     args = parser.parse_args(argv)
-    # Multi-host slice (KARPENTER_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID):
-    # join the jax.distributed runtime BEFORE the first device touch, so
-    # jax.devices() is the global set and cost_solve_dispatch auto-selects
-    # the mesh-sharded kernel spanning every host's chips.
+    # Multi-host slice (KARPENTER_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID or
+    # KARPENTER_MULTIHOST=auto): join the jax.distributed runtime BEFORE the
+    # first device touch, so jax.devices() is the global set and
+    # cost_solve_dispatch auto-selects the mesh-sharded kernel spanning
+    # every host's chips. Rank 0 serves RPCs and replicates each solve to
+    # the slice; other ranks mirror dispatches in the SPMD follower loop
+    # (parallel/spmd.py) — multi-process JAX requires every process to
+    # launch the same computation.
     from karpenter_tpu.parallel.multihost import init_distributed
 
-    init_distributed()
+    distributed = init_distributed()
+    if distributed:
+        import jax
+
+        if jax.process_index() > 0:
+            from karpenter_tpu.parallel import spmd
+
+            spmd.follower_loop()
+            return
     server = SolverServer(port=args.port, host=args.host, workers=args.workers)
     server.start()
-    server.wait()
+    # Terminate on SIGTERM (Kubernetes pod shutdown) as well as SIGINT, so
+    # the finally block actually runs under a rollout and the followers get
+    # their OP_STOP instead of timing out in a dead collective.
+    import signal
+    import threading
+
+    stop = threading.Event()
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, lambda *_: stop.set())
+    try:
+        stop.wait()
+        server.stop(grace=5.0)
+    finally:
+        if distributed:
+            from karpenter_tpu.parallel import spmd
+
+            spmd.lead_stop()
 
 
 if __name__ == "__main__":

@@ -144,6 +144,30 @@ class TestChart:
         )
         assert "tlsSecretName" in values["webhook"]
 
+    def test_multihost_statefulset_matches_env_contract(self):
+        """The multi-host solver StatefulSet must set exactly the env vars
+        parallel/multihost.py consumes, pin the RPC Service to rank 0, and
+        provide the headless rendezvous Service."""
+        templates = ROOT / "deploy/chart/karpenter-tpu/templates"
+        solver = (templates / "solver-deployment.yaml").read_text()
+        for var in (
+            "KARPENTER_PROCESS_ID",
+            "KARPENTER_NUM_PROCESSES",
+            "KARPENTER_COORDINATOR",
+        ):
+            assert var in solver, f"solver template missing {var}"
+        assert "kind: StatefulSet" in solver
+        assert "podManagementPolicy: Parallel" in solver
+        assert "clusterIP: None" in solver  # headless peers service
+        assert "statefulset.kubernetes.io/pod-name" in solver  # rank-0 pin
+        values = yaml.safe_load(
+            (ROOT / "deploy/chart/karpenter-tpu/values.yaml").read_text()
+        )
+        multihost = values["solver"]["multihost"]
+        assert multihost["enabled"] is False  # default stays single-host
+        assert multihost["hosts"] >= 2
+        assert multihost["coordinatorPort"]
+
     def test_templates_reference_real_entrypoints(self):
         templates = ROOT / "deploy/chart/karpenter-tpu/templates"
         text = "".join(p.read_text() for p in templates.glob("*.yaml"))
