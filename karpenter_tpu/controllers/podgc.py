@@ -1,0 +1,57 @@
+"""Orphaned-pod garbage collection.
+
+Ref: the reference leans on kube-controller-manager's podgc
+(`gcOrphaned`) to delete pods bound to nodes that no longer exist — a bind
+can land on a node concurrently being drained+deleted (the provisioner's
+bind fan-out racing the termination controller), and nothing else ever
+revisits such a pod: its node key no longer reconciles and the pod itself
+is not unschedulable. Since this framework replaces the surrounding
+cluster, it must carry the reaper itself.
+
+Deletion requires TWO consecutive sightings of the same orphan (one sweep
+interval apart): a single observation can be a transient watch-ordering
+window where the pod's binding event arrived before the node's ADDED event.
+"""
+
+from __future__ import annotations
+
+from typing import Set, Tuple
+
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.utils import logging as klog
+
+log = klog.named("podgc")
+
+SWEEP_SECONDS = 10.0
+
+
+class PodGcController:
+    """Periodic sweep (Manager drives it like the metrics poll): delete
+    bound, non-terminating pods whose node vanished."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self._suspects: Set[Tuple[str, str]] = set()
+
+    def reconcile(self, _key=None) -> float:
+        node_names = {node.name for node in self.cluster.list_nodes()}
+        orphans: Set[Tuple[str, str]] = set()
+        for pod in self.cluster.list_pods():
+            if (
+                pod.node_name is not None
+                and pod.deletion_timestamp is None
+                and pod.node_name not in node_names
+            ):
+                orphans.add((pod.namespace, pod.name))
+        deleted: Set[Tuple[str, str]] = set()
+        for key in orphans & self._suspects:  # second consecutive sighting
+            namespace, name = key
+            try:
+                self.cluster.delete_pod(namespace, name)
+                deleted.add(key)
+                log.info("deleted orphaned pod %s/%s (node gone)", namespace, name)
+            except Exception:  # noqa: BLE001 — transient failure or raced
+                # deletion: STAY a suspect so the very next sweep retries.
+                log.debug("orphan %s/%s delete failed; retrying", namespace, name)
+        self._suspects = orphans - deleted
+        return SWEEP_SECONDS

@@ -22,7 +22,7 @@ from karpenter_tpu.api.requirements import Requirement, Requirements
 from karpenter_tpu.api.validation import default_provisioner, validate_provisioner
 from karpenter_tpu.cloudprovider import CloudProvider, NodeSpec
 from karpenter_tpu.api.taints import Taint
-from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.cluster import Cluster, NotFoundError
 from karpenter_tpu.controllers.scheduling import Scheduler
 from karpenter_tpu.models.solver import GreedySolver, Solver
 from karpenter_tpu.ops.ffd import PackResult
@@ -289,6 +289,14 @@ class ProvisionerWorker:
             )
             stats.launch_errors.extend(errors)
 
+    @staticmethod
+    def _pod_vanished(error: BaseException) -> bool:
+        """Both backends' is-not-found: the in-memory store raises
+        NotFoundError; the apiserver write-through raises ApiError 404."""
+        if isinstance(error, NotFoundError):
+            return True
+        return getattr(error, "status", None) == 404
+
     def _register_and_bind(self, node: NodeSpec, pods: Sequence[PodSpec]):
         """Create the node object (not-ready taint + termination finalizer +
         constraint labels) then bind its pods (ref: provisioner.go:209-250)."""
@@ -307,7 +315,16 @@ class ProvisionerWorker:
         def bind(pod: PodSpec) -> None:
             try:
                 self.cluster.bind_pod(pod, node)
-            except Exception:  # noqa: BLE001
+            except Exception as error:  # noqa: BLE001
+                if self._pod_vanished(error):
+                    # The pod was deleted between batch collection and this
+                    # bind RPC — expected under churn, nothing to retry
+                    # (controller-runtime's IgnoreNotFound contract).
+                    klog.named("provisioning").debug(
+                        "pod %s/%s vanished before bind to %s",
+                        pod.namespace, pod.name, node.name,
+                    )
+                    return
                 klog.named("provisioning").exception(
                     "failed to bind %s/%s to %s", pod.namespace, pod.name, node.name
                 )

@@ -21,6 +21,7 @@ both (tests/test_backend_parity.py).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from karpenter_tpu.api.pods import PodSpec
@@ -57,10 +58,21 @@ class ApiServerCluster(Cluster):
         ("daemonset", DAEMONSETS),
     )
 
+    # How long a deletion tombstone suppresses late events for its key.
+    # Must exceed any plausible delivery delay of an in-flight stale event
+    # (watch replays after reconnects); pruned opportunistically on delete.
+    TOMBSTONE_TTL_S = 120.0
+
     def __init__(self, client: KubeClient, clock: Optional[Clock] = None):
         super().__init__(clock)
         self.api = client
         self._rv: Dict[Tuple[str, object], int] = {}
+        # Deletion tombstones: key -> (deletion rv, monotonic stamp). A
+        # deleted key's rv entry can't just be popped — a stale MODIFIED
+        # replayed after the DELETED event would pass _newer and resurrect
+        # the object in the cache (the client-go informer solves this with
+        # DeletedFinalStateUnknown tombstones).
+        self._tombstones: Dict[Tuple[str, object], Tuple[int, float]] = {}
         self._rv_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
@@ -139,7 +151,9 @@ class ApiServerCluster(Cluster):
                 # is not a ghost — leave it for the resumed watch to confirm.
                 if list_rv and self._rv.get((kind, key), 0) > list_rv:
                     continue
-                self._rv.pop((kind, key), None)
+                # Tombstone at the LIST's rv: any event predating the LIST
+                # is a stale replay of this vanished object.
+                self._entomb_locked((kind, key), list_rv)
                 if kind == "pod":
                     namespace, name = key
                     ghost = {"metadata": {"namespace": namespace, "name": name}}
@@ -170,7 +184,9 @@ class ApiServerCluster(Cluster):
         """resourceVersion gate: a watch event at-or-below what write-through
         already put in the cache is an echo of our own write — skipping it
         keeps cached object INSTANCES stable (controllers and tests hold
-        references), while genuinely external changes (higher rv) re-sync."""
+        references), while genuinely external changes (higher rv) re-sync.
+        Events at-or-below a deletion tombstone are stale replays of a dead
+        object and must not resurrect it."""
         metadata = obj.get("metadata") or {}
         try:
             rv = int(metadata.get("resourceVersion", 0))
@@ -181,19 +197,55 @@ class ApiServerCluster(Cluster):
         # the bind fan-out) race on this dict; unlocked, an older event could
         # be applied after a newer one.
         with self._rv_lock:
+            tombstone = self._tombstones.get(key)
+            if tombstone is not None:
+                if rv <= tombstone[0]:
+                    return False
+                self._tombstones.pop(key, None)  # genuine re-creation
             if rv <= self._rv.get(key, 0):
                 return False
             self._rv[key] = rv
         return True
 
+    def _entomb_locked(self, key, rv: int) -> None:
+        """Record a deletion tombstone (caller holds _rv_lock). The rv map
+        entry goes with the object (pod churn must not leak an entry per pod
+        ever observed); the tombstone carries the deletion rv forward for
+        TOMBSTONE_TTL_S so late replays can't resurrect the object, and the
+        TTL bounds the tombstone map the same way popping bounded _rv.
+
+        Prune cost: insertion order IS stamp order (appended with a fresh
+        monotonic stamp), so expiry pops from the front and stops at the
+        first live entry — O(expired) per delete, never a full scan."""
+        now = time.monotonic()
+        self._rv.pop(key, None)
+        cutoff = now - self.TOMBSTONE_TTL_S
+        while self._tombstones:
+            oldest = next(iter(self._tombstones))
+            if self._tombstones[oldest][1] >= cutoff:
+                break
+            del self._tombstones[oldest]
+        # Re-entombing an existing key must keep stamp order: drop the old
+        # slot so the new entry appends at the back.
+        self._tombstones.pop(key, None)
+        self._tombstones[key] = (rv, now)
+
     def _on_watch(self, kind: str, event_type: str, obj: dict) -> None:
         try:
             if event_type == "DELETED":
                 self._remove_local(kind, obj)
-                # Drop the rv entry with the object, or pod churn leaks one
-                # dict entry per pod ever observed.
+                key = (kind, self._key(kind, obj))
+                metadata = obj.get("metadata") or {}
+                try:
+                    delete_rv = int(metadata.get("resourceVersion", 0))
+                except (TypeError, ValueError):
+                    delete_rv = 0
                 with self._rv_lock:
-                    self._rv.pop((kind, self._key(kind, obj)), None)
+                    # The DELETED event's rv is >= every prior event of the
+                    # object; fall back to the last rv we applied.
+                    self._entomb_locked(
+                        key, max(delete_rv, self._rv.get(key, 0))
+                    )
             elif self._newer(kind, obj):
                 self._apply_remote(kind, obj)
         except Exception:  # noqa: BLE001 — one bad event must not kill the pump

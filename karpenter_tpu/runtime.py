@@ -19,6 +19,7 @@ from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.metrics import MetricsController, POLL_SECONDS
 from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.podgc import PodGcController
 from karpenter_tpu.controllers.provisioning import (
     BATCH_IDLE_SECONDS,
     ProvisioningController,
@@ -298,6 +299,7 @@ class Manager:
         self.node = NodeController(cluster)
         self.counter = CounterController(cluster)
         self.metrics = MetricsController(cluster)
+        self.podgc = PodGcController(cluster)
         self.ready = threading.Event()
         self._stop = threading.Event()
 
@@ -327,6 +329,11 @@ class Manager:
             ),
             "metrics": ReconcileLoop(
                 "metrics", self.metrics.reconcile, concurrency=1
+            ),
+            # Orphaned-pod reaper (kube-controller-manager podgc analogue):
+            # a periodic self-requeuing sweep, like the metrics poll.
+            "podgc": ReconcileLoop(
+                "podgc", self.podgc.reconcile, concurrency=1
             ),
         }
 
@@ -386,6 +393,7 @@ class Manager:
             self.loops["selection"].enqueue((pod.namespace, pod.name))
         for node in self.cluster.list_nodes():
             self.loops["node"].enqueue(node.name)
+        self.loops["podgc"].enqueue("sweep")
         self.ready.set()
 
     def stop(self) -> None:

@@ -263,6 +263,35 @@ class TestWatchResync:
             "advance from BOOKMARK events"
         )
 
+    def test_stale_replay_cannot_resurrect_deleted_object(self, backend):
+        """Deletion tombstones: after a DELETED event, a stale MODIFIED of
+        the same object replayed with an older rv (reconnect replay overlap)
+        must NOT re-add it to the cache — the client-go informer's
+        tombstone contract. Found by the battletest: popping the rv entry on
+        delete let late replays resurrect dead pods."""
+        server, cluster = backend
+        cluster.apply_pod(PodSpec(name="lazarus", unschedulable=True))
+        assert wait_until(lambda: cluster.try_get_pod("default", "lazarus"))
+        live = server.get_object("pods", "default", "lazarus")
+        stale_copy = {
+            "metadata": dict(live["metadata"]),
+            "spec": dict(live.get("spec") or {}),
+        }
+        server.handle("DELETE", "/api/v1/namespaces/default/pods/lazarus")
+        assert wait_until(
+            lambda: cluster.try_get_pod("default", "lazarus") is None
+        )
+        # A stale event with the pre-deletion rv arrives late (as a replayed
+        # watch window would deliver it).
+        cluster._on_watch("pod", "MODIFIED", stale_copy)
+        time.sleep(0.2)
+        assert cluster.try_get_pod("default", "lazarus") is None, (
+            "stale replay resurrected a deleted pod (tombstone missing)"
+        )
+        # A genuine re-creation (fresh, higher rv) still works.
+        cluster.apply_pod(PodSpec(name="lazarus", unschedulable=True))
+        assert wait_until(lambda: cluster.try_get_pod("default", "lazarus"))
+
     def test_410_recovery_over_http(self):
         """Same wedge over the real HTTP wire path."""
         from karpenter_tpu.kubeapi.client import HttpTransport

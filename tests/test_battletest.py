@@ -39,7 +39,10 @@ pytestmark = pytest.mark.skipif(
     reason="battletest: run via `make battletest` (KARPENTER_BATTLETEST=1)",
 )
 
-DURATION_S = float(os.environ.get("KARPENTER_BATTLETEST_SECONDS", "6"))
+# 15s default: the 6s run never surfaced the stale-replay resurrection,
+# bind-404, or orphaned-pod classes that a 30s soak caught — churn volume
+# matters. KARPENTER_BATTLETEST_SECONDS raises it further for soaks.
+DURATION_S = float(os.environ.get("KARPENTER_BATTLETEST_SECONDS", "15"))
 SEED = int(os.environ.get("KARPENTER_BATTLETEST_SEED", str(int(time.time()))))
 
 
@@ -140,12 +143,23 @@ class TestBattletest:
                 churn_once()
                 time.sleep(rng.uniform(0.0, 0.004))
 
-            # --- quiesce: every surviving unschedulable pod gets a node ----
+            # --- quiesce: every surviving unschedulable pod gets a node,
+            # and every orphan (bound to a node deleted mid-bind) is reaped
+            # by the podgc sweep (two sightings, 10s apart) ------------------
             def unbound():
                 return [
                     p for p in cluster.list_pods()
                     if p.unschedulable and p.node_name is None
                     and p.deletion_timestamp is None
+                ]
+
+            def orphaned():
+                node_names = {n.name for n in cluster.list_nodes()}
+                return [
+                    p for p in cluster.list_pods()
+                    if p.node_name is not None
+                    and p.deletion_timestamp is None
+                    and p.node_name not in node_names
                 ]
 
             quiesce_deadline = time.monotonic() + 60.0
@@ -158,13 +172,18 @@ class TestBattletest:
                             cluster.update_node(node)
                         except ApiError:
                             pass
-                if not unbound():
+                if not unbound() and not orphaned():
                     break
                 time.sleep(0.05)
             remaining = unbound()
             assert not remaining, (
                 f"seed {SEED}: {len(remaining)} pods never scheduled, e.g. "
                 f"{[p.name for p in remaining[:5]]}"
+            )
+            still_orphaned = orphaned()
+            assert not still_orphaned, (
+                f"seed {SEED}: {len(still_orphaned)} orphaned pods survived "
+                f"podgc, e.g. {[p.name for p in still_orphaned[:5]]}"
             )
 
             # --- conservation invariants (tests/test_replay.py) ------------
@@ -184,16 +203,18 @@ class TestBattletest:
             # --- informer cache coheres with the apiserver store -----------
             # (the watch plane took drops and 410 compactions mid-churn; a
             # wedged or stale cache shows up as a set difference here)
+            # Both sides sampled with the SAME membership rule (terminating
+            # objects included — evicted pods stay terminating forever in
+            # the fake, which has no kubelet to reap them, and the cache
+            # must mirror that state too).
             def stable_names(kind, lister):
                 while True:
                     live = {o["metadata"]["name"]
-                            for o in apiserver._collection(kind).values()
-                            if not o["metadata"].get("deletionTimestamp")}
+                            for o in apiserver._collection(kind).values()}
                     time.sleep(0.3)
                     cached = {obj.name for obj in lister()}
                     again = {o["metadata"]["name"]
-                             for o in apiserver._collection(kind).values()
-                             if not o["metadata"].get("deletionTimestamp")}
+                             for o in apiserver._collection(kind).values()}
                     if live == again:  # store quiet between samples
                         return live, cached
 
@@ -221,7 +242,9 @@ class TestBattletest:
                     )
             shutdown_s = time.monotonic() - stop_started
             assert shutdown_s < 10.0, f"shutdown took {shutdown_s:.1f}s"
+            # NOTE: shutdown checks run in finally, so reaching here does not
+            # mean the churn assertions passed — only pytest's verdict does.
             print(
-                f"battletest OK: seed={SEED} pods={counter[0]} "
+                f"battletest shutdown clean: seed={SEED} pods={counter[0]} "
                 f"shutdown={shutdown_s:.2f}s"
             )

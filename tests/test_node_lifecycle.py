@@ -210,3 +210,68 @@ class TestMetrics:
         h.reconcile_terminations()
         h.metrics.reconcile("default")
         assert READY_NODE_COUNT.get("default", zone) == 0
+
+
+class TestPodGc:
+    """Orphaned-pod reaper (kube-controller-manager podgc analogue,
+    controllers/podgc.py): pods bound to vanished nodes are deleted — but
+    only on a second consecutive sighting, so a transient watch-ordering
+    window never costs a live pod."""
+
+    def test_orphan_deleted_on_second_sighting_only(self):
+        from karpenter_tpu.controllers.podgc import PodGcController
+        from tests.harness import Harness
+        from tests import fixtures
+
+        h = Harness()
+        gc = PodGcController(h.cluster)
+        pod = fixtures.pod(name="orphan")
+        h.cluster.apply_pod(pod)
+        live = h.cluster.get_pod(pod.namespace, pod.name)
+        live.node_name = "gone-node"  # bound to a node that never existed
+        live.unschedulable = False
+        gc.reconcile()  # first sighting: suspect only
+        assert h.cluster.try_get_pod(pod.namespace, pod.name) is not None
+        gc.reconcile()  # second consecutive sighting: reaped
+        assert h.cluster.try_get_pod(pod.namespace, pod.name) is None
+
+    def test_transient_orphan_survives(self):
+        from karpenter_tpu.cloudprovider import NodeSpec
+        from karpenter_tpu.controllers.podgc import PodGcController
+        from tests.harness import Harness
+        from tests import fixtures
+
+        h = Harness()
+        gc = PodGcController(h.cluster)
+        pod = fixtures.pod(name="transient")
+        h.cluster.apply_pod(pod)
+        live = h.cluster.get_pod(pod.namespace, pod.name)
+        live.node_name = "late-node"
+        gc.reconcile()  # sighting 1: the node's ADDED event hasn't landed yet
+        h.cluster.create_node(NodeSpec(name="late-node"))  # now it has
+        gc.reconcile()  # orphan healed: not deleted, suspicion cleared
+        assert h.cluster.try_get_pod(pod.namespace, pod.name) is not None
+
+    def test_bound_and_terminating_pods_untouched(self):
+        from karpenter_tpu.cloudprovider import NodeSpec
+        from karpenter_tpu.controllers.podgc import PodGcController
+        from tests.harness import Harness
+        from tests import fixtures
+
+        h = Harness()
+        gc = PodGcController(h.cluster)
+        h.cluster.create_node(NodeSpec(name="n1"))
+        bound = fixtures.pod(name="bound")
+        h.cluster.apply_pod(bound)
+        h.cluster.get_pod(bound.namespace, bound.name).node_name = "n1"
+        terminating = fixtures.pod(name="terminating")
+        h.cluster.apply_pod(terminating)
+        dying = h.cluster.get_pod(terminating.namespace, terminating.name)
+        dying.node_name = "gone"
+        dying.deletion_timestamp = h.clock.now()
+        gc.reconcile()
+        gc.reconcile()
+        assert h.cluster.try_get_pod(bound.namespace, bound.name) is not None
+        assert h.cluster.try_get_pod(
+            terminating.namespace, terminating.name
+        ) is not None
