@@ -31,21 +31,26 @@ class PodGcController:
 
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
-        self._suspects: Set[Tuple[str, str]] = set()
+        # Keyed by (namespace, name, uid): a name reused by a NEW pod
+        # incarnation must restart the two-sighting clock — matching a new
+        # pod against an old incarnation's suspicion would delete a live
+        # pod on its first sighting (kube-controller-manager's gcOrphaned
+        # likewise operates on UIDs).
+        self._suspects: Set[Tuple[str, str, str]] = set()
 
     def reconcile(self, _key=None) -> float:
         node_names = {node.name for node in self.cluster.list_nodes()}
-        orphans: Set[Tuple[str, str]] = set()
+        orphans: Set[Tuple[str, str, str]] = set()
         for pod in self.cluster.list_pods():
             if (
                 pod.node_name is not None
                 and pod.deletion_timestamp is None
                 and pod.node_name not in node_names
             ):
-                orphans.add((pod.namespace, pod.name))
-        deleted: Set[Tuple[str, str]] = set()
+                orphans.add((pod.namespace, pod.name, getattr(pod, "uid", "") or ""))
+        deleted: Set[Tuple[str, str, str]] = set()
         for key in orphans & self._suspects:  # second consecutive sighting
-            namespace, name = key
+            namespace, name, _uid = key
             try:
                 self.cluster.delete_pod(namespace, name)
                 deleted.add(key)

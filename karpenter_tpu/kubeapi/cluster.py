@@ -225,15 +225,18 @@ class ApiServerCluster(Cluster):
             if self._tombstones[oldest][1] >= cutoff:
                 break
             del self._tombstones[oldest]
-        # Re-entombing an existing key must keep stamp order: drop the old
-        # slot so the new entry appends at the back.
-        self._tombstones.pop(key, None)
+        # Re-entombing an existing key must keep stamp order (drop the old
+        # slot so the new entry appends at the back) and must NEVER lower
+        # the rv — a stale replayed DELETED of an older incarnation would
+        # otherwise reopen the gate for stale events of a newer one.
+        old = self._tombstones.pop(key, None)
+        if old is not None and old[0] > rv:
+            rv = old[0]
         self._tombstones[key] = (rv, now)
 
     def _on_watch(self, kind: str, event_type: str, obj: dict) -> None:
         try:
             if event_type == "DELETED":
-                self._remove_local(kind, obj)
                 key = (kind, self._key(kind, obj))
                 metadata = obj.get("metadata") or {}
                 try:
@@ -241,11 +244,25 @@ class ApiServerCluster(Cluster):
                 except (TypeError, ValueError):
                     delete_rv = 0
                 with self._rv_lock:
+                    # DELETED needs the same staleness gate as every other
+                    # event: a replayed DELETED of a PRIOR incarnation must
+                    # not evict a live re-created object (cache rv newer)
+                    # nor lower an existing tombstone.
+                    tombstone = self._tombstones.get(key)
+                    if (
+                        delete_rv
+                        and tombstone is not None
+                        and delete_rv <= tombstone[0]
+                    ):
+                        return  # replay of a deletion already tombstoned
+                    if delete_rv and delete_rv < self._rv.get(key, 0):
+                        return  # the live object is a newer incarnation
                     # The DELETED event's rv is >= every prior event of the
                     # object; fall back to the last rv we applied.
                     self._entomb_locked(
                         key, max(delete_rv, self._rv.get(key, 0))
                     )
+                self._remove_local(kind, obj)
             elif self._newer(kind, obj):
                 self._apply_remote(kind, obj)
         except Exception:  # noqa: BLE001 — one bad event must not kill the pump

@@ -292,6 +292,27 @@ class TestWatchResync:
         cluster.apply_pod(PodSpec(name="lazarus", unschedulable=True))
         assert wait_until(lambda: cluster.try_get_pod("default", "lazarus"))
 
+    def test_stale_deleted_replay_cannot_evict_recreated_object(self, backend):
+        """The DELETED gate, mirror image of the tombstone test: pod created,
+        deleted, RE-created (higher rv) — a replayed DELETED of the first
+        incarnation must neither evict the live re-creation from the cache
+        nor lower the tombstone under it."""
+        server, cluster = backend
+        cluster.apply_pod(PodSpec(name="phoenix", unschedulable=True))
+        assert wait_until(lambda: cluster.try_get_pod("default", "phoenix"))
+        first = server.get_object("pods", "default", "phoenix")
+        stale_deleted = {"metadata": dict(first["metadata"])}
+        server.handle("DELETE", "/api/v1/namespaces/default/pods/phoenix")
+        assert wait_until(lambda: cluster.try_get_pod("default", "phoenix") is None)
+        cluster.apply_pod(PodSpec(name="phoenix", unschedulable=True))
+        assert wait_until(lambda: cluster.try_get_pod("default", "phoenix"))
+        # Late replay of the FIRST incarnation's deletion.
+        cluster._on_watch("pod", "DELETED", stale_deleted)
+        time.sleep(0.2)
+        assert cluster.try_get_pod("default", "phoenix") is not None, (
+            "stale replayed DELETED evicted a live re-created pod"
+        )
+
     def test_410_recovery_over_http(self):
         """Same wedge over the real HTTP wire path."""
         from karpenter_tpu.kubeapi.client import HttpTransport

@@ -89,10 +89,17 @@ class TestBattletest:
         def churn_once():
             roll = rng.random()
             if roll < 0.55:  # pod storm pressure
+                annotations = {}
+                if rng.random() < 0.05:
+                    # Drain blockers: the terminator must pause whole-node
+                    # drains behind these without wedging anything else.
+                    annotations[wellknown.DO_NOT_EVICT_ANNOTATION] = "true"
                 cluster.apply_pod(
                     PodSpec(
                         name=next_name("battle-pod"),
                         unschedulable=True,
+                        labels={"battle/app": f"app-{rng.randrange(4)}"},
+                        annotations=annotations,
                         requests={
                             "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
                             "memory": f"{rng.choice([128, 256, 512])}Mi",
@@ -125,10 +132,30 @@ class TestBattletest:
                         cluster.delete_node(rng.choice(nodes).name)
                     except ApiError:
                         pass
-            elif roll < 0.94:  # provisioner spec churn
+            elif roll < 0.92:  # provisioner spec churn
                 spec = ProvisionerSpec()
                 spec.labels = {"battle/epoch": next_name("epoch")}
                 cluster.apply_provisioner(Provisioner(name="battle", spec=spec))
+            elif roll < 0.94:  # PDBs gate evictions; daemonsets change
+                # per-node overhead mid-flight — both must hold up under
+                # concurrent solves and drains.
+                if rng.random() < 0.5:
+                    cluster.apply_pdb(
+                        f"battle-pdb-{rng.randrange(2)}",
+                        {"battle/app": f"app-{rng.randrange(4)}"},
+                        min_available=rng.randrange(3),
+                    )
+                else:
+                    cluster.apply_daemonset(
+                        f"battle-ds-{rng.randrange(2)}",
+                        PodSpec(
+                            name="battle-ds",
+                            requests={
+                                "cpu": f"{rng.choice([50, 100])}m",
+                                "memory": "64Mi",
+                            },
+                        ),
+                    )
             elif roll < 0.985:  # sever every watch stream mid-flight
                 apiserver.drop_watch_connections()
             else:  # compact history too: reconnects must take the 410 re-list
