@@ -271,7 +271,22 @@ class AwsHttpEc2Api(Ec2Api):
                 "utf-8", "replace"
             )
             raise ApiError(code, message)
-        return _strip_ns(ET.fromstring(response.body))
+        try:
+            root = _strip_ns(ET.fromstring(response.body))
+        except ET.ParseError as err:
+            # A 2xx with a non-XML body (misbehaving proxy) must still be a
+            # coded error for upstream classification, not a raw ParseError.
+            raise ApiError(
+                "MalformedResponse",
+                f"{err}: {response.body[:200].decode('utf-8', 'replace')}",
+            ) from None
+        if not root.tag.endswith("Response"):
+            # Well-formed XML that is not an EC2 envelope (an XHTML error
+            # page) would otherwise parse as an EMPTY result set.
+            raise ApiError(
+                "MalformedResponse", f"unexpected root element <{root.tag}>"
+            )
+        return root
 
     def _ec2_paginated(
         self, action: str, params: Mapping[str, str], item_path: str
@@ -302,10 +317,18 @@ class AwsHttpEc2Api(Ec2Api):
         try:
             data = json.loads(response.body or b"{}")
         except ValueError:
-            data = {}
+            data = None
         if response.status >= 300:
+            data = data if isinstance(data, dict) else {}
             code = str(data.get("__type", f"HTTP{response.status}")).split("#")[-1]
             raise ApiError(code, str(data.get("message", data.get("Message", ""))))
+        if not isinstance(data, dict):
+            # 2xx with a non-JSON body: a transient proxy glitch must not be
+            # coerced into {} and misread as ParameterNotFound downstream.
+            raise ApiError(
+                "MalformedResponse",
+                response.body[:200].decode("utf-8", "replace"),
+            )
         return data
 
     # --- discovery ----------------------------------------------------------

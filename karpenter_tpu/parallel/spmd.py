@@ -78,8 +78,12 @@ def _broadcast_operands(padded):
 
 def lead_dispatch(kernel, padded, lp_steps: int):
     """Rank 0: replicate one solve to every process, then dispatch it.
-    Returns the kernel's (async) outputs. Serialized — collective order
-    must match the follower loop's strictly sequential consumption."""
+    Returns the kernel's outputs, ALREADY device-complete (unlike the
+    single-host path's async dispatch): the lock must cover execution so a
+    concurrent second solve can't desynchronize collective order, which
+    means multi-host solves serialize and the batch path's one-fetch
+    amortization degrades to per-solve round trips — acceptable, since a
+    pod slice's solve throughput dwarfs any realistic schedule rate."""
     g_pad = int(padded[0].shape[0])
     t_pad = int(padded[2].shape[0])
     with _LEAD_LOCK:

@@ -224,6 +224,37 @@ class TestErrorMapping:
         assert err.value.code == "ParameterNotFound"
         assert is_not_found(err.value)
 
+    def test_garbage_2xx_body_maps_to_coded_error(self):
+        """A misbehaving proxy can 200 with an HTML body; the binding must
+        raise a coded ApiError, never a bare XML ParseError."""
+        api = recorded_api(HttpResponse(200, b"<html>gateway says hi</html "))
+        with pytest.raises(ApiError) as err:
+            api.describe_instances(["i-1"])
+        assert err.value.code == "MalformedResponse"
+
+    def test_5xx_html_body_maps_to_coded_error(self):
+        api = recorded_api(HttpResponse(503, b"<html>Service Unavailable"))
+        with pytest.raises(ApiError) as err:
+            api.describe_instances(["i-1"])
+        assert err.value.code == "HTTP503"
+
+    def test_well_formed_non_ec2_xml_is_malformed_not_empty(self):
+        """An XHTML error page parses as XML; it must not read as an empty
+        EC2 result set (callers would conclude live instances vanished)."""
+        api = recorded_api(
+            HttpResponse(200, b"<html><body>Bad Gateway</body></html>")
+        )
+        with pytest.raises(ApiError) as err:
+            api.describe_instances(["i-1"])
+        assert err.value.code == "MalformedResponse"
+
+    def test_ssm_garbage_2xx_is_malformed_not_parameter_not_found(self):
+        api = recorded_api(HttpResponse(200, b"<html>gateway</html>"))
+        with pytest.raises(ApiError) as err:
+            api.get_ami_parameter("/aws/service/x")
+        assert err.value.code == "MalformedResponse"
+        assert not is_not_found(err.value)
+
     def test_ssm_parameter_value_parsed(self):
         api = recorded_api(
             HttpResponse(
