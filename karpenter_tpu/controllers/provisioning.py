@@ -137,6 +137,14 @@ class ProvisionerWorker:
         self.solver = solver or GreedySolver()
         self.scheduler = Scheduler(cluster)
         self._pending: List[PodSpec] = []
+        # Pods beyond the batch cap wait HERE, not in the selection queue: a
+        # 50k-pod storm would otherwise need every overflowed pod
+        # re-reconciled (1 Hz re-verify) to refill each 2000-pod batch —
+        # measured at ~15s of GIL-bound queue mechanics per batch. The
+        # reference survives that shape with 10k network-parked reconciles
+        # (selection/controller.go:166); this runtime holds the backlog in
+        # the worker and refills the window directly at each drain.
+        self._overflow: List[PodSpec] = []
         self._pending_uids: set = set()
         self._lock = threading.Lock()
         self._first_add: Optional[float] = None
@@ -145,18 +153,31 @@ class ProvisionerWorker:
 
     # --- batching (ref: provisioner.go:137-163) -----------------------------
 
-    def add(self, pod: PodSpec) -> bool:
+    def add(self, pod: PodSpec) -> None:
+        """Accept a pod unconditionally: into the open batch window, or the
+        overflow backlog once the window is full."""
         with self._lock:
-            if len(self._pending) >= MAX_PODS_PER_BATCH:
-                return False
             if pod.uid not in self._pending_uids:
-                self._pending.append(pod)
+                if len(self._pending) >= MAX_PODS_PER_BATCH:
+                    self._overflow.append(pod)
+                else:
+                    self._pending.append(pod)
                 self._pending_uids.add(pod.uid)
             now = self.cluster.clock.now()
             if self._first_add is None:
                 self._first_add = now
             self._last_add = now
-            return True
+
+    def take_backlog(self) -> List[PodSpec]:
+        """Drain EVERYTHING (batch + overflow) for hand-off to a replacement
+        worker on spec-hash hot-swap."""
+        with self._lock:
+            backlog = self._pending + self._overflow
+            self._pending = []
+            self._overflow = []
+            self._pending_uids = set()
+            self._first_add = self._last_add = None
+        return backlog
 
     def batch_ready(self) -> bool:
         """Window closed: 1s since last add or 10s since first, or full."""
@@ -173,9 +194,20 @@ class ProvisionerWorker:
 
     def _drain(self) -> List[PodSpec]:
         with self._lock:
-            batch, self._pending = self._pending, []
-            self._pending_uids = set()
-            self._first_add = self._last_add = None
+            batch = self._pending
+            # Refill the next window straight from the overflow backlog —
+            # its pods already waited a full window, so the next batch
+            # starts its clock now rather than waiting for re-verifies.
+            self._pending = self._overflow[:MAX_PODS_PER_BATCH]
+            self._overflow = self._overflow[MAX_PODS_PER_BATCH:]
+            self._pending_uids = {p.uid for p in self._pending} | {
+                p.uid for p in self._overflow
+            }
+            if self._pending:
+                now = self.cluster.clock.now()
+                self._first_add = self._last_add = now
+            else:
+                self._first_add = self._last_add = None
         return batch
 
     # --- the provisioning pass (ref: provisioner.go:102-135) ----------------
@@ -333,7 +365,21 @@ class ProvisionerWorker:
             for pod in pods:
                 bind(pod)
             return
-        list(_bind_executor().map(bind, pods))
+        futures = []
+        for index, pod in enumerate(pods):
+            try:
+                futures.append(_bind_executor().submit(bind, pod))
+            except RuntimeError:
+                # Interpreter teardown: atexit shut the shared pool down
+                # while a daemon batch thread was mid-provision. Only the
+                # NOT-YET-SUBMITTED pods need the inline fallback — the
+                # already-submitted ones ran (or will run) on the pool, and
+                # re-binding them would double-bind.
+                for late in pods[index:]:
+                    bind(late)
+                break
+        for future in futures:
+            future.result()
 
 
 class ProvisioningController:
@@ -384,9 +430,18 @@ class ProvisioningController:
         new_hash = spec_hash(effective)
         if self._hashes.get(provisioner.name) != new_hash:
             self._hashes[provisioner.name] = new_hash
-            self.workers[provisioner.name] = ProvisionerWorker(
+            replacement = ProvisionerWorker(
                 effective, self.cluster, self.cloud, self.solver
             )
+            # Hand the old worker's accepted backlog (batch + overflow) to
+            # the replacement: mid-storm spec-hash flips (ICE blackouts
+            # changing effective offerings) must not dump tens of thousands
+            # of parked pods back onto the slow selection re-verify path.
+            old = self.workers.get(provisioner.name)
+            if old is not None:
+                for pod in old.take_backlog():
+                    replacement.add(pod)
+            self.workers[provisioner.name] = replacement
         else:
             self.workers[provisioner.name].provisioner = effective
         # A provisioner with a running worker is ready to scale — the Active

@@ -87,7 +87,13 @@ class Preferences:
 class SelectionController:
     """Ref: selection/controller.go:55-102."""
 
-    REQUEUE_SECONDS = 1.0  # re-verify after handing off (ref: :77)
+    REQUEUE_SECONDS = 1.0  # fresh attempt (relaxation advanced; ref: :77)
+    # Re-verify cadence for pods a worker has ACCEPTED (batched or in its
+    # overflow backlog): the worker owns delivery from here and watch events
+    # still pull the key forward immediately, so the safety re-verify can be
+    # slow — at 1 Hz a 50k-pod backlog burns the GIL on no-op reconciles
+    # (measured: ~15s of queue mechanics per 2000-pod batch).
+    ACCEPTED_REQUEUE_SECONDS = 5.0
     # Exponential backoff for pods no provisioner matches, mirroring
     # workqueue.DefaultControllerRateLimiter (5ms→1000s) that the reference
     # gets for free when it returns the match error. Our reconcile loop tick
@@ -117,13 +123,13 @@ class SelectionController:
         # (ref: preferences.go keeps relaxation in a UID-keyed TTL cache and
         # provisioner.go:172 deliberately batches the in-memory relaxed pod).
         relaxed = self.preferences.current(pod)
-        matched, _ = self._select_and_enqueue(relaxed)
+        matched = self._select_and_enqueue(relaxed)
         if matched:
-            # Enqueued (re-verify in 1s, ref: :77) — or the batch was full:
-            # retry without relaxing further (relaxation is only for genuine
-            # incompatibility; ref: preferences.go:50-63).
+            # Accepted by a worker (batch or overflow backlog): re-verify on
+            # the slow cadence; no further relaxation (relaxation is only
+            # for genuine incompatibility; ref: preferences.go:50-63).
             self._failures.delete(pod.uid)
-            return self.REQUEUE_SECONDS
+            return self.ACCEPTED_REQUEUE_SECONDS
         # No provisioner matched: relax one step if possible, then retry.
         # The retry happens EVEN when relaxation is exhausted — the reference
         # returns the match error so controller-runtime keeps requeueing
@@ -167,9 +173,10 @@ class SelectionController:
                         f"operator {requirement.operator!r} is not supported"
                     )
 
-    def _select_and_enqueue(self, pod: PodSpec):
+    def _select_and_enqueue(self, pod: PodSpec) -> bool:
         """First matching provisioner in alphabetical order wins
-        (ref: selectProvisioner:80-102). Returns (matched, enqueued)."""
+        (ref: selectProvisioner:80-102). True iff a worker accepted the pod
+        (workers accept unconditionally — batch window or overflow)."""
         for provisioner in self.cluster.list_provisioners():
             if provisioner.deletion_timestamp is not None:
                 continue
@@ -185,5 +192,6 @@ class SelectionController:
                 worker.provisioner.spec.constraints.validate_pod(pod)
             except PodIncompatibleError:
                 continue
-            return True, worker.add(pod)
-        return False, False
+            worker.add(pod)
+            return True
+        return False

@@ -307,10 +307,10 @@ class Manager:
         # MaxConcurrentReconciles=10,000 (selection/controller.go:166) where
         # each reconcile parks on network I/O; here selection reconciles the
         # informer cache (CPU-bound under the GIL) and the loop is keyed +
-        # collapse-deduped, so the envelope is picked from pod-storm data
-        # (bench.py bench_pod_storm: 10k-pod storm drain is flat from 4 to
-        # 128 threads — batching-window bound, so 8 threads keep up; see
-        # Options.selection_concurrency to raise it).
+        # collapse-deduped, with the batch overflow held by the worker —
+        # so the envelope is picked from pod-storm data (bench.py
+        # bench_pod_storm: 10k-pod drain is flat-to-worse from 8 to 128
+        # threads; 8 keeps up; see Options.selection_concurrency).
         self.loops = {
             "selection": ReconcileLoop(
                 "selection",

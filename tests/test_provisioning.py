@@ -279,3 +279,75 @@ class TestParallelBind:
         worker._register_and_bind(node, [*applied, ghost])
         for pod in applied:
             assert h.cluster.get_pod(pod.namespace, pod.name).node_name == node.name
+
+
+class TestBatchOverflow:
+    """Pods beyond MAX_PODS_PER_BATCH park in the worker's overflow backlog
+    (not the selection queue) and refill the next window at drain — the
+    mechanism that keeps a 50k-pod storm off the GIL-bound re-verify path."""
+
+    def _worker(self, h):
+        h.apply_provisioner(default_provisioner())
+        return h.provisioning.worker("default")
+
+    def test_overflow_accepted_and_refills_next_batch(self):
+        from karpenter_tpu.controllers.provisioning import MAX_PODS_PER_BATCH
+
+        h = Harness()
+        worker = self._worker(h)
+        total = MAX_PODS_PER_BATCH + 700
+        pods = fixtures.pods(total, cpu="100m", memory="64Mi")
+        for pod in pods:
+            h.cluster.apply_pod(pod)
+            worker.add(pod)
+        assert len(worker._pending) == MAX_PODS_PER_BATCH
+        assert len(worker._overflow) == 700
+        assert worker.batch_ready()  # full window closes immediately
+
+        first = worker._drain()
+        assert len(first) == MAX_PODS_PER_BATCH
+        # Overflow refilled the window and restarted its clock.
+        assert len(worker._pending) == 700
+        assert not worker._overflow
+        assert worker._first_add is not None
+        h.clock.advance(1.5)  # idle window elapses
+        assert worker.batch_ready()
+        second = worker._drain()
+        assert len(second) == 700
+        # Nothing lost, nothing duplicated across the two batches.
+        uids = [p.uid for p in first + second]
+        assert len(uids) == len(set(uids)) == total
+
+    def test_duplicate_adds_collapse_across_batch_and_overflow(self):
+        from karpenter_tpu.controllers.provisioning import MAX_PODS_PER_BATCH
+
+        h = Harness()
+        worker = self._worker(h)
+        pods = fixtures.pods(MAX_PODS_PER_BATCH + 5)
+        for pod in pods:
+            worker.add(pod)
+        for pod in pods:  # re-verify storm: every pod re-added
+            worker.add(pod)
+        assert len(worker._pending) == MAX_PODS_PER_BATCH
+        assert len(worker._overflow) == 5
+
+    def test_hot_swap_hands_backlog_to_replacement(self):
+        """A spec-hash flip mid-storm must not dump the parked backlog back
+        onto the slow selection re-verify path."""
+        from karpenter_tpu.controllers.provisioning import MAX_PODS_PER_BATCH
+
+        h = Harness()
+        provisioner = default_provisioner()
+        h.apply_provisioner(provisioner)
+        worker = h.provisioning.worker("default")
+        pods = fixtures.pods(MAX_PODS_PER_BATCH + 300)
+        for pod in pods:
+            worker.add(pod)
+        # Force a spec change -> new hash -> hot swap.
+        provisioner.spec.constraints.labels = {"swap/epoch": "two"}
+        h.apply_provisioner(provisioner)
+        replacement = h.provisioning.worker("default")
+        assert replacement is not worker
+        assert not worker._pending and not worker._overflow  # fully drained
+        carried = len(replacement._pending) + len(replacement._overflow)
+        assert carried == len(pods)

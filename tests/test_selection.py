@@ -174,7 +174,11 @@ class TestNoMatchBackoff:
         for _ in range(5):
             h.selection.reconcile(pod.namespace, pod.name)
         h.apply_provisioner(provisioner("default"))
-        assert h.selection.reconcile(pod.namespace, pod.name) == 1.0  # healed
+        # Healed: accepted by the worker, so the slow re-verify cadence.
+        assert (
+            h.selection.reconcile(pod.namespace, pod.name)
+            == h.selection.ACCEPTED_REQUEUE_SECONDS
+        )
         # And if that provisioner vanishes, backoff starts over from 1s.
         h.cluster.delete_provisioner("default")
         h.provisioning.workers.clear()
