@@ -232,12 +232,24 @@ def main():
     # Headline: latency at the solver boundary (densified specs in, packing
     # plan out) — the operation the <200ms p50 north-star targets. Encoding
     # is measured separately (encode_ms) and also charged in end_to_end_ms.
+    # COLD measurement: fresh PodSpec objects, so the per-pod dense-vector
+    # cache (populated during the warmup above) cannot flatter it; warm
+    # re-encodes of the same pods run ~10x faster (encode_warm_ms).
+    cold_pods, cold_catalog, _ = make_workload()
     start = time.perf_counter()
-    groups = group_pods(pods)
+    groups = group_pods(cold_pods)
     fleet = build_fleet(
-        catalog, constraints, pods, pods_need=groups.vectors.max(axis=0)
+        cold_catalog, constraints, cold_pods,
+        pods_need=groups.vectors.max(axis=0),
     )
     encode_ms = (time.perf_counter() - start) * 1e3
+    start = time.perf_counter()
+    warm_groups = group_pods(cold_pods)
+    build_fleet(
+        cold_catalog, constraints, cold_pods,
+        pods_need=warm_groups.vectors.max(axis=0),
+    )
+    encode_warm_ms = (time.perf_counter() - start) * 1e3
     latencies = []
     for _ in range(10):
         start = time.perf_counter()
@@ -246,8 +258,10 @@ def main():
     p50 = float(np.percentile(latencies, 50))
     p99 = float(np.percentile(latencies, 99))
 
+    # End-to-end on yet-unseen pod objects: cold encode + solve.
+    e2e_pods, e2e_catalog, _ = make_workload()
     start = time.perf_counter()
-    solver.solve(pods, catalog, constraints)
+    solver.solve(e2e_pods, e2e_catalog, constraints)
     end_to_end_ms = (time.perf_counter() - start) * 1e3
 
     # Baseline: the reference algorithm (greedy FFD) as compiled host code —
@@ -381,6 +395,7 @@ def main():
                 "p99_ms": round(p99, 3),
                 "end_to_end_ms": round(end_to_end_ms, 3),
                 "encode_ms": round(encode_ms, 3),
+                "encode_warm_ms": round(encode_warm_ms, 3),
                 "baseline_ms": round(baseline_ms, 3),
                 "baseline_impl": "native-cxx"
                 if native_mod.available()

@@ -98,6 +98,11 @@ class PodSpec:
         self.requests = parse_resource_list(self.requests)
         # Every pod consumes one pod slot.
         self.requests.setdefault(wellknown.RESOURCE_PODS, 1.0)
+        # Dense [R] request vector, computed once by ops.encode.group_pods
+        # and cached here (requests are immutable after parsing, so the
+        # cache cannot go stale). Shaves the per-pod dict walk off every
+        # subsequent encode of the same pod.
+        self.dense_vector = None
 
     # --- predicates (ref: pkg/utils/pod/scheduling.go) ----------------------
 

@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Sequence
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import PodSpec
-from karpenter_tpu.api.provisioner import Provisioner
+from karpenter_tpu.api.provisioner import PodIncompatibleError, Provisioner
 from karpenter_tpu.api.requirements import Requirement, Requirements
 from karpenter_tpu.api.validation import default_provisioner, validate_provisioner
 from karpenter_tpu.cloudprovider import CloudProvider, NodeSpec
@@ -163,10 +163,14 @@ class ProvisionerWorker:
                 else:
                     self._pending.append(pod)
                 self._pending_uids.add(pod.uid)
-            now = self.cluster.clock.now()
-            if self._first_add is None:
-                self._first_add = now
-            self._last_add = now
+                # Window clock moves only on GENUINE adds: duplicate
+                # re-verify adds would otherwise keep refreshing _last_add
+                # and hold a partial batch open to the 10s max instead of
+                # closing on the 1s idle.
+                now = self.cluster.clock.now()
+                if self._first_add is None:
+                    self._first_add = now
+                self._last_add = now
 
     def take_backlog(self) -> List[PodSpec]:
         """Drain EVERYTHING (batch + overflow) for hand-off to a replacement
@@ -437,9 +441,17 @@ class ProvisioningController:
             # the replacement: mid-storm spec-hash flips (ICE blackouts
             # changing effective offerings) must not dump tens of thousands
             # of parked pods back onto the slow selection re-verify path.
+            # Re-validate against the CHANGED constraints at hand-off — the
+            # hash flipped precisely because they changed; pods now
+            # incompatible stay out and heal through the selection
+            # re-verify, which relaxes and can re-route them.
             old = self.workers.get(provisioner.name)
             if old is not None:
                 for pod in old.take_backlog():
+                    try:
+                        effective.spec.constraints.validate_pod(pod)
+                    except PodIncompatibleError:
+                        continue
                     replacement.add(pod)
             self.workers[provisioner.name] = replacement
         else:

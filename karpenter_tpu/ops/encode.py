@@ -82,8 +82,13 @@ def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
     buckets: Dict[bytes, List[PodSpec]] = {}
     vectors: Dict[bytes, np.ndarray] = {}
     for pod in pods:
-        vec = resource_vector(pod.requests)  # memoized: ~1 parse per shape
-        key = vec.tobytes()
+        # Per-pod cache first (requests are immutable after parsing), then
+        # the content-keyed memo (~1 parse per distinct shape).
+        cached = pod.dense_vector
+        if cached is None:
+            vec = resource_vector(pod.requests)
+            pod.dense_vector = cached = (vec, vec.tobytes())
+        vec, key = cached
         members = buckets.get(key)
         if members is None:
             buckets[key] = [pod]

@@ -351,3 +351,28 @@ class TestBatchOverflow:
         assert not worker._pending and not worker._overflow  # fully drained
         carried = len(replacement._pending) + len(replacement._overflow)
         assert carried == len(pods)
+
+    def test_hot_swap_drops_pods_incompatible_with_new_constraints(self):
+        """The hash flipped because constraints changed: carried pods are
+        re-validated at hand-off, and now-incompatible ones are left to the
+        selection re-verify (which can relax and re-route them)."""
+        from karpenter_tpu.api import wellknown
+
+        h = Harness()
+        provisioner = default_provisioner()
+        h.apply_provisioner(provisioner)
+        worker = h.provisioning.worker("default")
+        plain = fixtures.pod(name="plain")
+        pinned = fixtures.pod(name="pinned")
+        pinned.node_selector = {wellknown.ZONE_LABEL: "test-zone-1"}
+        worker.add(plain)
+        worker.add(pinned)
+        # Narrow the provisioner to a different zone: `pinned` no longer fits.
+        provisioner.spec.constraints.requirements = Requirements(
+            [Requirement.in_(wellknown.ZONE_LABEL, ["test-zone-2"])]
+        )
+        h.apply_provisioner(provisioner)
+        replacement = h.provisioning.worker("default")
+        assert replacement is not worker
+        carried = {p.name for p in replacement._pending}
+        assert carried == {"plain"}
