@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
+from types import MappingProxyType
 from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
@@ -95,13 +96,17 @@ class PodSpec:
         if not self.uid:
             self.uid = f"pod-uid-{next(_uid_counter)}"
         # Always copy: never alias (and mutate) a caller-supplied dict.
-        self.requests = parse_resource_list(self.requests)
+        parsed = parse_resource_list(self.requests)
         # Every pod consumes one pod slot.
-        self.requests.setdefault(wellknown.RESOURCE_PODS, 1.0)
+        parsed.setdefault(wellknown.RESOURCE_PODS, 1.0)
+        # Read-only: the dense-vector cache below depends on requests never
+        # changing after parsing, so that invariant is ENFORCED, not assumed
+        # (mutating a proxy raises TypeError). Build changed requests into a
+        # new PodSpec instead.
+        self.requests = MappingProxyType(parsed)
         # Dense [R] request vector, computed once by ops.encode.group_pods
-        # and cached here (requests are immutable after parsing, so the
-        # cache cannot go stale). Shaves the per-pod dict walk off every
-        # subsequent encode of the same pod.
+        # and cached here. Shaves the per-pod dict walk off every subsequent
+        # encode of the same pod.
         self.dense_vector = None
 
     # --- predicates (ref: pkg/utils/pod/scheduling.go) ----------------------

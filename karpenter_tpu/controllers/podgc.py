@@ -19,10 +19,18 @@ from typing import Set, Tuple
 
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.metrics import REGISTRY
 
 log = klog.named("podgc")
 
 SWEEP_SECONDS = 10.0
+
+PODGC_DELETED_TOTAL = REGISTRY.counter(
+    "podgc_deleted_total", "Orphaned pods reaped (bound to a vanished node)"
+)
+PODGC_SUSPECTS = REGISTRY.gauge(
+    "podgc_suspect_count", "Orphan candidates awaiting a second sighting"
+)
 
 
 class PodGcController:
@@ -54,9 +62,11 @@ class PodGcController:
             try:
                 self.cluster.delete_pod(namespace, name)
                 deleted.add(key)
+                PODGC_DELETED_TOTAL.inc()
                 log.info("deleted orphaned pod %s/%s (node gone)", namespace, name)
             except Exception:  # noqa: BLE001 — transient failure or raced
                 # deletion: STAY a suspect so the very next sweep retries.
                 log.debug("orphan %s/%s delete failed; retrying", namespace, name)
         self._suspects = orphans - deleted
+        PODGC_SUSPECTS.set(len(self._suspects))
         return SWEEP_SECONDS

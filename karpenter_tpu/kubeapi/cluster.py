@@ -29,6 +29,7 @@ from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.cloudprovider import NodeSpec
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.errors import PDBViolationError
+from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.kubeapi import convert
 from karpenter_tpu.kubeapi.client import ApiError, KubeClient
 from karpenter_tpu.utils import logging as klog
@@ -46,6 +47,14 @@ LEASES = "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases"
 def _pod_path(namespace: str, name: str = "") -> str:
     base = f"/api/v1/namespaces/{namespace}/pods"
     return f"{base}/{name}" if name else base
+
+
+# Watch-plane health: a re-list means a watch gap outlived the apiserver's
+# history window (410 Gone) — rare in steady state; a rising rate signals
+# network trouble or an undersized watch cache.
+WATCH_RELIST_TOTAL = REGISTRY.counter(
+    "watch_relist_total", "410-triggered re-LISTs per resource kind", ["kind"]
+)
 
 
 class ApiServerCluster(Cluster):
@@ -168,6 +177,7 @@ class ApiServerCluster(Cluster):
             if self._newer(kind, obj):
                 self._apply_remote(kind, obj)
         self.resync_count += 1
+        WATCH_RELIST_TOTAL.inc(kind)
         log.warning("watch for %s expired (410); re-listed %d objects", kind, len(items))
         return rv
 

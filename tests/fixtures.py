@@ -65,11 +65,16 @@ def default_catalog() -> List[InstanceType]:
 _counter = [0]
 
 
-def pod(cpu="1", memory="512Mi", name=None, **kwargs) -> PodSpec:
+def pod(cpu="1", memory="512Mi", name=None, extra_requests=None, **kwargs) -> PodSpec:
+    """extra_requests merges additional resources (e.g. accelerators) into
+    the request set at construction — requests are immutable afterwards."""
     _counter[0] += 1
+    requests = {"cpu": cpu, "memory": memory}
+    if extra_requests:
+        requests.update(extra_requests)
     return PodSpec(
         name=name or f"pod-{_counter[0]}",
-        requests={"cpu": cpu, "memory": memory},
+        requests=requests,
         unschedulable=True,
         **kwargs,
     )

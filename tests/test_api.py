@@ -321,3 +321,19 @@ class TestValidation:
                     constraints=Constraints(taints=[Taint(key="k", effect="Nope")])
                 )
             )
+
+
+class TestRequestImmutability:
+    def test_requests_frozen_after_parse(self):
+        """The per-pod dense-vector cache depends on requests never changing
+        after construction; the invariant is enforced, not assumed."""
+        import pytest
+
+        from karpenter_tpu.api.pods import PodSpec
+
+        pod = PodSpec(name="frozen", requests={"cpu": "1"})
+        with pytest.raises(TypeError):
+            pod.requests["cpu"] = 2.0
+        # Reading and copying still work.
+        assert pod.requests["cpu"] == 1.0
+        assert dict(pod.total_requests())["cpu"] == 1.0

@@ -64,8 +64,7 @@ class TestEncode:
         assert "gpu-instance-type" not in names
         assert "default-instance-type" in names
         # GPU pod: only the gpu type remains.
-        gpu_pod = fixtures.pod()
-        gpu_pod.requests[wellknown.RESOURCE_NVIDIA_GPU] = 1.0
+        gpu_pod = fixtures.pod(extra_requests={wellknown.RESOURCE_NVIDIA_GPU: 1.0})
         fleet = build_fleet(catalog, no_constraints(), [gpu_pod])
         assert [it.name for it in fleet.instance_types] == ["gpu-instance-type"]
 

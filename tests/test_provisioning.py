@@ -46,8 +46,7 @@ class TestProvisioning:
     def test_gpu_pod_gets_gpu_node(self):
         h = Harness()
         h.apply_provisioner(default_provisioner())
-        pod = fixtures.pod()
-        pod.requests[wellknown.RESOURCE_NVIDIA_GPU] = 1.0
+        pod = fixtures.pod(extra_requests={wellknown.RESOURCE_NVIDIA_GPU: 1.0})
         h.provision(pod)
         node = h.expect_scheduled(pod)
         assert node.instance_type == "nvidia-gpu-instance-type"
@@ -55,8 +54,7 @@ class TestProvisioning:
     def test_tpu_pod_gets_tpu_node(self):
         h = Harness()
         h.apply_provisioner(default_provisioner())
-        pod = fixtures.pod()
-        pod.requests[wellknown.RESOURCE_GOOGLE_TPU] = 4.0
+        pod = fixtures.pod(extra_requests={wellknown.RESOURCE_GOOGLE_TPU: 4.0})
         h.provision(pod)
         node = h.expect_scheduled(pod)
         assert node.instance_type == "tpu-instance-type"

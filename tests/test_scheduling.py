@@ -76,8 +76,7 @@ class TestScheduleGrouping:
         p = h.apply_provisioner(provisioner())
         scheduler = Scheduler(h.cluster)
         cpu_pod = fixtures.pod()
-        gpu_pod = fixtures.pod()
-        gpu_pod.requests[wellknown.RESOURCE_NVIDIA_GPU] = 1.0
+        gpu_pod = fixtures.pod(extra_requests={wellknown.RESOURCE_NVIDIA_GPU: 1.0})
         schedules = scheduler.solve(p, [cpu_pod, gpu_pod])
         assert len(schedules) == 2
 
