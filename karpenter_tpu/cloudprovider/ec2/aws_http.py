@@ -25,9 +25,12 @@ import hashlib
 import hmac
 import json
 import os
+import random
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
@@ -45,6 +48,10 @@ from karpenter_tpu.cloudprovider.ec2.api import (
     SecurityGroup,
     Subnet,
 )
+
+from karpenter_tpu.utils import logging as klog
+
+log = klog.named("aws")
 
 EC2_API_VERSION = "2016-11-15"
 _SSM_TARGET_PREFIX = "AmazonSSM"
@@ -87,6 +94,83 @@ class UrllibTransport(HttpTransport):
             return HttpResponse(
                 status=err.code, body=err.read(), headers=dict(err.headers or {})
             )
+        except (urllib.error.URLError, OSError) as err:
+            # Socket-level failures (DNS, reset, timeout) are normalized to a
+            # coded ApiError so upstream classification — and the retryer —
+            # behave identically against the real cloud and the fakes.
+            raise ApiError("TransportError", str(err)) from err
+
+
+# --- Retry ------------------------------------------------------------------
+
+# Throttle codes back off harder than generic transient failures, mirroring
+# the SDK's throttle/retryable split (Go SDK shouldRetry / throttle lists).
+THROTTLE_CODES = frozenset(
+    {
+        "RequestLimitExceeded",
+        "Throttling",
+        "ThrottlingException",
+        "RequestThrottled",
+        "RequestThrottledException",
+        "TooManyRequestsException",
+        "EC2ThrottledException",
+    }
+)
+_TRANSIENT_CODES = frozenset(
+    {
+        "TransportError",
+        "RequestTimeout",
+        "RequestTimeoutException",
+        "InternalError",
+        "InternalFailure",
+        "ServiceUnavailable",
+        "Unavailable",
+        "InternalServiceError",
+        "InternalServerError",
+    }
+)
+
+
+@dataclass
+class RetryPolicy:
+    """Jittered exponential backoff with a bounded attempt budget.
+
+    Ref: the reference's AWS session installs
+    `client.DefaultRetryer{NumMaxRetries: DefaultRetryerMaxNumRetries}`
+    (pkg/cloudprovider/aws/cloudprovider.go:67-69), so every EC2/SSM call
+    there absorbs throttles (`RequestLimitExceeded`), 5xx, and connection
+    errors for free. This is that retryer for the hand-rolled binding: equal
+    jitter over an exponentially growing window, with throttle codes backing
+    off from a larger base than generic transient failures (the SDK's 500ms
+    vs 30ms minimums).
+    """
+
+    max_retries: int = 3
+    base_delay: float = 0.03
+    throttle_base: float = 0.5
+    max_delay: float = 20.0
+    sleep: Callable[[float], None] = time.sleep
+    rng: Callable[[], float] = random.random
+
+    def is_retryable(self, code: str) -> bool:
+        if code in THROTTLE_CODES or code in _TRANSIENT_CODES:
+            return True
+        # Synthesized codes for proxy/LB failures with no parseable envelope:
+        # all 5xx, plus bare 429 (throttle) and 408 (timeout), the statuses
+        # the SDK DefaultRetryer retries on without an error code.
+        if code in ("HTTP429", "HTTP408"):
+            return True
+        if code.startswith("HTTP5") and code[4:].isdigit():
+            return True
+        return False
+
+    def is_throttle(self, code: str) -> bool:
+        return code in THROTTLE_CODES or code == "HTTP429"
+
+    def delay(self, attempt: int, code: str) -> float:
+        base = self.throttle_base if self.is_throttle(code) else self.base_delay
+        window = min(self.max_delay, base * (2.0 ** attempt))
+        return window / 2.0 + self.rng() * (window / 2.0)
 
 
 # --- SigV4 ------------------------------------------------------------------
@@ -136,10 +220,16 @@ def sign_request(
 
     canonical_uri = urllib.parse.quote(parsed.path or "/", safe="/")
     query_pairs = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
-    canonical_query = "&".join(
-        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
-        for k, v in sorted(query_pairs)
+    # Spec: sort by URI-encoded name/value — encode FIRST, then sort, so keys
+    # whose encodings order differently than their raw forms sign correctly.
+    encoded_pairs = sorted(
+        (
+            urllib.parse.quote(k, safe="-_.~"),
+            urllib.parse.quote(v, safe="-_.~"),
+        )
+        for k, v in query_pairs
     )
+    canonical_query = "&".join(f"{k}={v}" for k, v in encoded_pairs)
     signed_names = sorted(headers, key=str.lower)
     canonical_headers = "".join(
         f"{name.lower()}:{' '.join(headers[name].split())}\n" for name in signed_names
@@ -226,6 +316,7 @@ class AwsHttpEc2Api(Ec2Api):
         spot_prices: Optional[Mapping[Tuple[str, str], float]] = None,
         branch_interfaces: Optional[Mapping[str, int]] = None,
         clock: Callable[[], datetime.datetime] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self.region = region or os.environ.get(
             "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "us-east-1")
@@ -241,16 +332,43 @@ class AwsHttpEc2Api(Ec2Api):
         # the reference (vpc-resource-controller data), not the EC2 API.
         self.branch_interfaces = dict(branch_interfaces or {})
         self._clock = clock
+        self.retry = retry_policy or RetryPolicy()
         # type name -> supported usage classes, from the last
         # DescribeInstanceTypes response (see describe_instance_type_offerings).
         self._usage_classes: Optional[Dict[str, Sequence[str]]] = None
 
     # --- protocol plumbing --------------------------------------------------
 
+    def _with_retries(self, attempt_fn: Callable[[], "ET.Element | Dict"], what: str):
+        """Run one signed call with the retry budget: throttles, 5xx, and
+        transport failures back off and re-sign (fresh X-Amz-Date per attempt);
+        everything else — and budget exhaustion — propagates."""
+        attempt = 0
+        while True:
+            try:
+                return attempt_fn()
+            except ApiError as error:
+                if attempt >= self.retry.max_retries or not self.retry.is_retryable(
+                    error.code
+                ):
+                    raise
+                delay = self.retry.delay(attempt, error.code)
+                attempt += 1
+                log.debug(
+                    "%s attempt %d failed (%s); retrying in %.2fs",
+                    what, attempt, error.code, delay,
+                )
+                self.retry.sleep(delay)
+
     def _ec2_call(self, action: str, params: Mapping[str, str]) -> ET.Element:
         body_params = {"Action": action, "Version": EC2_API_VERSION}
         body_params.update(params)
         body = urllib.parse.urlencode(sorted(body_params.items())).encode()
+        return self._with_retries(
+            lambda: self._ec2_attempt(body), what=action
+        )
+
+    def _ec2_attempt(self, body: bytes) -> ET.Element:
         headers = {"Content-Type": "application/x-www-form-urlencoded; charset=utf-8"}
         headers = sign_request(
             "POST", self.ec2_endpoint, headers, body, self.region, "ec2",
@@ -305,6 +423,11 @@ class AwsHttpEc2Api(Ec2Api):
 
     def _ssm_call(self, target: str, payload: Mapping) -> Dict:
         body = json.dumps(payload).encode()
+        return self._with_retries(
+            lambda: self._ssm_attempt(target, body), what=target
+        )
+
+    def _ssm_attempt(self, target: str, body: bytes) -> Dict:
         headers = {
             "Content-Type": "application/x-amz-json-1.1",
             "X-Amz-Target": f"{_SSM_TARGET_PREFIX}.{target}",
@@ -502,6 +625,10 @@ class AwsHttpEc2Api(Ec2Api):
     def create_launch_template(self, template: LaunchTemplate) -> LaunchTemplate:
         params: Dict[str, str] = {
             "LaunchTemplateName": template.name,
+            # Same idempotency rationale as CreateFleet: a retried create
+            # whose first attempt executed server-side must not surface
+            # AlreadyExists (one token per logical call, reused by retries).
+            "ClientToken": str(uuid.uuid4()),
             "LaunchTemplateData.ImageId": template.image_id,
             "LaunchTemplateData.UserData": template.user_data,
         }
@@ -535,6 +662,11 @@ class AwsHttpEc2Api(Ec2Api):
         spot (ref: instance.go:116-133)."""
         params: Dict[str, str] = {
             "Type": "instant",
+            # Idempotency token: a retried CreateFleet (5xx whose first
+            # attempt may have executed server-side) must not double-launch.
+            # The whole retry loop re-sends ONE token since the body is built
+            # once per logical call in _ec2_call.
+            "ClientToken": str(uuid.uuid4()),
             "LaunchTemplateConfigs.1.LaunchTemplateSpecification.LaunchTemplateName":
                 request.launch_template_name,
             "LaunchTemplateConfigs.1.LaunchTemplateSpecification.Version": "$Latest",

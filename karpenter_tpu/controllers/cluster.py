@@ -93,11 +93,22 @@ class Cluster:
             stored.unschedulable = False
         self._notify("pod", stored)
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(
+        self, namespace: str, name: str, uid: Optional[str] = None
+    ) -> bool:
+        """uid, when given, preconditions the delete (DeleteOptions
+        semantics): a same-name pod re-created since the caller observed the
+        victim is left alone (compare-and-pop under the lock). Returns True
+        iff this call removed the pod."""
         with self._lock:
-            pod = self._pods.pop((namespace, name), None)
-        if pod is not None:
-            self._notify("pod", pod)
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                return False
+            if uid and (getattr(pod, "uid", "") or "") != uid:
+                return False
+            self._pods.pop((namespace, name), None)
+        self._notify("pod", pod)
+        return True
 
     def evict_pod(self, namespace: str, name: str) -> None:
         """Eviction-API analogue: honors PDBs (429-equivalent refusal)

@@ -58,12 +58,18 @@ class PodGcController:
                 orphans.add((pod.namespace, pod.name, getattr(pod, "uid", "") or ""))
         deleted: Set[Tuple[str, str, str]] = set()
         for key in orphans & self._suspects:  # second consecutive sighting
-            namespace, name, _uid = key
+            namespace, name, uid = key
             try:
-                self.cluster.delete_pod(namespace, name)
-                deleted.add(key)
-                PODGC_DELETED_TOTAL.inc()
-                log.info("deleted orphaned pod %s/%s (node gone)", namespace, name)
+                # UID-preconditioned: a same-name pod re-created (and bound to
+                # a live node) between this sweep's listing and the delete must
+                # survive — kube-controller-manager's gcOrphaned does the same.
+                removed = self.cluster.delete_pod(namespace, name, uid=uid or None)
+                deleted.add(key)  # observed incarnation is gone either way
+                if removed:
+                    PODGC_DELETED_TOTAL.inc()
+                    log.info(
+                        "deleted orphaned pod %s/%s (node gone)", namespace, name
+                    )
             except Exception:  # noqa: BLE001 — transient failure or raced
                 # deletion: STAY a suspect so the very next sweep retries.
                 log.debug("orphan %s/%s delete failed; retrying", namespace, name)

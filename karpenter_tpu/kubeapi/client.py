@@ -217,8 +217,12 @@ class KubeClient:
     def patch(self, path: str, patch: dict) -> dict:
         return self._call("PATCH", path, body=patch)
 
-    def delete(self, path: str) -> dict:
-        return self._call("DELETE", path)
+    def delete(self, path: str, uid: Optional[str] = None) -> dict:
+        """DELETE, optionally UID-preconditioned (DeleteOptions.preconditions):
+        the server answers 409 when the live object is a different incarnation
+        than the one the caller observed."""
+        body = {"preconditions": {"uid": uid}} if uid else None
+        return self._call("DELETE", path, body=body)
 
     def try_get(self, path: str) -> Optional[dict]:
         try:

@@ -354,13 +354,22 @@ class ApiServerCluster(Cluster):
         )
         super().bind_pod(pod, node)
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(
+        self, namespace: str, name: str, uid: Optional[str] = None
+    ) -> bool:
         try:
-            self.api.delete(_pod_path(namespace, name))
+            self.api.delete(_pod_path(namespace, name), uid=uid)
         except ApiError as error:
+            if error.status == 409 and uid:
+                # UID precondition failed: the name now belongs to a new
+                # incarnation — the pod the caller observed is already gone.
+                return False
             if error.status != 404:
                 raise
-        super().delete_pod(namespace, name)
+            super().delete_pod(namespace, name, uid=uid)
+            return False  # someone else already deleted it
+        super().delete_pod(namespace, name, uid=uid)
+        return True
 
     def evict_pod(self, namespace: str, name: str) -> None:
         """POST the Eviction subresource; the apiserver enforces PDBs and

@@ -214,7 +214,7 @@ class FakeApiServer:
             if method == "PATCH":
                 return self._patch(kind, namespace, name, body or {})
             if method == "DELETE":
-                return self._delete(kind, namespace, name)
+                return self._delete(kind, namespace, name, body)
         return _status_error(405, f"{method} not supported on {path}")
 
     def _create(self, kind, namespace, body) -> Tuple[int, dict]:
@@ -271,11 +271,19 @@ class FakeApiServer:
             self._emit(kind, "DELETED", merged)
         return 200, copy.deepcopy(merged)
 
-    def _delete(self, kind, namespace, name) -> Tuple[int, dict]:
+    def _delete(self, kind, namespace, name, options=None) -> Tuple[int, dict]:
         key = (namespace if kind in NAMESPACED else "", name)
         existing = self._collection(kind).get(key)
         if existing is None:
             return _status_error(404, f"{kind}/{name} not found")
+        # DeleteOptions.preconditions.uid — like the real apiserver, a UID
+        # mismatch (name reused by a new incarnation) answers 409 Conflict.
+        want_uid = ((options or {}).get("preconditions") or {}).get("uid")
+        have_uid = existing.get("metadata", {}).get("uid")
+        if want_uid and want_uid != have_uid:
+            return _status_error(
+                409, f"uid precondition failed: have {have_uid}, want {want_uid}"
+            )
         metadata = existing.setdefault("metadata", {})
         if metadata.get("finalizers"):
             # Finalizers block actual removal: stamp deletionTimestamp only

@@ -27,6 +27,7 @@ from karpenter_tpu.cloudprovider.ec2.aws_http import (
     Credentials,
     HttpResponse,
     HttpTransport,
+    RetryPolicy,
     sign_request,
 )
 from tests.wire_fake import WireFakeTransport, wire_api
@@ -77,12 +78,16 @@ class RecordedTransport(HttpTransport):
         return self.responses.pop(0)
 
 
-def recorded_api(*responses) -> AwsHttpEc2Api:
+def recorded_api(*responses, retry_policy=None) -> AwsHttpEc2Api:
+    # Default: retries OFF, so encoding/parsing tests see exactly one
+    # attempt per canned response. Retry behavior is covered by TestRetry.
     return AwsHttpEc2Api(
         region="us-test-1",
         credentials=Credentials("AKID", "secret"),
         transport=RecordedTransport(responses),
         price_catalog={"m5.large": 0.096},
+        retry_policy=retry_policy
+        or RetryPolicy(max_retries=0, sleep=lambda _s: None),
     )
 
 
@@ -255,6 +260,23 @@ class TestErrorMapping:
         assert err.value.code == "MalformedResponse"
         assert not is_not_found(err.value)
 
+    def test_transport_error_is_coded(self):
+        """Socket-level failures surface as ApiError('TransportError'), not a
+        raw URLError, so classification is uniform vs the fakes."""
+        from karpenter_tpu.cloudprovider.ec2.aws_http import UrllibTransport
+
+        transport = UrllibTransport(timeout=0.01)
+        api = AwsHttpEc2Api(
+            region="us-test-1",
+            credentials=Credentials("AKID", "secret"),
+            transport=transport,
+            ec2_endpoint="http://127.0.0.1:9/",  # discard port: refuses fast
+            retry_policy=RetryPolicy(max_retries=0, sleep=lambda _s: None),
+        )
+        with pytest.raises(ApiError) as err:
+            api.describe_instances(["i-1"])
+        assert err.value.code == "TransportError"
+
     def test_ssm_parameter_value_parsed(self):
         api = recorded_api(
             HttpResponse(
@@ -263,6 +285,157 @@ class TestErrorMapping:
             )
         )
         assert api.get_ami_parameter("/aws/service/x") == "ami-12345"
+
+
+_THROTTLE_XML = HttpResponse(
+    503,
+    b"<Response><Errors><Error><Code>RequestLimitExceeded</Code>"
+    b"<Message>Request limit exceeded.</Message></Error></Errors></Response>",
+)
+_OK_DESCRIBE = HttpResponse(
+    200,
+    b'<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/'
+    b'2016-11-15/"><reservationSet><item><instancesSet><item>'
+    b"<instanceId>i-1</instanceId><instanceType>m5.large</instanceType>"
+    b"</item></instancesSet></item></reservationSet>"
+    b"</DescribeInstancesResponse>",
+)
+
+
+class TestRetry:
+    """The binding's DefaultRetryer analogue (ref: aws/cloudprovider.go:67-69
+    installs client.DefaultRetryer on every EC2/SSM call): throttles, 5xx and
+    transport failures back off with jittered-exponential delays inside a
+    bounded attempt budget."""
+
+    def _sleep_recorder(self):
+        slept = []
+        return slept, slept.append
+
+    def test_throttle_sequence_recovers(self):
+        slept, sleep = self._sleep_recorder()
+        api = recorded_api(
+            _THROTTLE_XML,
+            HttpResponse(500, b"<html>internal"),
+            _OK_DESCRIBE,
+            retry_policy=RetryPolicy(sleep=sleep, rng=lambda: 0.5),
+        )
+        instances = api.describe_instances(["i-1"])
+        assert [i.instance_id for i in instances] == ["i-1"]
+        assert len(slept) == 2  # two failures, two backoffs
+        assert len(api.transport.sent) == 3
+
+    def test_budget_exhaustion_raises_with_bounded_attempts(self):
+        slept, sleep = self._sleep_recorder()
+        api = recorded_api(
+            *([_THROTTLE_XML] * 4),
+            retry_policy=RetryPolicy(
+                max_retries=3, sleep=sleep, rng=lambda: 0.0
+            ),
+        )
+        with pytest.raises(ApiError) as err:
+            api.describe_instances(["i-1"])
+        assert err.value.code == "RequestLimitExceeded"
+        assert len(api.transport.sent) == 4  # 1 + 3 retries, no more
+        assert len(slept) == 3
+
+    def test_throttle_backs_off_harder_than_transient(self):
+        policy = RetryPolicy(rng=lambda: 0.0, sleep=lambda _s: None)
+        assert policy.delay(0, "RequestLimitExceeded") > policy.delay(
+            0, "HTTP503"
+        )
+        # Exponential growth, capped.
+        assert policy.delay(2, "Throttling") > policy.delay(0, "Throttling")
+        assert policy.delay(30, "Throttling") <= policy.max_delay
+
+    def test_bare_429_and_408_are_retryable_throttles(self):
+        """A proxy/LB throttle or timeout with no parseable envelope
+        synthesizes HTTP429/HTTP408 — the SDK retries these statuses even
+        without an error code, and 429 backs off on the throttle schedule."""
+        policy = RetryPolicy(rng=lambda: 0.0, sleep=lambda _s: None)
+        assert policy.is_retryable("HTTP429")
+        assert policy.is_retryable("HTTP408")
+        assert not policy.is_retryable("HTTP404")
+        assert policy.delay(0, "HTTP429") == policy.delay(
+            0, "RequestLimitExceeded"
+        )
+
+    def test_non_retryable_error_fails_fast(self):
+        slept, sleep = self._sleep_recorder()
+        api = recorded_api(
+            HttpResponse(
+                400,
+                b"<Response><Errors><Error><Code>InvalidInstanceID.NotFound"
+                b"</Code><Message>nope</Message></Error></Errors></Response>",
+            ),
+            retry_policy=RetryPolicy(sleep=sleep),
+        )
+        with pytest.raises(ApiError):
+            api.describe_instances(["i-missing"])
+        assert slept == [] and len(api.transport.sent) == 1
+
+    def test_transport_failure_retries(self):
+        class FlakySocket(HttpTransport):
+            def __init__(self):
+                self.sent = []
+
+            def send(self, method, url, headers, body):
+                self.sent.append(body)
+                if len(self.sent) == 1:
+                    raise ApiError("TransportError", "connection reset")
+                return _OK_DESCRIBE
+
+        api = AwsHttpEc2Api(
+            region="us-test-1",
+            credentials=Credentials("AKID", "secret"),
+            transport=FlakySocket(),
+            retry_policy=RetryPolicy(sleep=lambda _s: None),
+        )
+        assert api.describe_instances(["i-1"])[0].instance_id == "i-1"
+        assert len(api.transport.sent) == 2
+
+    def test_ssm_throttle_recovers(self):
+        slept, sleep = self._sleep_recorder()
+        api = recorded_api(
+            HttpResponse(
+                400,
+                json.dumps({"__type": "ThrottlingException"}).encode(),
+            ),
+            HttpResponse(
+                200, json.dumps({"Parameter": {"Value": "ami-9"}}).encode()
+            ),
+            retry_policy=RetryPolicy(sleep=sleep),
+        )
+        assert api.get_ami_parameter("/aws/service/x") == "ami-9"
+        assert len(slept) == 1
+
+    def test_create_fleet_retry_reuses_one_client_token(self):
+        """A retried CreateFleet must carry the SAME idempotency token so a
+        5xx whose first attempt executed server-side cannot double-launch."""
+        ok_fleet = HttpResponse(
+            200,
+            b'<CreateFleetResponse xmlns="http://ec2.amazonaws.com/doc/'
+            b'2016-11-15/"><fleetInstanceSet/><errorSet/>'
+            b"</CreateFleetResponse>",
+        )
+        api = recorded_api(
+            HttpResponse(500, b""),
+            ok_fleet,
+            retry_policy=RetryPolicy(sleep=lambda _s: None),
+        )
+        api.create_fleet(
+            FleetRequest(
+                launch_template_name="lt",
+                capacity_type="on-demand",
+                quantity=1,
+                overrides=[],
+            )
+        )
+        tokens = [
+            _params(body).get("ClientToken")
+            for _m, _u, _h, body in api.transport.sent
+        ]
+        assert len(tokens) == 2 and tokens[0] == tokens[1] and tokens[0]
 
 
 class TestWireFakeRoundTrip:

@@ -1,0 +1,56 @@
+"""The ENTIRE EC2 provider suite re-run over a FLAKY wire: every other HTTP
+request is answered with a rotating throttle / 5xx / empty-body / socket
+fault before reaching the wire fake. With the binding's retryer
+(aws_http.RetryPolicy) this must stay green — the operational guarantee the
+reference inherits from the SDK's DefaultRetryer
+(ref: pkg/cloudprovider/aws/cloudprovider.go:67-69).
+"""
+
+import pytest
+
+from tests import test_ec2 as _suite
+from tests.wire_fake import wire_api
+
+
+@pytest.fixture(autouse=True)
+def _flaky_wire_backend(monkeypatch):
+    # period=2: literally half of all wire requests fail first try.
+    monkeypatch.setattr(
+        _suite, "make_api", lambda: wire_api(page_size=4, flaky_period=2)
+    )
+
+
+class TestVendorExtensionFlaky(_suite.TestVendorExtension):
+    pass
+
+
+class TestInstanceTypeAdaptationFlaky(_suite.TestInstanceTypeAdaptation):
+    pass
+
+
+class TestDiscoveryFlaky(_suite.TestDiscovery):
+    pass
+
+
+class TestLaunchTemplatesFlaky(_suite.TestLaunchTemplates):
+    pass
+
+
+class TestFleetLaunchFlaky(_suite.TestFleetLaunch):
+    pass
+
+
+class TestInsufficientCapacityFlaky(_suite.TestInsufficientCapacity):
+    pass
+
+
+class TestTerminateFlaky(_suite.TestTerminate):
+    pass
+
+
+class TestEndToEndFlaky(_suite.TestEndToEnd):
+    pass
+
+
+class TestPoolPinnedLaunchFlaky(_suite.TestPoolPinnedLaunch):
+    pass

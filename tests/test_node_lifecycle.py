@@ -252,6 +252,70 @@ class TestPodGc:
         gc.reconcile()  # orphan healed: not deleted, suspicion cleared
         assert h.cluster.try_get_pod(pod.namespace, pod.name) is not None
 
+    def test_reincarnated_pod_survives_uid_precondition(self):
+        """The delete is UID-preconditioned: a same-name pod re-created (and
+        bound to a live node) between the sweep's listing and the delete call
+        must NOT be deleted in the old incarnation's stead."""
+        from karpenter_tpu.cloudprovider import NodeSpec
+        from karpenter_tpu.controllers.podgc import PodGcController
+        from tests.harness import Harness
+        from tests import fixtures
+
+        h = Harness()
+        gc = PodGcController(h.cluster)
+        h.cluster.create_node(NodeSpec(name="live-node"))
+        victim = fixtures.pod(name="reused")
+        h.cluster.apply_pod(victim)
+        h.cluster.get_pod(victim.namespace, victim.name).node_name = "gone"
+        gc.reconcile()  # sighting 1: suspect
+
+        # Race: the orphan vanishes and a NEW incarnation takes its name,
+        # bound to a live node — but gc's next sweep lists *before* learning
+        # that. Simulate by swapping the stored pod between list and delete.
+        original_list = h.cluster.list_pods
+
+        def list_then_swap(*args, **kwargs):
+            pods = original_list(*args, **kwargs)
+            fresh = fixtures.pod(name="reused")
+            fresh.node_name = "live-node"
+            fresh.unschedulable = False
+            h.cluster._pods[(fresh.namespace, fresh.name)] = fresh
+            return pods
+
+        from karpenter_tpu.controllers.podgc import PODGC_DELETED_TOTAL
+
+        before = PODGC_DELETED_TOTAL.get()
+        h.cluster.list_pods = list_then_swap
+        gc.reconcile()  # sighting 2: delete attempted with the OLD uid
+        h.cluster.list_pods = original_list
+        survivor = h.cluster.try_get_pod(victim.namespace, victim.name)
+        assert survivor is not None and survivor.node_name == "live-node"
+        # The refused delete must not be counted as a deletion.
+        assert PODGC_DELETED_TOTAL.get() == before
+
+    def test_apiserver_delete_honors_uid_precondition(self):
+        """The apiserver backend's DELETE carries DeleteOptions.preconditions;
+        the fake answers 409 on mismatch and the pod survives."""
+        from tests.fake_apiserver import DirectTransport, FakeApiServer
+        from karpenter_tpu.kubeapi.client import ApiError, KubeClient
+
+        server = FakeApiServer()
+        client = KubeClient(DirectTransport(server))
+        client.create(
+            "/api/v1/namespaces/default/pods",
+            {"metadata": {"name": "p", "namespace": "default", "uid": "uid-new"}},
+        )
+        try:
+            client.delete(
+                "/api/v1/namespaces/default/pods/p", uid="uid-old"
+            )
+            raise AssertionError("expected 409")
+        except ApiError as error:
+            assert error.status == 409
+        assert client.try_get("/api/v1/namespaces/default/pods/p") is not None
+        client.delete("/api/v1/namespaces/default/pods/p", uid="uid-new")
+        assert client.try_get("/api/v1/namespaces/default/pods/p") is None
+
     def test_bound_and_terminating_pods_untouched(self):
         from karpenter_tpu.cloudprovider import NodeSpec
         from karpenter_tpu.controllers.podgc import PodGcController

@@ -406,6 +406,46 @@ class WireFakeTransport(HttpTransport):
         )
 
 
+class FlakyTransport(HttpTransport):
+    """Wraps a real transport with a deterministic fault schedule: every
+    `period`-th request is answered with a throttle/5xx/socket failure
+    instead of reaching the inner transport. With the binding's retryer in
+    place, the whole provider suite must stay green over this — the
+    reference gets the same guarantee from the SDK's DefaultRetryer
+    (ref: aws/cloudprovider.go:67-69)."""
+
+    _FAULTS = (
+        HttpResponse(
+            503,
+            b"<Response><Errors><Error><Code>RequestLimitExceeded</Code>"
+            b"<Message>Request limit exceeded.</Message></Error></Errors>"
+            b"</Response>",
+        ),
+        HttpResponse(500, b"<html>internal error"),
+        HttpResponse(503, b""),  # empty-body LB failure
+        None,  # socket-level failure (raised as TransportError)
+    )
+
+    def __init__(self, inner: HttpTransport, period: int = 2):
+        self.inner = inner
+        self.period = period
+        self.calls = 0
+        self.faults_injected = 0
+
+    def send(self, method, url, headers, body) -> HttpResponse:
+        self.calls += 1
+        if self.calls % self.period == 0:
+            fault = self._FAULTS[self.faults_injected % len(self._FAULTS)]
+            self.faults_injected += 1
+            if fault is None:
+                raise ApiError("TransportError", "connection reset by fake")
+            return fault
+        return self.inner.send(method, url, headers, body)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 def _snake(action: str) -> str:
     out = []
     for ch in action:
@@ -415,13 +455,26 @@ def _snake(action: str) -> str:
     return "".join(out)
 
 
-def wire_api(fake: Optional[FakeEc2] = None, page_size: int = 6):
+def wire_api(
+    fake: Optional[FakeEc2] = None, page_size: int = 6, flaky_period: int = 0
+):
     """An AwsHttpEc2Api over the wire fake, with FakeEc2 attribute
     passthrough so provider-suite fault injection
-    (api.insufficient_capacity_pools, api.calls, ...) keeps working."""
-    from karpenter_tpu.cloudprovider.ec2.aws_http import AwsHttpEc2Api, Credentials
+    (api.insufficient_capacity_pools, api.calls, ...) keeps working.
+    flaky_period > 0 interposes FlakyTransport (every Nth request fails with
+    a rotating throttle/5xx/socket fault) with a no-sleep retry policy."""
+    from karpenter_tpu.cloudprovider.ec2.aws_http import (
+        AwsHttpEc2Api,
+        Credentials,
+        RetryPolicy,
+    )
 
     transport = WireFakeTransport(fake, page_size=page_size)
+    wire_transport = transport
+    retry_policy = None
+    if flaky_period:
+        wire_transport = FlakyTransport(transport, period=flaky_period)
+        retry_policy = RetryPolicy(sleep=lambda _seconds: None)
     price_catalog = {
         info.name: info.price_on_demand
         for info in transport.fake.instance_type_infos
@@ -437,7 +490,8 @@ def wire_api(fake: Optional[FakeEc2] = None, page_size: int = 6):
     api = _WireApi(
         region="us-test-1",
         credentials=Credentials("AKIDEXAMPLE", "secret", "token"),
-        transport=transport,
+        transport=wire_transport,
+        retry_policy=retry_policy,
         price_catalog=price_catalog,
         spot_price_ratio=0.6,
         # The wire carries no branch-interface counts; like the reference's
