@@ -314,6 +314,25 @@ def main():
     jax.device_get(probe)  # the same fetch path _to_host uses
     device_fetch_floor_ms = (time.perf_counter() - start) * 1e3
 
+    # Fetch irreducibility evidence: the fused kernel's outputs are packed
+    # into two flat arrays, and fetching the FULL payload after the compute
+    # is done costs the same as the 8-int probe above — the fetch is
+    # latency-bound (one tunnel round trip), not bandwidth-bound, so p50
+    # cannot drop below floor + compute on this rig. fetch_bytes sizes the
+    # payload; everything else (pool matrix, mix candidate, transfer) is
+    # overlapped with the blocking fetch (models/solver.cost_solve_dense).
+    from karpenter_tpu.models import solver as solver_mod
+
+    fused_probe = solver_mod.cost_solve_dispatch(
+        groups.vectors, groups.counts, fleet.capacity, fleet.total,
+        fleet.prices, 300,
+    )
+    fused_fetch_bytes = solver_mod.fetch_bytes(fused_probe)
+    jax.block_until_ready((fused_probe.ints, fused_probe.floats))
+    start = time.perf_counter()
+    solver_mod._to_host(fused_probe)
+    fetch_full_payload_ms = (time.perf_counter() - start) * 1e3
+
     # Realized $/hr: both plans bought through the SAME fleet-allocation
     # simulator (lowest-price for on-demand, capacity-optimized-prioritized
     # for spot — ref: instance.go:116-133) against one market state. The
@@ -369,6 +388,53 @@ def main():
             headline_ratios = per_seed[default_slack][:4]
     sweep_worst_mean = max(cell["mean"] for cell in sweep_cells.values())
 
+    # The BASELINE.md config ladder (configs 1-4; config 5 is the headline
+    # above): per config, solve-boundary latency p50 and the cost ratios
+    # under both accountings, so the perf claim covers the whole ladder and
+    # not just the 50k point. Constraint semantics (selectors, spread,
+    # anti-affinity) are correctness-tested in tests/ — the ladder here
+    # holds the solver-boundary shape of each scale.
+    configs = {}
+    for label, (n_pods, n_types) in {
+        "c1_100x10": (100, 10),
+        "c2_1k_50": (1_000, 50),
+        "c3_5k_100_3az": (5_000, 100),
+        "c4_10k_200": (10_000, 200),
+    }.items():
+        c_pods, c_catalog, c_market = make_workload(
+            num_pods=n_pods, num_types=n_types
+        )
+        c_groups = group_pods(c_pods)
+        c_fleet = build_fleet(
+            c_catalog, constraints, c_pods,
+            pods_need=c_groups.vectors.max(axis=0),
+        )
+        solver.solve_encoded(c_groups, c_fleet)  # warm this bucket shape
+        c_lat = []
+        for _ in range(5):
+            start = time.perf_counter()
+            c_ours = solver.solve_encoded(c_groups, c_fleet)
+            c_lat.append((time.perf_counter() - start) * 1e3)
+        c_greedy = baseline_solver.solve_encoded(c_groups, c_fleet)
+        c_g_cost = simulate_plan_cost(
+            c_greedy, constraints, c_market, ZONES, depth_slack=default_slack
+        )
+        c_o_cost = simulate_plan_cost(
+            c_ours, constraints, c_market, ZONES, depth_slack=default_slack
+        )
+        c_ideal = c_greedy.projected_cost()
+        configs[label] = {
+            "pods": n_pods,
+            "types": n_types,
+            "solve_p50_ms": round(float(np.percentile(c_lat, 50)), 2),
+            "cost_ratio": round(c_o_cost / c_g_cost, 4) if c_g_cost else 1.0,
+            "cost_ratio_lowest_price": round(
+                c_ours.projected_cost() / c_ideal, 4
+            )
+            if c_ideal
+            else 1.0,
+        }
+
     # Watch->selection->batch->solve->bind pipeline under a 10k-pod storm,
     # per selection-concurrency setting (justifies Options.selection_concurrency).
     pod_storm = {
@@ -384,6 +450,28 @@ def main():
     lowest_price_ratio = (
         cost_result.projected_cost() / greedy_ideal if greedy_ideal else 1.0
     )
+    # The hard floor of that ratio: the aggregate fractional LP (cover total
+    # demand with fractional nodes at each type's cheapest advertised pool)
+    # lower-bounds ANY feasible plan's projected cost — integral packings
+    # can only be worse (bin-packing integrality). Published so the achieved
+    # ratio is judged against what is attainable, not against zero.
+    lowest_price_bound = None
+    try:
+        from karpenter_tpu.models.solver import _pool_price_matrix
+        from karpenter_tpu.ops.mix_pack import aggregate_lp_bound
+
+        _, pool_prices_b = _pool_price_matrix(fleet)
+        pool_floor_b = np.where(
+            np.isfinite(pool_prices_b), pool_prices_b, np.inf
+        ).min(axis=1)
+        demand_b = (
+            groups.counts.astype(np.float64)[:, None] * groups.vectors
+        ).sum(axis=0)
+        lp_bound = aggregate_lp_bound(fleet.capacity, pool_floor_b, demand_b)
+        if lp_bound is not None and greedy_ideal:
+            lowest_price_bound = round(lp_bound[0] / greedy_ideal, 4)
+    except Exception:
+        pass
 
     print(
         json.dumps(
@@ -408,12 +496,16 @@ def main():
                 "p50_net_of_fetch_floor_ms": round(
                     max(p50 - device_fetch_floor_ms, 0.0), 3
                 ),
+                "fetch_bytes": int(fused_fetch_bytes),
+                "fetch_full_payload_ms": round(fetch_full_payload_ms, 1),
                 "batch8_schedules_ms": round(batch8_ms, 1),
                 "bind_10k_ms": round(bench_bind(), 1),
+                "configs": configs,
                 "pod_storm_10k": pod_storm,
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),
+                "cost_ratio_lowest_price_lp_bound": lowest_price_bound,
                 "cost_ratio_sweep": sweep_cells,
                 "cost_ratio_sweep_worst_mean": round(sweep_worst_mean, 4),
                 "pods": len(pods),

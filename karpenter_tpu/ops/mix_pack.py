@@ -1,0 +1,512 @@
+"""Column-LP mix packing: the host-overlap candidate of the cost solve.
+
+Ref: the reference's packer greedily fills one node shape at a time
+(pkg/controllers/provisioning/binpacking/packer.go:82-189) and never
+revisits the *mix* of node shapes it bought. On workloads whose pod shapes
+are complementary (cpu-heavy pods pairing with mem-heavy ones), a greedy
+pass — even a price-aware one — leaves a few percent of projected $/hr on
+the table versus jointly choosing the fill *configurations* to buy. This
+module recovers that gap with a configuration LP:
+
+  1. enumerate candidate node fills ("columns"): for a pruned set of
+     price-efficient types, seed each fill with k pods of group `a`
+     (k swept over fractions of the max), max-fill with group `b`, then
+     top off first-fit over all groups — the classic complementary-pair
+     structure the greedy pass cannot see;
+  2. price each column at the cheapest pool of any instance type whose
+     usable capacity dominates the column's demand (the same
+     launch-realization rule the decode path applies);
+  3. solve the covering LP  min c·x  s.t.  fills^T x >= counts  (scipy's
+     HiGHS — a hard dependency of jax — with a greedy fallback);
+  4. integerize: floor, greedily cover the residual by best
+     price-per-covered-pod, trim overshoot, and clamp fills to remaining
+     pods while emitting rounds so the cover is exact.
+
+Everything here is plain numpy on the HOST, by design: the fused device
+kernel's dispatch is async and its fetch pays a full device round trip
+(tens of ms on a tunneled accelerator), so this entire pipeline runs in
+that otherwise-idle window and adds nothing to the solve's latency
+(models/solver.cost_solve_dense overlaps it with the device).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# Enumeration budget: types kept after price-efficiency pruning, seed-group
+# cap, and the ka sweep fractions. J = TYPES_BUDGET * min(G, GROUPS_CAP)^2 *
+# len(KA_FRACS) columns — ~65k at the 50k-pod bench shape, a few ms of
+# vectorized numpy.
+TYPES_BUDGET = 64
+GROUPS_CAP = 32
+KA_FRACS = (1.0, 0.75, 0.5, 0.25)
+_EPS = 1e-4
+
+
+def _hash_mixers(num_groups: int) -> np.ndarray:
+    """Deterministic odd 64-bit multipliers for fill dedup — shared by the
+    native and numpy enumerations so their keys agree."""
+    return (
+        np.random.default_rng(0x5DEECE66D)
+        .integers(1, 2**63, size=num_groups, dtype=np.uint64)
+        | np.uint64(1)
+    )
+
+
+def _candidate_types(
+    capacity: np.ndarray, pool_floor: np.ndarray
+) -> np.ndarray:
+    """Union of the most price-efficient types per resource dimension."""
+    finite = np.isfinite(pool_floor) & (pool_floor > 0)
+    dims = min(3, capacity.shape[1])
+    sel: set = set()
+    per_dim = max(TYPES_BUDGET // dims, 1)
+    for d in range(dims):
+        eff = np.where(
+            finite & (capacity[:, d] > 0),
+            pool_floor / np.maximum(capacity[:, d], 1e-9),
+            np.inf,
+        )
+        sel |= set(np.argsort(eff, kind="stable")[:per_dim].tolist())
+    return np.array(sorted(sel), dtype=np.int32)[:TYPES_BUDGET]
+
+
+def _seed_groups(vectors: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Pair-seed groups: the GROUPS_CAP largest by normalized demand share;
+    every group still participates via the top-off."""
+    num_groups = vectors.shape[0]
+    if num_groups <= GROUPS_CAP:
+        return np.arange(num_groups, dtype=np.int32)
+    load = (counts[:, None] * vectors).astype(np.float64)
+    norm = load / np.maximum(load.sum(axis=0, keepdims=True), 1e-9)
+    seeds = np.argsort(-norm.max(axis=1), kind="stable")[:GROUPS_CAP]
+    return np.sort(seeds).astype(np.int32)
+
+
+def enumerate_pair_columns(
+    vectors: np.ndarray,  # [G, R] group request vectors (FFD-sorted desc)
+    counts: np.ndarray,  # [G] pods per group
+    capacity: np.ndarray,  # [T, R] usable capacity
+    pool_floor: np.ndarray,  # [T] cheapest advertised pool price per type
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Candidate fills [J, G] int64 (deduped) and their packed-type anchor
+    [J] int32. Prefers the native enumeration (native/ffd.cc
+    ktpu_mix_enumerate, ~15x the numpy fallback below — it must fit in the
+    dispatch-to-fetch overlap window)."""
+    num_groups = vectors.shape[0]
+    cand_types = _candidate_types(capacity, pool_floor)
+    if cand_types.size == 0:
+        return np.zeros((0, num_groups), np.int64), np.zeros((0,), np.int32)
+    seed_groups = _seed_groups(vectors, counts)
+    mixers = _hash_mixers(num_groups)
+
+    from karpenter_tpu.ops import native
+
+    result = native.mix_enumerate(
+        vectors,
+        counts,
+        capacity[cand_types],
+        seed_groups,
+        np.asarray(KA_FRACS, np.float32),
+        mixers,
+    )
+    if result is not None:
+        fills, cand_index = result
+        return fills, cand_types[cand_index]
+    return _enumerate_pair_columns_numpy(
+        vectors, counts, capacity, cand_types, seed_groups, mixers
+    )
+
+
+def _enumerate_pair_columns_numpy(
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    cand_types: np.ndarray,
+    seed_groups: np.ndarray,
+    mixers: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized fallback enumeration (no native toolchain)."""
+    num_groups = vectors.shape[0]
+    cap_t = capacity[cand_types]
+    fracs = np.asarray(KA_FRACS)
+    tt, aa, ff, bb = np.meshgrid(
+        np.arange(len(cand_types)),
+        seed_groups,
+        np.arange(len(fracs)),
+        seed_groups,
+        indexing="ij",
+    )
+    tt, aa, ff, bb = (x.ravel() for x in (tt, aa, ff, bb))
+    cap_j = cap_t[tt]  # [J, R]
+
+    def max_fit(remaining: np.ndarray, vec: np.ndarray) -> np.ndarray:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ratio = np.where(
+                vec > 0, remaining / np.where(vec > 0, vec, 1.0), np.inf
+            )
+        return np.maximum(np.floor(ratio.min(axis=1) + _EPS), 0.0)
+
+    va = vectors[aa]
+    ka = np.minimum(max_fit(cap_j, va), counts[aa].astype(np.float64))
+    ka = np.floor(fracs[ff] * ka + 1e-9)
+    remaining = cap_j - ka[:, None] * va
+    vb = vectors[bb]
+    kb = np.minimum(max_fit(remaining, vb), counts[bb].astype(np.float64))
+    kb = np.where(aa == bb, 0.0, kb)
+    remaining = remaining - kb[:, None] * vb
+
+    fills = np.zeros((len(tt), num_groups), np.int64)
+    rows = np.arange(len(tt))
+    np.add.at(fills, (rows, aa), ka.astype(np.int64))
+    np.add.at(fills, (rows, bb), kb.astype(np.int64))
+    # First-fit top-off in group order (desc pod size, matching the FFD
+    # convention) — turns every pair seed into a maximal fill.
+    for g in range(num_groups):
+        if counts[g] <= 0:
+            continue
+        n = np.minimum(
+            max_fit(remaining, vectors[g]),
+            (counts[g] - fills[:, g]).astype(np.float64),
+        ).astype(np.int64)
+        if not n.any():
+            continue
+        fills[:, g] += n
+        remaining = remaining - n[:, None].astype(np.float64) * vectors[g]
+
+    nonzero = fills.sum(axis=1) > 0
+    fills = fills[nonzero]
+    types_out = cand_types[tt[nonzero]]
+    # Dedup by 64-bit hash: the ka sweep × pair grid collapses ~15x (many
+    # seeds top off to the same maximal fill). Collision odds are ~J²/2⁶⁴.
+    keys = (fills.astype(np.uint64) * mixers[None, :]).sum(
+        axis=1, dtype=np.uint64
+    )
+    _, first = np.unique(keys, return_index=True)
+    first = np.sort(first)
+    return fills[first], types_out[first]
+
+
+def price_columns(
+    fills: np.ndarray,  # [J, G]
+    vectors: np.ndarray,  # [G, R]
+    capacity: np.ndarray,  # [T, R]
+    pool_floor: np.ndarray,  # [T]
+    block: int = 16,
+) -> np.ndarray:
+    """[J] cheapest pool price of any type whose usable capacity dominates
+    each column's demand — the price the launch realization actually pays
+    (demand-level dominance, sharper than full-capacity dominance).
+
+    Types are scanned in ascending price order and each column takes the
+    FIRST feasible hit (native ktpu_mix_price; block-scan numpy fallback) —
+    average work is a few dozen type checks per column, not J*T*R."""
+    demand = fills.astype(np.float64) @ vectors  # [J, R]
+    order = np.argsort(
+        np.where(np.isfinite(pool_floor), pool_floor, np.inf), kind="stable"
+    )
+    from karpenter_tpu.ops import native
+
+    native_prices = native.mix_price(demand, capacity, pool_floor, order)
+    if native_prices is not None:
+        return native_prices
+    prices = np.full(fills.shape[0], np.inf)
+    unpriced = np.arange(fills.shape[0])
+    for start in range(0, len(order), block):
+        if unpriced.size == 0:
+            break
+        types_block = order[start : start + block]
+        if not np.isfinite(pool_floor[types_block]).any():
+            break  # the rest of the order is unpriced types
+        feasible = (
+            capacity[types_block][None, :, :]
+            >= demand[unpriced][:, None, :] - 1e-6
+        ).all(axis=2)
+        hit = np.where(
+            feasible, pool_floor[types_block][None, :], np.inf
+        ).min(axis=1)
+        prices[unpriced] = hit
+        unpriced = unpriced[~np.isfinite(hit)]
+    return prices
+
+
+# Covering-LP column budget: HiGHS on [G, J] stays a few ms at this size.
+# Deduped enumerations usually fit under it, so the reduced-cost prune is a
+# backstop for pathological grids, not the normal path.
+MAX_LP_COLUMNS = 4096
+
+
+def aggregate_lp_bound(
+    capacity: np.ndarray,  # [T, R]
+    pool_floor: np.ndarray,  # [T] cheapest pool price per type
+    demand: np.ndarray,  # [R] total demand
+) -> Optional[Tuple[float, np.ndarray]]:
+    """The aggregate fractional LP: min Σ n_t·price_t s.t. the bought
+    capacity covers total demand (T variables, R constraints, ~1ms). Its
+    objective lower-bounds ANY feasible plan's projected cost (bin-packing
+    integrality only pushes real plans above it); its duals price each
+    resource unit. Returns (objective, dual_per_resource [R]) or None.
+    Shared by the column prune here and bench.py's published
+    cost_ratio_lowest_price_lp_bound — one formulation, one meaning."""
+    try:
+        from scipy.optimize import linprog
+    except Exception:  # pragma: no cover — scipy ships with jax
+        return None
+    result = linprog(
+        np.where(np.isfinite(pool_floor), pool_floor, 1e9),
+        A_ub=-capacity.T.astype(np.float64),
+        b_ub=-np.asarray(demand, np.float64),
+        bounds=(0, None),
+        method="highs",
+    )
+    if not result.success or result.ineqlin is None:
+        return None
+    return float(result.fun), -np.asarray(result.ineqlin.marginals)
+
+
+def _prune_columns(
+    fills: np.ndarray,
+    types: np.ndarray,
+    prices: np.ndarray,
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    pool_floor: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Keep the MAX_LP_COLUMNS most promising columns by reduced cost
+    against the aggregate LP's resource duals (aggregate_lp_bound). The
+    duals price each resource unit; a column whose dual value most exceeds
+    its price is the kind the covering LP will buy."""
+    if fills.shape[0] <= MAX_LP_COLUMNS:
+        return fills, types, prices
+    demand = (counts[:, None] * vectors).sum(axis=0)
+    bound = aggregate_lp_bound(capacity, pool_floor, demand)
+    value = None
+    if bound is not None:
+        group_value = vectors @ bound[1]  # [G]
+        value = fills @ group_value  # [J]
+    if value is None:
+        # No dual estimate: fall back to pods-covered per dollar.
+        with np.errstate(divide="ignore"):
+            value = fills.sum(axis=1) / np.maximum(prices, 1e-12)
+        keep = np.argsort(-value, kind="stable")[:MAX_LP_COLUMNS]
+    else:
+        keep = np.argsort(prices - value, kind="stable")[:MAX_LP_COLUMNS]
+    return fills[keep], types[keep], prices[keep]
+
+
+def solve_cover_lp(
+    fills: np.ndarray, prices: np.ndarray, counts: np.ndarray
+) -> Optional[np.ndarray]:
+    """Fractional covering LP via scipy HiGHS (a jax hard dependency);
+    None when unavailable or infeasible — callers fall back to pure greedy
+    integerization from x=0."""
+    try:
+        from scipy.optimize import linprog
+    except Exception:  # pragma: no cover — scipy ships with jax
+        return None
+    result = linprog(
+        prices,
+        A_ub=-fills.T.astype(np.float64),
+        b_ub=-counts.astype(np.float64),
+        bounds=(0, None),
+        method="highs",
+    )
+    if not result.success:
+        return None
+    return result.x
+
+
+def integerize_cover(
+    fills: np.ndarray,  # [J, G]
+    prices: np.ndarray,  # [J]
+    x_frac: Optional[np.ndarray],
+    counts: np.ndarray,  # [G]
+) -> Optional[np.ndarray]:
+    """Integral node counts per column covering `counts`: floor the LP,
+    greedily cover the residual by price per covered pod, then trim
+    overshoot off the most expensive columns. Returns [J] int64 or None
+    when some pods cannot be covered by any column."""
+    num_cols = fills.shape[0]
+    if num_cols == 0:
+        return None
+    cover_matrix = fills.astype(np.int64)
+    x = (
+        np.floor(x_frac + 1e-9).astype(np.int64)
+        if x_frac is not None
+        else np.zeros(num_cols, np.int64)
+    )
+    residual = np.maximum(counts - cover_matrix.T @ x, 0)
+    while residual.sum() > 0:
+        covered = np.minimum(cover_matrix, residual[None, :]).sum(axis=1)
+        with np.errstate(divide="ignore"):
+            score = np.where(covered > 0, prices / covered, np.inf)
+        j = int(np.argmin(score))
+        if not np.isfinite(score[j]):
+            return None  # residual pods fit no column
+        fill_j = cover_matrix[j]
+        with np.errstate(divide="ignore"):
+            repl = int(
+                np.min(
+                    np.where(
+                        fill_j > 0,
+                        residual // np.maximum(fill_j, 1),
+                        np.iinfo(np.int64).max,
+                    )
+                )
+            )
+        repl = max(repl, 1)
+        x[j] += repl
+        residual = np.maximum(residual - repl * fill_j, 0)
+    # Trim overshoot, most expensive used columns first.
+    slack = cover_matrix.T @ x - counts
+    used = np.nonzero(x)[0]
+    for j in used[np.argsort(-prices[used], kind="stable")]:
+        fill_j = cover_matrix[j]
+        with np.errstate(divide="ignore"):
+            removable = np.min(
+                np.where(
+                    fill_j > 0,
+                    slack // np.maximum(fill_j, 1),
+                    np.iinfo(np.int64).max,
+                )
+            )
+        k = int(min(x[j], max(removable, 0)))
+        if k > 0:
+            x[j] -= k
+            slack -= k * fill_j
+    return x
+
+
+def mix_candidate(
+    vectors: np.ndarray,
+    counts: np.ndarray,  # [G] SOLVABLE pods per group (infeasible zeroed)
+    capacity: np.ndarray,
+    pool_floor: np.ndarray,  # [T] cheapest advertised pool price
+    extra_columns: Optional[
+        List[Tuple[int, np.ndarray]]
+    ] = None,  # (type, fill) seeds, e.g. the kernel candidates' rounds
+) -> Optional[List[Tuple[int, np.ndarray, int]]]:
+    """The full column-LP pipeline → round list [(type, fill, repl)], with
+    fills clamped to remaining pods so coverage is exact (decode walks group
+    cursors and must never overrun). None when no plan covers the counts."""
+    counts = counts.astype(np.int64)
+    if counts.sum() == 0 or capacity.shape[0] == 0:
+        return None
+    fills, types = enumerate_pair_columns(vectors, counts, capacity, pool_floor)
+    if fills.shape[0]:
+        # Prune on COARSE prices first (type-capacity dominance, one [T, T]
+        # reduction), then exact-price only the survivors — exact
+        # demand-dominance pricing over the full enumeration would dominate
+        # the pipeline's runtime.
+        dominates = (
+            capacity[None, :, :] >= capacity[:, None, :] - 1e-6
+        ).all(axis=2)
+        effective = np.where(dominates, pool_floor[None, :], np.inf).min(axis=1)
+        coarse = effective[types]
+        usable = np.isfinite(coarse)
+        fills, types, coarse = fills[usable], types[usable], coarse[usable]
+        fills, types, _ = _prune_columns(
+            fills, types, coarse, vectors, counts, capacity, pool_floor
+        )
+        prices = price_columns(fills, vectors, capacity, pool_floor)
+        usable = np.isfinite(prices)
+        fills, types, prices = fills[usable], types[usable], prices[usable]
+    else:
+        prices = np.zeros((0,))
+    # Rescue columns: one single-group max-fill per group on its cheapest
+    # feasible type — guarantees every solvable group is coverable even when
+    # its only feasible types fell outside the pruned enumeration set.
+    # Appended AFTER pruning (with caller seeds) so they always survive.
+    rescue: List[Tuple[int, np.ndarray]] = []
+    for g in range(vectors.shape[0]):
+        if counts[g] <= 0:
+            continue
+        vec = vectors[g]
+        feasible = (capacity >= vec[None, :] - 1e-6).all(axis=1)
+        priced = np.where(feasible, pool_floor, np.inf)
+        t = int(np.argmin(priced))
+        if not np.isfinite(priced[t]):
+            # Feasible but unpriced type (no offering): still usable as a
+            # coverage column — fall back to any feasible type.
+            feasible_idx = np.nonzero(feasible)[0]
+            if feasible_idx.size == 0:
+                continue
+            t = int(feasible_idx[0])
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ratio = np.where(
+                vec > 0, capacity[t] / np.where(vec > 0, vec, 1.0), np.inf
+            )
+        k = int(
+            min(max(np.floor(ratio.min() + _EPS), 1.0), float(counts[g]))
+        )
+        fill = np.zeros(vectors.shape[0], np.int64)
+        fill[g] = k
+        rescue.append((t, fill))
+    extras = list(extra_columns or []) + rescue
+    if extras:
+        seed_fills = np.stack([np.asarray(f, np.int64) for _, f in extras])
+        seed_types = np.asarray([t for t, _ in extras], np.int32)
+        seed_prices = price_columns(seed_fills, vectors, capacity, pool_floor)
+        usable = np.isfinite(seed_prices)
+        fills = (
+            np.concatenate([fills, seed_fills[usable]])
+            if fills.size
+            else seed_fills[usable]
+        )
+        types = (
+            np.concatenate([types, seed_types[usable]])
+            if types.size
+            else seed_types[usable]
+        )
+        prices = (
+            np.concatenate([prices, seed_prices[usable]])
+            if prices.size
+            else seed_prices[usable]
+        )
+    if fills.shape[0] == 0:
+        return None
+    x = integerize_cover(
+        fills, prices, solve_cover_lp(fills, prices, counts), counts
+    )
+    if x is None:
+        return None
+
+    # Emit rounds cheapest-first, clamping to remaining pods: expensive
+    # columns absorb the trim, and coverage comes out exact (the integral x
+    # covers counts per group, and clamping only drops pods a group no
+    # longer needs, so the walk always drains `remaining` to zero).
+    remaining = counts.copy()
+    rounds: List[Tuple[int, np.ndarray, int]] = []
+    used = np.nonzero(x)[0]
+    for j in used[np.argsort(prices[used], kind="stable")]:
+        budget = int(x[j])
+        fill = fills[j]
+        while budget > 0 and remaining.sum() > 0:
+            clamped = np.minimum(fill, remaining)
+            if clamped.sum() == 0:
+                break
+            if np.array_equal(clamped, fill):
+                with np.errstate(divide="ignore"):
+                    full = int(
+                        np.min(
+                            np.where(
+                                fill > 0,
+                                remaining // np.maximum(fill, 1),
+                                np.iinfo(np.int64).max,
+                            )
+                        )
+                    )
+                take = min(budget, max(full, 1))
+                rounds.append((int(types[j]), fill.copy(), take))
+                remaining -= take * fill
+                budget -= take
+            else:
+                rounds.append((int(types[j]), clamped.copy(), 1))
+                remaining -= clamped
+                budget -= 1
+    if remaining.sum() != 0:
+        return None  # defensive: exact cover failed
+    return rounds

@@ -89,6 +89,51 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),  # round_repl
             ctypes.c_int,  # max_rounds
         ]
+        lib.ktpu_mix_enumerate.restype = ctypes.c_int
+        lib.ktpu_mix_enumerate.argtypes = [
+            ctypes.POINTER(ctypes.c_float),  # vectors
+            ctypes.POINTER(ctypes.c_int64),  # counts
+            ctypes.c_int,  # num_groups
+            ctypes.c_int,  # dims
+            ctypes.POINTER(ctypes.c_float),  # capacity (pre-gathered cands)
+            ctypes.c_int,  # num_cand
+            ctypes.POINTER(ctypes.c_int),  # seed_groups
+            ctypes.c_int,  # num_seeds
+            ctypes.POINTER(ctypes.c_float),  # fracs
+            ctypes.c_int,  # num_fracs
+            ctypes.POINTER(ctypes.c_uint64),  # hash mixers
+            ctypes.POINTER(ctypes.c_int64),  # out fills
+            ctypes.POINTER(ctypes.c_int),  # out type (candidate index)
+            ctypes.c_int,  # max_out
+        ]
+        lib.ktpu_pool_select.restype = None
+        lib.ktpu_pool_select.argtypes = [
+            ctypes.POINTER(ctypes.c_double),  # demand [F x D]
+            ctypes.c_int,  # num_fills
+            ctypes.c_int,  # dims
+            ctypes.POINTER(ctypes.c_float),  # capacity
+            ctypes.POINTER(ctypes.c_int),  # row_types
+            ctypes.POINTER(ctypes.c_double),  # row_prices
+            ctypes.c_int,  # num_rows
+            ctypes.c_int,  # max_rows
+            ctypes.c_int,  # min_rows
+            ctypes.c_double,  # band
+            ctypes.c_double,  # ceiling_ratio
+            ctypes.c_int,  # max_types
+            ctypes.POINTER(ctypes.c_int),  # out_rows [F x max_rows]
+            ctypes.POINTER(ctypes.c_int),  # out_counts [F]
+        ]
+        lib.ktpu_mix_price.restype = None
+        lib.ktpu_mix_price.argtypes = [
+            ctypes.POINTER(ctypes.c_double),  # demand [J x D]
+            ctypes.c_int,  # num_cols
+            ctypes.c_int,  # dims
+            ctypes.POINTER(ctypes.c_float),  # capacity
+            ctypes.POINTER(ctypes.c_double),  # pool_floor
+            ctypes.POINTER(ctypes.c_int),  # order (price-ascending)
+            ctypes.c_int,  # num_types
+            ctypes.POINTER(ctypes.c_double),  # out prices
+        ]
         _lib = lib
         return _lib
 
@@ -223,3 +268,134 @@ def lp_realize(
         (int(round_type[r]), round_fill[r, :num_groups].copy(), int(round_repl[r]))
         for r in range(rounds)
     ]
+
+
+def mix_enumerate(
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    cand_capacity: np.ndarray,  # [C, D] pre-gathered candidate-type capacity
+    seed_groups: np.ndarray,
+    fracs: np.ndarray,
+    mixers: np.ndarray,  # [G] uint64 hash multipliers (dedup key)
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native pair-seeded fill enumeration for the column-LP mix candidate
+    (ops/mix_pack.py). Returns (fills [J, G] int64, candidate index [J]
+    int32) deduped, or None when the library is unavailable / overflow."""
+    lib = load()
+    if lib is None:
+        return None
+    vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    cand_capacity = np.ascontiguousarray(cand_capacity, dtype=np.float32)
+    seed_groups = np.ascontiguousarray(seed_groups, dtype=np.int32)
+    fracs = np.ascontiguousarray(fracs, dtype=np.float32)
+    mixers = np.ascontiguousarray(mixers, dtype=np.uint64)
+    num_groups, dims = vectors.shape
+    num_cand = cand_capacity.shape[0]
+    max_out = num_cand * len(seed_groups) * len(fracs) * len(seed_groups) + 1
+    out_fills = np.empty((max_out, max(num_groups, 1)), dtype=np.int64)
+    out_type = np.empty(max_out, dtype=np.int32)
+
+    def ptr(array, ctype):
+        return array.ctypes.data_as(ctypes.POINTER(ctype))
+
+    written = lib.ktpu_mix_enumerate(
+        ptr(vectors, ctypes.c_float),
+        ptr(counts, ctypes.c_int64),
+        num_groups,
+        dims,
+        ptr(cand_capacity, ctypes.c_float),
+        num_cand,
+        ptr(seed_groups, ctypes.c_int),
+        len(seed_groups),
+        ptr(fracs, ctypes.c_float),
+        len(fracs),
+        ptr(mixers, ctypes.c_uint64),
+        ptr(out_fills, ctypes.c_int64),
+        ptr(out_type, ctypes.c_int),
+        max_out,
+    )
+    if written < 0:
+        return None
+    return out_fills[:written].copy(), out_type[:written].copy()
+
+
+def mix_price(
+    demand: np.ndarray,  # [J, D] float64 column demand
+    capacity: np.ndarray,  # [T, D]
+    pool_floor: np.ndarray,  # [T] float64
+    order: np.ndarray,  # [T] int32 type indices, price-ascending
+) -> Optional[np.ndarray]:
+    """Native demand-dominance pricing (first feasible type in price order).
+    Returns [J] float64 prices or None when the library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    demand = np.ascontiguousarray(demand, dtype=np.float64)
+    capacity = np.ascontiguousarray(capacity, dtype=np.float32)
+    pool_floor = np.ascontiguousarray(pool_floor, dtype=np.float64)
+    order = np.ascontiguousarray(order, dtype=np.int32)
+    num_cols, dims = demand.shape
+    out = np.empty(num_cols, dtype=np.float64)
+
+    def ptr(array, ctype):
+        return array.ctypes.data_as(ctypes.POINTER(ctype))
+
+    lib.ktpu_mix_price(
+        ptr(demand, ctypes.c_double),
+        num_cols,
+        dims,
+        ptr(capacity, ctypes.c_float),
+        ptr(pool_floor, ctypes.c_double),
+        ptr(order, ctypes.c_int),
+        capacity.shape[0],
+        ptr(out, ctypes.c_double),
+    )
+    return out
+
+
+def pool_select_batch(
+    demand: np.ndarray,  # [F, D] float64 per-fill demand
+    capacity: np.ndarray,  # [T, D]
+    row_types: np.ndarray,  # [N] int32 global price-sorted pool order
+    row_prices: np.ndarray,  # [N] float64
+    max_rows: int,
+    min_rows: int,
+    band: float,
+    ceiling_ratio: float,
+    max_types: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native batched pool selection (ktpu_pool_select). Returns
+    (selected row indices [F, max_rows], counts [F]; count -1 = no feasible
+    row) or None when the library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    demand = np.ascontiguousarray(demand, dtype=np.float64)
+    capacity = np.ascontiguousarray(capacity, dtype=np.float32)
+    row_types = np.ascontiguousarray(row_types, dtype=np.int32)
+    row_prices = np.ascontiguousarray(row_prices, dtype=np.float64)
+    num_fills, dims = demand.shape
+    out_rows = np.empty((num_fills, max_rows), dtype=np.int32)
+    out_counts = np.empty(num_fills, dtype=np.int32)
+
+    def ptr(array, ctype):
+        return array.ctypes.data_as(ctypes.POINTER(ctype))
+
+    lib.ktpu_pool_select(
+        ptr(demand, ctypes.c_double),
+        num_fills,
+        dims,
+        ptr(capacity, ctypes.c_float),
+        ptr(row_types, ctypes.c_int),
+        ptr(row_prices, ctypes.c_double),
+        len(row_types),
+        max_rows,
+        min_rows,
+        band,
+        ceiling_ratio,
+        max_types,
+        ptr(out_rows, ctypes.c_int),
+        ptr(out_counts, ctypes.c_int),
+    )
+    return out_rows, out_counts

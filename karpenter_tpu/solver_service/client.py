@@ -74,6 +74,13 @@ class RemoteSolver(Solver):
         self.blackout_s = blackout_s
         self.clock = clock
         self._blackout_until = -float("inf")
+        # Until the sidecar's boot warmup finishes (health status
+        # "warming"), solves go straight to host fallback WITHOUT arming
+        # the failure blackout: a warming sidecar is healthy-but-not-ready,
+        # and the first live batch must not pay its jit compile. Checked
+        # once; an "ok" sticks for the client's lifetime (readiness probes
+        # own steady-state gating).
+        self._warm_verified = False
         self._channel = grpc.insecure_channel(endpoint)
         self._solve_rpc = self._channel.unary_unary(
             wire.SOLVE_METHOD,
@@ -96,6 +103,25 @@ class RemoteSolver(Solver):
             return self._health_rpc(pb.HealthRequest(), timeout=timeout_s)
         except grpc.RpcError:
             return None
+
+    def _check_warm(self) -> bool:
+        """True once the sidecar has reported status "ok" (warmup done).
+        While it reports "warming", callers host-solve WITHOUT arming the
+        blackout — the sidecar is healthy, just precompiling; the next
+        batch re-checks. An unreachable sidecar returns False here and the
+        solve path's own RPC failure handling owns the blackout."""
+        if self._warm_verified:
+            return True
+        health = self.healthy(timeout_s=1.0)
+        if health is None or health.status == "ok":
+            # Unreachable: proceed to the RPC (its error path arms the
+            # blackout properly). "ok": verified warm.
+            self._warm_verified = health is not None
+            return True
+        log.info(
+            "sidecar %s warming; host-solving this batch", self.endpoint
+        )
+        return False
 
     def _build_request(self, groups: PodGroups, fleet: InstanceFleet):
         zones, pool_prices = _pool_price_matrix(fleet)
@@ -122,6 +148,8 @@ class RemoteSolver(Solver):
         if not items:
             return []
         if self.clock() < self._blackout_until:
+            return self.fallback.solve_encoded_many(items)
+        if not self._check_warm():
             return self.fallback.solve_encoded_many(items)
         built = [self._build_request(groups, fleet) for groups, fleet in items]
         start = self.clock()
@@ -180,6 +208,8 @@ class RemoteSolver(Solver):
 
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         if self.clock() < self._blackout_until:
+            return self.fallback.solve_encoded(groups, fleet)
+        if not self._check_warm():
             return self.fallback.solve_encoded(groups, fleet)
 
         request, zones = self._build_request(groups, fleet)

@@ -22,6 +22,8 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -222,6 +224,187 @@ int ktpu_lp_realize(const float* vectors, int num_groups, int dims,
     }
   }
   return rounds;
+}
+
+// Pair-seeded maximal-fill enumeration for the column-LP mix candidate
+// (karpenter_tpu/ops/mix_pack.py): for each (candidate type, seed group a,
+// ka fraction, seed group b), place ka pods of a, max-fill with b, then top
+// off first-fit over all groups — the complementary-pair structure a greedy
+// packer cannot see. Fills are deduped in-line (64-bit multiplicative hash;
+// the ka sweep collapses ~10-15x). Returns fills written, or -1 on
+// max_out overflow.
+//
+// capacity here is [num_cand x dims], pre-gathered to the pruned candidate
+// types by the caller; mixers is [num_groups] of odd 64-bit hash
+// multipliers (shared with the Python fallback so dedup matches).
+int ktpu_mix_enumerate(const float* vectors, const int64_t* counts,
+                       int num_groups, int dims, const float* capacity,
+                       int num_cand, const int* seed_groups, int num_seeds,
+                       const float* fracs, int num_fracs,
+                       const uint64_t* mixers, int64_t* out_fills,
+                       int* out_type, int max_out) {
+  std::unordered_set<uint64_t> seen;
+  seen.reserve(static_cast<size_t>(num_cand) * num_seeds * 2);
+  std::vector<double> remaining(dims);
+  std::vector<int64_t> fill(num_groups);
+  int written = 0;
+
+  auto max_fit = [&](const float* need, int64_t limit) -> int64_t {
+    int64_t n = limit;
+    for (int d = 0; d < dims; ++d) {
+      if (need[d] > 0.0f) {
+        double q = std::floor(remaining[d] / need[d] + 1e-4);
+        int64_t qi = q <= 0.0 ? 0 : static_cast<int64_t>(q);
+        if (qi < n) n = qi;
+      }
+    }
+    return n < 0 ? 0 : n;
+  };
+
+  for (int ci = 0; ci < num_cand; ++ci) {
+    const float* cap_row = capacity + static_cast<size_t>(ci) * dims;
+    for (int si = 0; si < num_seeds; ++si) {
+      int a = seed_groups[si];
+      const float* va = vectors + static_cast<size_t>(a) * dims;
+      for (int d = 0; d < dims; ++d) remaining[d] = cap_row[d];
+      int64_t ka_cap = max_fit(va, counts[a]);
+      for (int fi = 0; fi < num_fracs; ++fi) {
+        int64_t ka =
+            static_cast<int64_t>(std::floor(fracs[fi] * double(ka_cap) + 1e-9));
+        for (int sj = 0; sj < num_seeds; ++sj) {
+          int b = seed_groups[sj];
+          std::memset(fill.data(), 0, sizeof(int64_t) * num_groups);
+          for (int d = 0; d < dims; ++d)
+            remaining[d] = cap_row[d] - double(va[d]) * ka;
+          fill[a] = ka;
+          if (b != a) {
+            const float* vb = vectors + static_cast<size_t>(b) * dims;
+            int64_t kb = max_fit(vb, counts[b]);
+            if (kb > 0) {
+              fill[b] = kb;
+              for (int d = 0; d < dims; ++d) remaining[d] -= double(vb[d]) * kb;
+            }
+          }
+          // First-fit top-off in (descending-size) group order.
+          int64_t packed = 0;
+          for (int g = 0; g < num_groups; ++g) {
+            if (counts[g] <= fill[g]) { packed += fill[g]; continue; }
+            const float* vg = vectors + static_cast<size_t>(g) * dims;
+            int64_t n = max_fit(vg, counts[g] - fill[g]);
+            if (n > 0) {
+              fill[g] += n;
+              for (int d = 0; d < dims; ++d) remaining[d] -= double(vg[d]) * n;
+            }
+            packed += fill[g];
+          }
+          if (packed == 0) continue;
+          uint64_t key = 0;
+          for (int g = 0; g < num_groups; ++g)
+            key += static_cast<uint64_t>(fill[g]) * mixers[g];
+          if (!seen.insert(key).second) continue;
+          if (written >= max_out) return -1;
+          std::memcpy(out_fills + static_cast<size_t>(written) * num_groups,
+                      fill.data(), sizeof(int64_t) * num_groups);
+          out_type[written] = ci;
+          ++written;
+        }
+      }
+    }
+  }
+  return written;
+}
+
+// Exact demand-dominance column pricing for the mix candidate: for each
+// column (its demand pre-computed by the caller), the cheapest pool of any
+// type whose usable capacity covers the demand. `order` lists type indices
+// ascending by pool price, so the scan breaks at the first feasible type —
+// average work is a few dozen type checks per column, not num_types.
+void ktpu_mix_price(const double* demand /* [J x dims] */, int num_cols,
+                    int dims, const float* capacity /* [T x dims] */,
+                    const double* pool_floor /* [T] */,
+                    const int* order /* [T] price-ascending */, int num_types,
+                    double* out_prices /* [J] */) {
+  for (int j = 0; j < num_cols; ++j) {
+    const double* d = demand + static_cast<size_t>(j) * dims;
+    double price = std::numeric_limits<double>::infinity();
+    for (int oi = 0; oi < num_types; ++oi) {
+      int t = order[oi];
+      if (!std::isfinite(pool_floor[t])) break;  // rest of order is unpriced
+      const float* cap = capacity + static_cast<size_t>(t) * dims;
+      bool ok = true;
+      for (int r = 0; r < dims; ++r) {
+        if (double(cap[r]) < d[r] - 1e-6) { ok = false; break; }
+      }
+      if (ok) { price = pool_floor[t]; break; }
+    }
+    out_prices[j] = price;
+  }
+}
+
+// Batched launch-pool selection (models/solver._cheapest_feasible_pools
+// semantics, bit-for-bit): for each fill's demand, walk the global
+// price-sorted pool-row order, keep rows of the first `max_types` distinct
+// feasible types, and stop at the first row hitting the row budget, the
+// price band past the row floor, or the price ceiling. The per-fill Python
+// form costs ~0.2ms in numpy-call overhead; the finish phase calls it for
+// ~100 distinct fills per solve, so this batch form keeps candidate
+// scoring off the solve's critical path.
+//
+// out_rows is [F x max_rows] indices into the order arrays; out_counts[f]
+// is the selected count, or -1 when NO pool row is feasible (caller falls
+// back to the anchor type's options).
+void ktpu_pool_select(const double* demand /* [F x dims] */, int num_fills,
+                      int dims, const float* capacity /* [T x dims] */,
+                      const int* row_types /* [N] */,
+                      const double* row_prices /* [N] */, int num_rows,
+                      int max_rows, int min_rows, double band,
+                      double ceiling_ratio, int max_types,
+                      int* out_rows, int* out_counts) {
+  std::vector<int8_t> type_state;  // 0 unknown, 1 feasible, 2 infeasible
+  int num_types = 0;
+  for (int i = 0; i < num_rows; ++i) {
+    if (row_types[i] >= num_types) num_types = row_types[i] + 1;
+  }
+  std::vector<int8_t> admitted(num_types);
+
+  for (int f = 0; f < num_fills; ++f) {
+    const double* d = demand + static_cast<size_t>(f) * dims;
+    type_state.assign(num_types, 0);
+    std::memset(admitted.data(), 0, num_types);
+    int distinct = 0;
+    int count = 0;
+    double cheapest = -1.0;
+    int* out = out_rows + static_cast<size_t>(f) * max_rows;
+    out_counts[f] = -1;
+    for (int i = 0; i < num_rows; ++i) {
+      int t = row_types[i];
+      int8_t state = type_state[t];
+      if (state == 0) {
+        const float* cap = capacity + static_cast<size_t>(t) * dims;
+        state = 1;
+        for (int r = 0; r < dims; ++r) {
+          if (double(cap[r]) < d[r] - 1e-6) { state = 2; break; }
+        }
+        type_state[t] = state;
+      }
+      if (state == 2) continue;
+      double price = row_prices[i];
+      if (cheapest < 0.0) cheapest = price;  // first feasible row
+      // Stop conditions on the count of rows appended so far (count_excl).
+      if (count >= max_rows) break;
+      if (price > cheapest * (1.0 + band) && count >= min_rows) break;
+      if (price > cheapest * ceiling_ratio && count >= 1) break;
+      if (!admitted[t]) {
+        if (distinct >= max_types) continue;  // skipped, not counted
+        admitted[t] = 1;
+        ++distinct;
+      }
+      out[count++] = i;
+      out_counts[f] = count;
+    }
+    if (cheapest < 0.0) out_counts[f] = -1;  // nothing feasible at all
+    else if (out_counts[f] < 0) out_counts[f] = 0;
+  }
 }
 
 }  // extern "C"

@@ -1,0 +1,287 @@
+"""Column-LP mix packing (ops/mix_pack.py): the host-overlap candidate that
+jointly chooses node-fill configurations — complementary-pair fills a greedy
+pass cannot see. Correctness invariants: exact cover, count respect, native
+and numpy enumerations agreeing, rescue coverage for types outside the
+pruned enumeration set, and a solver-level win on complementary workloads.
+
+Ref: the reference's packer (binpacking/packer.go:82-189) is one greedy
+pass; there is no analogue of this configuration LP there — it is the cost
+edge over the reference's plan quality.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.ops import mix_pack, native
+
+
+def simple_problem():
+    """Two complementary groups (cpu-heavy + mem-heavy) and types where
+    mixing pairs beats per-group packing."""
+    # dims: cpu(m), mem(Mi), pods
+    vectors = np.array(
+        [
+            [3500.0, 2048.0, 1.0],  # cpu-heavy
+            [500.0, 6144.0, 1.0],  # mem-heavy
+        ],
+        np.float32,
+    )
+    counts = np.array([40, 40], np.int64)
+    capacity = np.array(
+        [
+            [4000.0, 8192.0, 32.0],  # fits one of EACH — the pair node
+            [4000.0, 3072.0, 32.0],  # cpu node: one cpu-heavy only
+            [1024.0, 8192.0, 32.0],  # mem node: one mem-heavy only
+        ],
+        np.float32,
+    )
+    pool_floor = np.array([0.20, 0.17, 0.12])
+    return vectors, counts, capacity, pool_floor
+
+
+class TestEnumeration:
+    def test_native_and_numpy_enumerations_agree(self):
+        vectors, counts, capacity, pool_floor = simple_problem()
+        cand = mix_pack._candidate_types(capacity, pool_floor)
+        seeds = mix_pack._seed_groups(vectors, counts)
+        mixers = mix_pack._hash_mixers(vectors.shape[0])
+        native_result = native.mix_enumerate(
+            vectors,
+            counts,
+            capacity[cand],
+            seeds,
+            np.asarray(mix_pack.KA_FRACS, np.float32),
+            mixers,
+        )
+        if native_result is None:
+            pytest.skip("native toolchain unavailable")
+        np_fills, np_types = mix_pack._enumerate_pair_columns_numpy(
+            vectors, counts, capacity, cand, seeds, mixers
+        )
+        nat_fills = native_result[0]
+        as_set = lambda f: {tuple(row) for row in f}  # noqa: E731
+        assert as_set(nat_fills) == as_set(np_fills)
+
+    def test_pair_column_exists(self):
+        """The enumeration must produce the complementary 1+1 fill on the
+        pair type — the configuration greedy passes never build."""
+        vectors, counts, capacity, pool_floor = simple_problem()
+        fills, types = mix_pack.enumerate_pair_columns(
+            vectors, counts, capacity, pool_floor
+        )
+        assert any((f[0] >= 1 and f[1] >= 1) for f in fills)
+
+    def test_fills_respect_capacity_and_counts(self):
+        vectors, counts, capacity, pool_floor = simple_problem()
+        fills, types = mix_pack.enumerate_pair_columns(
+            vectors, counts, capacity, pool_floor
+        )
+        for fill, t in zip(fills, types):
+            demand = fill.astype(np.float64) @ vectors
+            assert (demand <= capacity[t] + 1e-3).all(), (fill, t)
+            assert (fill <= counts).all()
+
+
+class TestPricing:
+    def test_price_is_cheapest_dominating_pool(self):
+        vectors, counts, capacity, pool_floor = simple_problem()
+        # one mem-heavy pod: fits type 0 (0.20) and type 2 (0.12) -> 0.12
+        fills = np.array([[0, 1]], np.int64)
+        prices = mix_pack.price_columns(
+            fills, vectors[:, :3], capacity, pool_floor
+        )
+        assert prices[0] == pytest.approx(0.12)
+        # the pair fill fits only type 0
+        pair = np.array([[1, 1]], np.int64)
+        prices = mix_pack.price_columns(
+            pair, vectors[:, :3], capacity, pool_floor
+        )
+        assert prices[0] == pytest.approx(0.20)
+
+    def test_infeasible_everywhere_is_inf(self):
+        vectors, counts, capacity, pool_floor = simple_problem()
+        fills = np.array([[10, 10]], np.int64)  # far beyond any capacity
+        prices = mix_pack.price_columns(
+            fills, vectors[:, :3], capacity, pool_floor
+        )
+        assert np.isinf(prices[0])
+
+
+class TestMixCandidate:
+    def test_exact_cover(self):
+        vectors, counts, capacity, pool_floor = simple_problem()
+        rounds = mix_pack.mix_candidate(vectors, counts, capacity, pool_floor)
+        assert rounds is not None
+        covered = np.zeros_like(counts)
+        for t, fill, repl in rounds:
+            assert repl > 0
+            demand = fill.astype(np.float64) @ vectors
+            assert (demand <= capacity[t] + 1e-3).all()
+            covered += repl * fill
+        assert (covered == counts).all()
+
+    def test_prefers_pair_node_over_split(self):
+        """40+40 complementary pods: pair nodes cost 40*0.20=8.0; split
+        packing costs 40*0.17 + 40*0.12 = 11.6. The LP must choose pairs."""
+        vectors, counts, capacity, pool_floor = simple_problem()
+        rounds = mix_pack.mix_candidate(vectors, counts, capacity, pool_floor)
+        cost = sum(
+            repl
+            * float(
+                mix_pack.price_columns(
+                    fill[None, :], vectors, capacity, pool_floor
+                )[0]
+            )
+            for t, fill, repl in rounds
+        )
+        assert cost == pytest.approx(40 * 0.20, rel=0.05)
+
+    def test_rescue_covers_type_outside_pruned_set(self):
+        """A group feasible only on a type the efficiency pruning would
+        drop: the rescue column must keep the plan coverable."""
+        rng = np.random.default_rng(7)
+        num_small = mix_pack.TYPES_BUDGET + 8
+        # Many tiny, hyper-efficient types none of which fit the big pod...
+        capacity = np.concatenate(
+            [
+                np.column_stack(
+                    [
+                        rng.uniform(900, 1100, num_small),
+                        rng.uniform(900, 1100, num_small),
+                        np.full(num_small, 10.0),
+                    ]
+                ),
+                # ...and ONE huge, price-inefficient type that does.
+                np.array([[50000.0, 50000.0, 10.0]]),
+            ]
+        ).astype(np.float32)
+        pool_floor = np.concatenate(
+            [rng.uniform(0.01, 0.02, num_small), [9.0]]
+        )
+        vectors = np.array([[20000.0, 20000.0, 1.0]], np.float32)
+        counts = np.array([5], np.int64)
+        cand = mix_pack._candidate_types(capacity, pool_floor)
+        assert num_small not in cand  # the big type was pruned
+        rounds = mix_pack.mix_candidate(vectors, counts, capacity, pool_floor)
+        assert rounds is not None
+        covered = sum(repl * fill[0] for _, fill, repl in rounds)
+        assert covered == 5
+        assert all(t == num_small for t, _, _ in rounds)
+
+    def test_none_when_nothing_fits(self):
+        vectors = np.array([[100.0, 100.0, 1.0]], np.float32)
+        counts = np.array([3], np.int64)
+        capacity = np.array([[10.0, 10.0, 10.0]], np.float32)
+        assert (
+            mix_pack.mix_candidate(
+                vectors, counts, capacity, np.array([0.1])
+            )
+            is None
+        )
+
+    def test_greedy_fallback_without_lp(self, monkeypatch):
+        """With the covering LP unavailable, pure greedy integerization must
+        still produce an exact cover."""
+        monkeypatch.setattr(mix_pack, "solve_cover_lp", lambda *a: None)
+        vectors, counts, capacity, pool_floor = simple_problem()
+        rounds = mix_pack.mix_candidate(vectors, counts, capacity, pool_floor)
+        assert rounds is not None
+        covered = np.zeros_like(counts)
+        for _, fill, repl in rounds:
+            covered += repl * fill
+        assert (covered == counts).all()
+
+
+class TestPoolSelectParity:
+    def test_native_batch_matches_numpy_walk(self):
+        """ktpu_pool_select must be bit-identical to the per-fill
+        _cheapest_feasible_pools selection across random fleets/fills."""
+        from karpenter_tpu.models import solver as S
+        from karpenter_tpu.ops import ffd as ffd_mod
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(11)
+        for trial in range(8):
+            num_groups, num_types, num_zones = 6, 40, 3
+            vectors = np.zeros((num_groups, 4), np.float32)
+            vectors[:, 0] = rng.integers(1, 9, num_groups) * 250
+            vectors[:, 1] = rng.integers(1, 17, num_groups) * 256
+            vectors[:, 2] = 1.0
+            capacity = np.zeros((num_types, 4), np.float32)
+            sizes = rng.integers(1, 33, num_types)
+            capacity[:, 0] = 2000.0 * sizes
+            capacity[:, 1] = 4096.0 * sizes
+            capacity[:, 2] = 110.0
+            pool_prices = rng.uniform(0.05, 3.0, (num_types, num_zones))
+            pool_prices[rng.random((num_types, num_zones)) < 0.2] = np.inf
+            pool_order = S.sort_pool_rows(pool_prices)
+            fills = rng.integers(0, 4, (12, num_groups)).astype(np.int64)
+            fills[0] = 0
+            fills[1] = 9999  # infeasible everywhere
+            demand = fills.astype(np.float64) @ vectors
+            out = native.pool_select_batch(
+                demand,
+                capacity,
+                pool_order[0],
+                pool_order[2],
+                S.MAX_POOL_ROWS,
+                S.MIN_POOL_ROWS,
+                S.POOL_PRICE_BAND,
+                S.MAX_POOL_PRICE_RATIO,
+                ffd_mod.MAX_INSTANCE_TYPES,
+            )
+            assert out is not None
+            out_rows, out_counts = out
+            for f, fill in enumerate(fills):
+                if fill.sum() == 0:
+                    continue
+                want_types, want_rows = S._cheapest_feasible_pools(
+                    fill, 0, vectors, capacity, pool_prices, pool_order
+                )
+                if want_rows is None:
+                    assert out_counts[f] < 0
+                    continue
+                got_rows = [
+                    (
+                        int(pool_order[0][i]),
+                        int(pool_order[1][i]),
+                        float(pool_order[2][i]),
+                    )
+                    for i in out_rows[f, : out_counts[f]]
+                ]
+                assert got_rows == want_rows, (trial, f)
+
+
+class TestSolverIntegration:
+    def test_cost_solver_wins_on_complementary_workload(self):
+        """End-to-end through CostSolver: on a workload whose optimum needs
+        pair mixing, the solve must beat the greedy baseline's projected
+        cost by the pair margin, all pods scheduled exactly once."""
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.models.solver import CostSolver, GreedySolver
+        from tests import fixtures
+
+        catalog = [
+            fixtures.cpu_instance("pair", cpu=4, mem_gib=8, price=0.20),
+            fixtures.cpu_instance("cpuish", cpu=4, mem_gib=3, price=0.17),
+            fixtures.cpu_instance("memish", cpu=1, mem_gib=8, price=0.12),
+        ]
+        pods = [
+            fixtures.pod(name=f"cpu-{i}", cpu="3500m", memory="2Gi")
+            for i in range(40)
+        ] + [
+            fixtures.pod(name=f"mem-{i}", cpu="400m", memory="6Gi")
+            for i in range(40)
+        ]
+        constraints = Constraints()
+        cost = CostSolver().solve(pods, catalog, constraints)
+        greedy = GreedySolver().solve(pods, catalog, constraints)
+        assert not cost.unschedulable
+        packed = sum(
+            len(pods_on_node)
+            for p in cost.packings
+            for pods_on_node in p.pods_per_node
+        )
+        assert packed == len(pods)
+        assert cost.projected_cost() < greedy.projected_cost() * 0.9

@@ -100,7 +100,7 @@ class TestPipelineWiring:
 
         monkeypatch.setattr(client_mod, "TRACER", tracer)
         monkeypatch.setattr(server_mod, "TRACER", tracer)
-        server = SolverServer(port=0).start()
+        server = SolverServer(port=0).start(warmup=False)
         try:
             remote = RemoteSolver(f"127.0.0.1:{server.port}")
             remote.solve(fixtures.pods(6), fixtures.size_ladder(3), Constraints())
