@@ -214,18 +214,45 @@ class Scheduler:
         self.topology.inject(constraints, [shadow for _, shadow in work])
         schedules: Dict[Tuple, Schedule] = {}
         ordered: List[Schedule] = []
+        # validate+tighten depend only on the shadow's tolerations and
+        # scheduling requirements (post-topology-injection), so identical
+        # pods — the bulk of any storm — share ONE evaluation instead of a
+        # per-pod Requirements merge/consolidate pass (measured: ~1.3s of a
+        # 10k-pod storm's drain was spent re-tightening 5 identical specs
+        # 2000x each).
+        _INCOMPATIBLE = object()
+        evaluated: Dict[Tuple, object] = {}
         for pod, shadow in work:
-            try:
-                constraints.validate_pod(shadow)
-            except PodIncompatibleError:
-                continue  # logged-and-skipped in the reference (scheduler.go:96)
-            tightened = constraints.tighten(shadow)
+            signature = (
+                tuple(
+                    (t.key, t.operator, t.value, t.effect)
+                    for t in shadow.tolerations
+                ),
+                tuple(
+                    (r.key, r.operator, tuple(r.values))
+                    for r in shadow.scheduling_requirements()
+                ),
+            )
+            entry = evaluated.get(signature)
+            if entry is None:
+                try:
+                    constraints.validate_pod(shadow)
+                except PodIncompatibleError:
+                    # logged-and-skipped in the reference (scheduler.go:96)
+                    evaluated[signature] = _INCOMPATIBLE
+                    continue
+                tightened = constraints.tighten(shadow)
+                entry = (tightened, tightened.requirements.canonical_key())
+                evaluated[signature] = entry
+            elif entry is _INCOMPATIBLE:
+                continue
+            tightened, canonical = entry
             accelerators = frozenset(
                 name
                 for name in wellknown.ACCELERATOR_RESOURCES
                 if pod.requests.get(name, 0) > 0
             )
-            key = (tightened.requirements.canonical_key(), accelerators)
+            key = (canonical, accelerators)
             schedule = schedules.get(key)
             if schedule is None:
                 schedule = Schedule(constraints=tightened)

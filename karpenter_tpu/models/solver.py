@@ -386,11 +386,6 @@ def _pool_zones(fleet: InstanceFleet) -> List[str]:
     )
 
 
-def _pool_matrix_of(fleet: InstanceFleet) -> np.ndarray:
-    """Thunk form for _HostOverlap items: just the [T, Z] matrix."""
-    return _pool_price_matrix(fleet)[1]
-
-
 def _pool_price_matrix(fleet: InstanceFleet) -> Tuple[List[str], np.ndarray]:
     """[T, Z] price of each type's pool per zone at the fleet's capacity type
     (inf where not offered), computed once per solve so per-round option
@@ -1215,16 +1210,24 @@ class CostSolver(Solver):
         if pending:
             # Per-schedule host work (pool matrices + mix candidates) runs in
             # a worker thread concurrently with the ONE blocking batch fetch,
-            # exactly like the single-solve path.
+            # exactly like the single-solve path. The thunks stash each
+            # fleet's zone axis so the finish loop doesn't rebuild it.
+            zones_box: List[Optional[List[str]]] = [None] * len(pending)
+
+            def _matrix_thunk(fleet: InstanceFleet, slot: int) -> np.ndarray:
+                zones, matrix = _pool_price_matrix(fleet)
+                zones_box[slot] = zones
+                return matrix
+
             overlap = _HostOverlap(
                 [
                     (
                         groups.vectors,
                         groups.counts,
                         fleet.capacity,
-                        functools.partial(_pool_matrix_of, fleet),
+                        functools.partial(_matrix_thunk, fleet, k),
                     )
-                    for _, groups, fleet, _ in pending
+                    for k, (_, groups, fleet, _) in enumerate(pending)
                 ]
             ).start()
             with device_profile(TRACER), TRACER.span(
@@ -1232,10 +1235,9 @@ class CostSolver(Solver):
             ):
                 fetched_all = _to_host([entry[3] for entry in pending])
             pool_matrices, mix_plans = overlap.join()
-            for (i, groups, fleet, _), pool_prices, mix_plan, fetched in zip(
-                pending, pool_matrices, mix_plans, fetched_all
+            for (i, groups, fleet, _), zones, pool_prices, mix_plan, fetched in zip(
+                pending, zones_box, pool_matrices, mix_plans, fetched_all
             ):
-                zones = _pool_zones(fleet)
                 dense = cost_solve_finish(
                     fetched,
                     groups.vectors,

@@ -341,7 +341,14 @@ class Manager:
 
     def _on_event(self, kind: str, obj) -> None:
         if kind == "pod":
-            self.loops["selection"].enqueue((obj.namespace, obj.name))
+            # Only provisionable pods route through selection: its reconcile
+            # is a no-op for anything else, and a 10k-pod storm's bind wave
+            # would otherwise re-enqueue every just-bound pod for a pointless
+            # (GIL-bound) pass. The reference pays the same event with a
+            # network-parked reconcile; here the event thread can filter on
+            # the object it already holds.
+            if obj.is_provisionable():
+                self.loops["selection"].enqueue((obj.namespace, obj.name))
             if obj.node_name:
                 # pod-to-node events re-list the node (ref: node/controller.go:118-150)
                 self.loops["node"].enqueue(obj.node_name)

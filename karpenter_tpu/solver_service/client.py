@@ -108,8 +108,9 @@ class RemoteSolver(Solver):
         """True once the sidecar has reported status "ok" (warmup done).
         While it reports "warming", callers host-solve WITHOUT arming the
         blackout — the sidecar is healthy, just precompiling; the next
-        batch re-checks. An unreachable sidecar returns False here and the
-        solve path's own RPC failure handling owns the blackout."""
+        batch re-checks. An UNREACHABLE sidecar returns True on purpose:
+        the solve proceeds to its RPC, whose failure path owns arming the
+        blackout (this method must never swallow an outage silently)."""
         if self._warm_verified:
             return True
         health = self.healthy(timeout_s=1.0)
