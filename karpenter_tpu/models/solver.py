@@ -716,6 +716,20 @@ def cost_solve_dense(
     num_groups = int(vectors.shape[0])
     num_types = int(capacity.shape[0])
 
+    # Adaptive dispatch: below the device break-even (HOST_SOLVE_MAX_PODS —
+    # one fetch costs a full, often-tunneled device round trip) the host
+    # candidates answer in milliseconds and carry the cost win; the device
+    # path owns scale, where its throughput and mesh sharding pay for the
+    # trip. Falls through when the native library is unavailable.
+    if host_solve_enabled(int(np.asarray(counts).sum())):
+        if callable(pool_prices):
+            pool_prices = pool_prices()
+        dense = cost_solve_host(
+            vectors, counts, capacity, total, prices, pool_prices
+        )
+        if dense is not None:
+            return dense
+
     # device_profile is a no-op unless KARPENTER_JAX_PROFILE_DIR is set, in
     # which case each solve captures a jax.profiler device trace whose XLA
     # ops line up with the host spans via TraceAnnotation.
@@ -796,17 +810,21 @@ def compute_mix_candidate(
     counts: np.ndarray,
     capacity: np.ndarray,
     pool_prices: np.ndarray,
+    allow_single_group: bool = False,
 ) -> Optional[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]:
     """The column-LP candidate (ops/mix_pack.py) as (rounds, unschedulable),
     or None when no covering plan exists. Pure host work — callers run it
-    while the fused kernel computes on the device."""
+    while the fused kernel computes on the device (or as the whole cost
+    engine on the cost_solve_host path, which sets allow_single_group)."""
     counts = counts.astype(np.int64)
-    if int(vectors.shape[0]) < 2:
-        # A single request shape has no complementary pairs to exploit: the
-        # kernel's greedy candidates already enumerate every single-group
-        # fill, so the covering LP cannot improve on them — and in the
-        # batched path (many small schedules sharing one fetch) the
-        # per-schedule LP overhead would outlast the fetch window.
+    if int(vectors.shape[0]) < 2 and not allow_single_group:
+        # On the DEVICE path a single request shape gains little from the
+        # covering LP (the kernel's greedy candidates enumerate every
+        # single-group fill) and the batched path (many small schedules
+        # sharing one fetch) cannot afford per-schedule LP overhead
+        # outlasting the fetch window. The host path has no fetch to hide
+        # behind — there the LP's per-type max-fill columns pick the
+        # cheapest per-pod type mix and DO improve on plain FFD.
         return None
     from karpenter_tpu.ops import native
 
@@ -835,6 +853,70 @@ def compute_mix_candidate(
     if rounds is None:
         return None
     return rounds, unschedulable
+
+
+# Below this many pods a solve goes host-only: the device fetch costs a
+# full (often tunneled) round trip — ~70ms on the bench rig — while the
+# host candidates (compiled FFD + the column-LP mix) answer in a few ms
+# and carry the cost win at these sizes. Chosen at the batch cap: a full
+# batch window is exactly where the device's throughput starts to matter.
+HOST_SOLVE_MAX_PODS = 2000
+
+
+def cost_solve_host(
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+    prices: np.ndarray,
+    pool_prices: np.ndarray,
+) -> Optional[DenseSolveResult]:
+    """Host-only cost solve for problems under HOST_SOLVE_MAX_PODS: the
+    compiled-C++ greedy FFD (reference-parity guarantee — greedy is always
+    among the candidates) plus the column-LP mix, scored identically to the
+    device path's candidates. Returns None when the native library is
+    unavailable — callers fall through to the device path."""
+    from karpenter_tpu.ops import native as native_mod
+
+    ffd_result = native_mod.ffd_pack_rounds(
+        vectors, counts.astype(np.int64), capacity, total, quirk=False
+    )
+    if ffd_result is None:
+        return None
+    mix_plan = compute_mix_candidate(
+        vectors, counts, capacity, pool_prices, allow_single_group=True
+    )
+    return cost_solve_finish(
+        None,
+        vectors,
+        counts,
+        capacity,
+        total,
+        prices,
+        pool_prices,
+        mix_plan=mix_plan,
+        host_candidates=[ffd_result],
+    )
+
+
+def host_solve_enabled(num_pods: int) -> bool:
+    """Policy gate for the host path (KARPENTER_HOST_SOLVE=0 forces the
+    device path, =1 forces host regardless of size). Requires the native
+    library: without it cost_solve_host cannot run, and callers that gate
+    on this — notably the sidecar's SolveStream intake — would de-batch
+    small requests into serial device round trips for nothing."""
+    import os
+
+    from karpenter_tpu.ops import native as native_mod
+
+    flag = os.environ.get("KARPENTER_HOST_SOLVE", "").lower()
+    if flag in ("0", "false", "off"):
+        return False
+    if not native_mod.available():
+        return False
+    if flag in ("1", "true", "on"):
+        return True
+    return num_pods <= HOST_SOLVE_MAX_PODS
 
 
 def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int = 300):
@@ -886,35 +968,46 @@ def cost_solve_finish(
     mix_plan: Optional[
         Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]
     ] = None,
+    host_candidates: Optional[
+        List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]
+    ] = None,
 ) -> Optional[DenseSolveResult]:
     """Host-side candidate scoring + LP realization over fetched kernel
     outputs (the second half of cost_solve_dense). mix_plan, when given, is
     the column-LP candidate computed in the dispatch-to-fetch overlap window
-    (compute_mix_candidate) and competes on equal scoring terms."""
+    (compute_mix_candidate) and competes on equal scoring terms. fetched may
+    be None (the cost_solve_host path): scoring then runs over
+    host_candidates + mix_plan only and the device-LP realization is
+    skipped."""
     num_groups = int(vectors.shape[0])
-    if isinstance(fetched, FusedHandle):
-        rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
-            unpack_fused(
-                np.asarray(fetched.ints),
-                np.asarray(fetched.floats),
-                fetched.num_groups,
-                fetched.num_types,
-            )
-        )
-    else:  # pre-packing tuple form (kept for direct kernel callers)
-        rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = fetched
-
+    lp_assignment = feasible_any = None
+    lp_objective = np.inf
     # Candidates stay in round form; only the winner pays the decode into
     # concrete per-node pod lists.
     candidates: List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]] = []
-    for rounds in (rounds_ffd, rounds_cost):
-        if not bool(rounds.overflow):
-            candidates.append(
-                (
-                    _kernel_rounds_to_list(rounds, num_groups),
-                    rounds.unschedulable[:num_groups],
+    if fetched is not None:
+        if isinstance(fetched, FusedHandle):
+            rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
+                unpack_fused(
+                    np.asarray(fetched.ints),
+                    np.asarray(fetched.floats),
+                    fetched.num_groups,
+                    fetched.num_types,
                 )
             )
+        else:  # pre-packing tuple form (kept for direct kernel callers)
+            rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
+                fetched
+            )
+        for rounds in (rounds_ffd, rounds_cost):
+            if not bool(rounds.overflow):
+                candidates.append(
+                    (
+                        _kernel_rounds_to_list(rounds, num_groups),
+                        rounds.unschedulable[:num_groups],
+                    )
+                )
+    candidates.extend(host_candidates or [])
     if mix_plan is not None:
         candidates.append(mix_plan)
 
@@ -984,7 +1077,10 @@ def cost_solve_finish(
     best_kernel_cost = min(
         (s[1] for s in scores.values() if s[0] == 0), default=np.inf
     )
-    if not candidates or best_kernel_cost > float(lp_objective) * LP_REALIZE_SLACK:
+    if lp_assignment is not None and (
+        not candidates
+        or best_kernel_cost > float(lp_objective) * LP_REALIZE_SLACK
+    ):
         lp_candidate = _realize_lp_dense(
             lp_assignment, feasible_any, vectors, counts, capacity, total
         )
@@ -1196,6 +1292,24 @@ class CostSolver(Solver):
             if fleet.num_types == 0 or groups.num_groups == 0:
                 results[i] = ffd.pack_groups(fleet, groups)
                 continue
+            prebuilt_pool = None  # (zones, matrix) when the host gate ran
+            if host_solve_enabled(int(groups.counts.sum())):
+                # Small schedule: the host path answers in milliseconds —
+                # cheaper than even a SHARED device fetch's slice of work.
+                prebuilt_pool = _pool_price_matrix(fleet)
+                dense = cost_solve_host(
+                    groups.vectors,
+                    groups.counts,
+                    fleet.capacity,
+                    fleet.total,
+                    fleet.prices,
+                    prebuilt_pool[1],
+                )
+                if dense is not None:
+                    results[i] = decode_dense_result(
+                        dense, groups, fleet, prebuilt_pool[0]
+                    )
+                    continue
             fused = cost_solve_dispatch(
                 groups.vectors,
                 groups.counts,
@@ -1205,17 +1319,21 @@ class CostSolver(Solver):
                 self.lp_steps,
             )
             _start_fetch(fused)
-            pending.append((i, groups, fleet, fused))
+            pending.append((i, groups, fleet, fused, prebuilt_pool))
 
         if pending:
             # Per-schedule host work (pool matrices + mix candidates) runs in
             # a worker thread concurrently with the ONE blocking batch fetch,
             # exactly like the single-solve path. The thunks stash each
-            # fleet's zone axis so the finish loop doesn't rebuild it.
+            # fleet's zone axis so the finish loop doesn't rebuild it, and
+            # reuse a matrix the host-gate branch already built (rare
+            # fallthrough: native overflow after the gate passed).
             zones_box: List[Optional[List[str]]] = [None] * len(pending)
 
-            def _matrix_thunk(fleet: InstanceFleet, slot: int) -> np.ndarray:
-                zones, matrix = _pool_price_matrix(fleet)
+            def _matrix_thunk(
+                fleet: InstanceFleet, slot: int, prebuilt
+            ) -> np.ndarray:
+                zones, matrix = prebuilt or _pool_price_matrix(fleet)
                 zones_box[slot] = zones
                 return matrix
 
@@ -1225,9 +1343,9 @@ class CostSolver(Solver):
                         groups.vectors,
                         groups.counts,
                         fleet.capacity,
-                        functools.partial(_matrix_thunk, fleet, k),
+                        functools.partial(_matrix_thunk, fleet, k, prebuilt),
                     )
-                    for k, (_, groups, fleet, _) in enumerate(pending)
+                    for k, (_, groups, fleet, _, prebuilt) in enumerate(pending)
                 ]
             ).start()
             with device_profile(TRACER), TRACER.span(
@@ -1235,7 +1353,7 @@ class CostSolver(Solver):
             ):
                 fetched_all = _to_host([entry[3] for entry in pending])
             pool_matrices, mix_plans = overlap.join()
-            for (i, groups, fleet, _), zones, pool_prices, mix_plan, fetched in zip(
+            for (i, groups, fleet, _, _), zones, pool_prices, mix_plan, fetched in zip(
                 pending, zones_box, pool_matrices, mix_plans, fetched_all
             ):
                 dense = cost_solve_finish(

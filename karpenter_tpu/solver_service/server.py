@@ -206,6 +206,13 @@ class _Handler:
                     # Request-scoped context: an unknown mode aborts THIS
                     # request only, not the whole stream.
                     ready[order] = self.solve(request, _RequestScopedContext())
+                elif solver_models.host_solve_enabled(
+                    int(np.sum(wire.decode_tensor(request.group_counts)))
+                ):
+                    # Small schedule: the unary path's adaptive host solve
+                    # answers inline in milliseconds — no reason to ride
+                    # the batched device fetch.
+                    ready[order] = self.solve(request, _RequestScopedContext())
                 else:
                     start = time.perf_counter()
                     vectors = wire.decode_tensor(request.group_vectors)

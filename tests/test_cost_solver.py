@@ -201,3 +201,74 @@ class TestBatchedSolve:
             assert got.node_count == want.node_count
             assert got.projected_cost() == pytest.approx(want.projected_cost())
             assert len(got.unschedulable) == len(want.unschedulable)
+
+
+class TestAdaptiveHostDispatch:
+    """Below HOST_SOLVE_MAX_PODS a solve answers on the HOST (compiled FFD +
+    column-LP mix, same scoring) — the device fetch costs a full round trip
+    (~70ms tunneled) that small problems cannot amortize. The device path
+    owns scale and stays reachable via KARPENTER_HOST_SOLVE=0."""
+
+    def test_small_solve_skips_the_device(self, monkeypatch):
+        from karpenter_tpu.models import solver as S
+        from karpenter_tpu.ops import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        dispatched = []
+        real_dispatch = S.cost_solve_dispatch
+        monkeypatch.setattr(
+            S,
+            "cost_solve_dispatch",
+            lambda *a, **k: dispatched.append(1) or real_dispatch(*a, **k),
+        )
+        pods = fixtures.pods(50, cpu="1", memory="1Gi")
+        result = CostSolver().solve(pods, aws_like_catalog(), Constraints())
+        assert not dispatched  # host path answered
+        assert not result.unschedulable
+
+    def test_forced_device_path_matches_host_quality_bound(self, monkeypatch):
+        """KARPENTER_HOST_SOLVE=0 forces the device path; both paths must
+        beat-or-match greedy (the shared guarantee), and the host plan must
+        not be costlier than the device plan by more than the LP's edge."""
+        from karpenter_tpu.ops import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        pods = fixtures.pods(80, cpu="2", memory="3Gi") + fixtures.pods(
+            40, cpu="1", memory="6Gi"
+        )
+        catalog = aws_like_catalog()
+        greedy_cost = GreedySolver().solve(
+            pods, catalog, Constraints()
+        ).projected_cost()
+        host_cost = CostSolver().solve(
+            pods, catalog, Constraints()
+        ).projected_cost()
+        monkeypatch.setenv("KARPENTER_HOST_SOLVE", "0")
+        device_cost = CostSolver().solve(
+            pods, catalog, Constraints()
+        ).projected_cost()
+        assert host_cost <= greedy_cost + 1e-9
+        assert device_cost <= greedy_cost + 1e-9
+        assert host_cost <= device_cost * 1.05
+
+    def test_single_group_host_solve_picks_cheap_type_mix(self):
+        """G=1 on the host path: the mix LP's per-type max-fill columns must
+        choose the cheapest per-pod type, not just FFD's size-bound pick."""
+        from karpenter_tpu.ops import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        # A type ladder where the mid size is disproportionately cheap.
+        catalog = [
+            fixtures.cpu_instance("small", cpu=4, mem_gib=16, price=0.40),
+            fixtures.cpu_instance("mid", cpu=16, mem_gib=64, price=0.50),
+            fixtures.cpu_instance("big", cpu=64, mem_gib=256, price=8.0),
+        ]
+        pods = fixtures.pods(64, cpu="1", memory="1Gi")
+        result = CostSolver().solve(pods, catalog, Constraints())
+        greedy = GreedySolver().solve(pods, catalog, Constraints())
+        assert result.projected_cost() <= greedy.projected_cost() + 1e-9
+        # 64 one-cpu pods: 4x mid ($2.00) vs 16x small ($6.40) vs 1x big ($8).
+        assert result.projected_cost() == pytest.approx(2.0, rel=0.35)
