@@ -857,10 +857,19 @@ def compute_mix_candidate(
 
 # Below this many pods a solve goes host-only: the device fetch costs a
 # full (often tunneled) round trip — ~70ms on the bench rig — while the
-# host candidates (compiled FFD + the column-LP mix) answer in a few ms
-# and carry the cost win at these sizes. Chosen at the batch cap: a full
-# batch window is exactly where the device's throughput starts to matter.
-HOST_SOLVE_MAX_PODS = 2000
+# host candidates (compiled FFD + the column-LP mix) answer faster with
+# identical plans. Measured break-even on the bench rig: 10k pods × 200
+# types host-solves in ~49ms vs ~94ms on device (same cost ratios under
+# both accountings); at 50k × 400 the device wins (~93ms vs ~157ms host)
+# and additionally scales via mesh sharding. 10k is the last measured
+# point where host wins.
+HOST_SOLVE_MAX_PODS = 10_000
+# The BATCHED paths (solve_encoded_many, the sidecar's SolveStream) share
+# ONE device fetch across K schedules, so the per-schedule device cost is
+# fetch/K + compute — far below the single-solve break-even. Host-solving
+# there must clear a much lower bar (and it runs serially on the intake
+# thread): only schedules whose host solve is a few ms qualify.
+HOST_SOLVE_MAX_PODS_BATCHED = 2_000
 
 
 def cost_solve_host(
@@ -899,12 +908,14 @@ def cost_solve_host(
     )
 
 
-def host_solve_enabled(num_pods: int) -> bool:
+def host_solve_enabled(num_pods: int, batched: bool = False) -> bool:
     """Policy gate for the host path (KARPENTER_HOST_SOLVE=0 forces the
     device path, =1 forces host regardless of size). Requires the native
     library: without it cost_solve_host cannot run, and callers that gate
     on this — notably the sidecar's SolveStream intake — would de-batch
-    small requests into serial device round trips for nothing."""
+    small requests into serial device round trips for nothing. batched=True
+    applies the batch threshold: those paths amortize one fetch across the
+    whole batch, so the device bar per schedule is K times lower."""
     import os
 
     from karpenter_tpu.ops import native as native_mod
@@ -916,7 +927,13 @@ def host_solve_enabled(num_pods: int) -> bool:
         return False
     if flag in ("1", "true", "on"):
         return True
-    return num_pods <= HOST_SOLVE_MAX_PODS
+    if solve_mesh() is not None:
+        # Multi-chip runtime: the operator provisioned a mesh precisely so
+        # solves ride it (and the sharded path is what dryrun/parity checks
+        # must exercise) — the host path is a single-chip latency trade.
+        return False
+    limit = HOST_SOLVE_MAX_PODS_BATCHED if batched else HOST_SOLVE_MAX_PODS
+    return num_pods <= limit
 
 
 def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int = 300):
@@ -1293,7 +1310,7 @@ class CostSolver(Solver):
                 results[i] = ffd.pack_groups(fleet, groups)
                 continue
             prebuilt_pool = None  # (zones, matrix) when the host gate ran
-            if host_solve_enabled(int(groups.counts.sum())):
+            if host_solve_enabled(int(groups.counts.sum()), batched=True):
                 # Small schedule: the host path answers in milliseconds —
                 # cheaper than even a SHARED device fetch's slice of work.
                 prebuilt_pool = _pool_price_matrix(fleet)

@@ -207,7 +207,8 @@ class _Handler:
                     # request only, not the whole stream.
                     ready[order] = self.solve(request, _RequestScopedContext())
                 elif solver_models.host_solve_enabled(
-                    int(np.sum(wire.decode_tensor(request.group_counts)))
+                    int(np.sum(wire.decode_tensor(request.group_counts))),
+                    batched=True,
                 ):
                     # Small schedule: the unary path's adaptive host solve
                     # answers inline in milliseconds — no reason to ride

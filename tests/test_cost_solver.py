@@ -215,6 +215,9 @@ class TestAdaptiveHostDispatch:
 
         if not native.available():
             pytest.skip("native toolchain unavailable")
+        # Single-chip runtime: with a mesh the device owns every solve.
+        monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
+        monkeypatch.delenv("KARPENTER_HOST_SOLVE", raising=False)
         dispatched = []
         real_dispatch = S.cost_solve_dispatch
         monkeypatch.setattr(
@@ -235,6 +238,7 @@ class TestAdaptiveHostDispatch:
 
         if not native.available():
             pytest.skip("native toolchain unavailable")
+        monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
         pods = fixtures.pods(80, cpu="2", memory="3Gi") + fixtures.pods(
             40, cpu="1", memory="6Gi"
         )
@@ -253,13 +257,15 @@ class TestAdaptiveHostDispatch:
         assert device_cost <= greedy_cost + 1e-9
         assert host_cost <= device_cost * 1.05
 
-    def test_single_group_host_solve_picks_cheap_type_mix(self):
+    def test_single_group_host_solve_picks_cheap_type_mix(self, monkeypatch):
         """G=1 on the host path: the mix LP's per-type max-fill columns must
         choose the cheapest per-pod type, not just FFD's size-bound pick."""
         from karpenter_tpu.ops import native
 
         if not native.available():
             pytest.skip("native toolchain unavailable")
+        monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
+        monkeypatch.delenv("KARPENTER_HOST_SOLVE", raising=False)
         # A type ladder where the mid size is disproportionately cheap.
         catalog = [
             fixtures.cpu_instance("small", cpu=4, mem_gib=16, price=0.40),

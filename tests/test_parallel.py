@@ -81,6 +81,9 @@ class TestProductionShardedPath:
             + pods(500, cpu="250m", memory="3Gi")
         )
         monkeypatch.delenv("KARPENTER_SHARDED_SOLVE", raising=False)
+        # Kernel-vs-kernel comparison: without the host override the
+        # single-chip side would adaptively host-solve at this size.
+        monkeypatch.setenv("KARPENTER_HOST_SOLVE", "0")
         sharded = CostSolver(lp_steps=60).solve(batch, catalog, Constraints())
         monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
         single = CostSolver(lp_steps=60).solve(batch, catalog, Constraints())
