@@ -927,13 +927,34 @@ def host_solve_enabled(num_pods: int, batched: bool = False) -> bool:
         return False
     if flag in ("1", "true", "on"):
         return True
-    if solve_mesh() is not None:
+    sharded_off = os.environ.get("KARPENTER_SHARDED_SOLVE", "").lower() in (
+        "0",
+        "false",
+        "off",
+    )
+    if not sharded_off and _multi_device():
         # Multi-chip runtime: the operator provisioned a mesh precisely so
         # solves ride it (and the sharded path is what dryrun/parity checks
         # must exercise) — the host path is a single-chip latency trade.
+        # (Same condition as solve_mesh() non-None, without constructing a
+        # Mesh per gate call.)
         return False
     limit = HOST_SOLVE_MAX_PODS_BATCHED if batched else HOST_SOLVE_MAX_PODS
     return num_pods <= limit
+
+
+_MULTI_DEVICE: Optional[bool] = None
+
+
+def _multi_device() -> bool:
+    """Cached jax.device_count() > 1 — the device topology is fixed for the
+    process lifetime, and probing it per solve would pay (on first call) a
+    backend initialization inside the very gate whose host path exists to
+    avoid touching the device."""
+    global _MULTI_DEVICE
+    if _MULTI_DEVICE is None:
+        _MULTI_DEVICE = jax.device_count() > 1
+    return _MULTI_DEVICE
 
 
 def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int = 300):
@@ -1310,9 +1331,13 @@ class CostSolver(Solver):
                 results[i] = ffd.pack_groups(fleet, groups)
                 continue
             prebuilt_pool = None  # (zones, matrix) when the host gate ran
-            if host_solve_enabled(int(groups.counts.sum()), batched=True):
+            if host_solve_enabled(
+                int(groups.counts.sum()), batched=len(items) > 1
+            ):
                 # Small schedule: the host path answers in milliseconds —
                 # cheaper than even a SHARED device fetch's slice of work.
+                # A single-item "batch" has no fetch to amortize, so it uses
+                # the unary threshold.
                 prebuilt_pool = _pool_price_matrix(fleet)
                 dense = cost_solve_host(
                     groups.vectors,

@@ -82,12 +82,14 @@ def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
     buckets: Dict[bytes, List[PodSpec]] = {}
     vectors: Dict[bytes, np.ndarray] = {}
     for pod in pods:
-        # Per-pod cache first (requests are immutable after parsing), then
-        # the content-keyed memo (~1 parse per distinct shape).
+        # The cache is populated at PodSpec construction
+        # (api/pods._dense_request_cache — one definition of the format);
+        # the fallback covers only detached copies built without __post_init__.
         cached = pod.dense_vector
-        if cached is None:
-            vec = resource_vector(pod.requests)
-            pod.dense_vector = cached = (vec, vec.tobytes())
+        if cached is None:  # pragma: no cover — defensive
+            from karpenter_tpu.api.pods import _dense_request_cache
+
+            pod.dense_vector = cached = _dense_request_cache(pod.requests)
         vec, key = cached
         members = buckets.get(key)
         if members is None:

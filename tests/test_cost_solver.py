@@ -239,6 +239,7 @@ class TestAdaptiveHostDispatch:
         if not native.available():
             pytest.skip("native toolchain unavailable")
         monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
+        monkeypatch.delenv("KARPENTER_HOST_SOLVE", raising=False)
         pods = fixtures.pods(80, cpu="2", memory="3Gi") + fixtures.pods(
             40, cpu="1", memory="6Gi"
         )
