@@ -342,7 +342,7 @@ def main():
 
     fused_probe = solver_mod.cost_solve_dispatch(
         groups.vectors, groups.counts, fleet.capacity, fleet.total,
-        fleet.prices, 300,
+        fleet.prices, 300, count=False,
     )
     fused_fetch_bytes = solver_mod.fetch_bytes(fused_probe)
     jax.block_until_ready((fused_probe.ints, fused_probe.floats))

@@ -35,7 +35,16 @@ from karpenter_tpu.ops.score_kernel import (
     round_assignment,
 )
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.tracing import TRACER, device_profile
+
+# Which side of the adaptive dispatch served each cost solve — the first
+# thing to check when solve latency looks wrong for the problem size.
+SOLVE_DISPATCH_TOTAL = REGISTRY.counter(
+    "solver_dispatch_total",
+    "Cost solves by dispatch path (host|device)",
+    ["path"],
+)
 
 
 class Solver(abc.ABC):
@@ -295,19 +304,45 @@ def _sharded_fused_kernel(mesh=None):
     return cached
 
 
+def sharded_solve_active() -> bool:
+    """True iff solve_mesh() would return a mesh — THE sharded-solve
+    predicate, mesh-free so gates can call it per solve. Shared by
+    solve_mesh and host_solve_enabled so the dispatch gate can never drift
+    from the actual mesh policy."""
+    import os
+
+    if os.environ.get("KARPENTER_SHARDED_SOLVE", "").lower() in (
+        "0",
+        "false",
+        "off",
+    ):
+        return False
+    return _multi_device()
+
+
 def solve_mesh():
     """The production mesh policy: shard the fused solve when the runtime has
     more than one accelerator (KARPENTER_SHARDED_SOLVE=0 forces the
     single-device path). Returns a Mesh or None."""
-    import os
-
-    if os.environ.get("KARPENTER_SHARDED_SOLVE", "").lower() in ("0", "false", "off"):
-        return None
-    if jax.device_count() < 2:
+    if not sharded_solve_active():
         return None
     from karpenter_tpu.parallel.mesh import make_mesh
 
     return make_mesh()
+
+
+_MULTI_DEVICE: Optional[bool] = None
+
+
+def _multi_device() -> bool:
+    """Cached jax.device_count() > 1 — the device topology is fixed for the
+    process lifetime, and probing it per solve would pay (on first call) a
+    backend initialization inside the very gate whose host path exists to
+    avoid touching the device."""
+    global _MULTI_DEVICE
+    if _MULTI_DEVICE is None:
+        _MULTI_DEVICE = jax.device_count() > 1
+    return _MULTI_DEVICE
 
 
 def pad_kernel_args(vectors, counts, capacity, total, prices, g_mult=1, t_mult=1):
@@ -892,6 +927,7 @@ def cost_solve_host(
     )
     if ffd_result is None:
         return None
+    SOLVE_DISPATCH_TOTAL.inc("host")
     mix_plan = compute_mix_candidate(
         vectors, counts, capacity, pool_prices, allow_single_group=True
     )
@@ -927,37 +963,19 @@ def host_solve_enabled(num_pods: int, batched: bool = False) -> bool:
         return False
     if flag in ("1", "true", "on"):
         return True
-    sharded_off = os.environ.get("KARPENTER_SHARDED_SOLVE", "").lower() in (
-        "0",
-        "false",
-        "off",
-    )
-    if not sharded_off and _multi_device():
+    if sharded_solve_active():
         # Multi-chip runtime: the operator provisioned a mesh precisely so
         # solves ride it (and the sharded path is what dryrun/parity checks
         # must exercise) — the host path is a single-chip latency trade.
-        # (Same condition as solve_mesh() non-None, without constructing a
-        # Mesh per gate call.)
         return False
     limit = HOST_SOLVE_MAX_PODS_BATCHED if batched else HOST_SOLVE_MAX_PODS
     return num_pods <= limit
 
 
-_MULTI_DEVICE: Optional[bool] = None
-
-
-def _multi_device() -> bool:
-    """Cached jax.device_count() > 1 — the device topology is fixed for the
-    process lifetime, and probing it per solve would pay (on first call) a
-    backend initialization inside the very gate whose host path exists to
-    avoid touching the device."""
-    global _MULTI_DEVICE
-    if _MULTI_DEVICE is None:
-        _MULTI_DEVICE = jax.device_count() > 1
-    return _MULTI_DEVICE
-
-
-def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int = 300):
+def cost_solve_dispatch(
+    vectors, counts, capacity, total, prices, lp_steps: int = 300,
+    count: bool = True,
+):
     """Dispatch the fused kernel asynchronously; pair with a (batchable)
     fetch + cost_solve_finish. Splitting dispatch from finish lets a batch of
     schedules share ONE device->host round trip (the dominant latency on
@@ -965,7 +983,11 @@ def cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps: int 
 
     On a multi-chip runtime (solve_mesh() non-None) the SAME entry dispatches
     the mesh-sharded fused kernel — production callers (CostSolver, the gRPC
-    sidecar) get the sharded path with no code of their own."""
+    sidecar) get the sharded path with no code of their own. count=False
+    keeps non-solve dispatches (boot warmup, bench probes) out of the
+    dispatch-path metric."""
+    if count:
+        SOLVE_DISPATCH_TOTAL.inc("device")
     # Probe the pallas dominance kernel EAGERLY before the fused kernel
     # traces — under the trace the probe can't run and the XLA formulation
     # would be baked in untested (ops/pallas_kernels.ensure_probed).

@@ -430,6 +430,7 @@ class SolverServer:
                     solver_models.cost_solve_dispatch(
                         vectors, counts, capacity, capacity.copy(),
                         (0.1 * sizes).astype(np.float32), 300,
+                        count=False,  # warmup, not a routed solve
                     )
                 )
             except Exception:  # noqa: BLE001 — warmup must never kill boot
