@@ -38,11 +38,14 @@ from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.tracing import TRACER, device_profile
 
-# Which side of the adaptive dispatch served each cost solve — the first
-# thing to check when solve latency looks wrong for the problem size.
+# Which side of the adaptive dispatch a cost solve was ROUTED to — the
+# first thing to check when solve latency looks wrong for the problem
+# size. Counted at routing time: a device dispatch whose candidates all
+# fail (rare — the caller then falls back to host greedy) still counts as
+# "device", since the routing decision is what the metric explains.
 SOLVE_DISPATCH_TOTAL = REGISTRY.counter(
     "solver_dispatch_total",
-    "Cost solves by dispatch path (host|device)",
+    "Cost solves by routed dispatch path (host|device)",
     ["path"],
 )
 

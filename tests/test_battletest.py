@@ -12,6 +12,10 @@ load). Afterwards: conservation invariants (tests/test_replay.py), informer
 cache vs apiserver-store coherence, zero non-conflict reconcile exceptions,
 and a clean bounded shutdown.
 
+The churn scenario runs once per solver: "greedy" (reference-parity
+packer) and "cost" (the full cost engine — column-LP mix, adaptive host
+dispatch, candidate scoring — in the single-chip production config).
+
 Run via `make battletest` (KARPENTER_BATTLETEST=1); skipped in the normal
 suite to keep it fast. KARPENTER_BATTLETEST_SECONDS / _SEED tune the run.
 """
@@ -170,8 +174,16 @@ class TestLeaderFailoverMidStorm:
 
 
 class TestBattletest:
-    def test_manager_survives_randomized_churn(self):
-        print(f"\nbattletest seed={SEED} duration={DURATION_S}s")
+    @pytest.mark.parametrize("solver_name", ["greedy", "cost"])
+    def test_manager_survives_randomized_churn(self, solver_name, monkeypatch):
+        # The cost variant drives the FULL cost engine (column-LP mix,
+        # adaptive host dispatch, candidate scoring) under the same churn;
+        # KARPENTER_SHARDED_SOLVE=0 pins the single-chip production config
+        # so no jit compile races the churn window on the CPU test mesh.
+        if solver_name == "cost":
+            monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
+            monkeypatch.delenv("KARPENTER_HOST_SOLVE", raising=False)
+        print(f"\nbattletest seed={SEED} duration={DURATION_S}s solver={solver_name}")
         rng = random.Random(SEED)
         apiserver = FakeApiServer(history_limit=2048)
         cluster = ApiServerCluster(
@@ -180,7 +192,7 @@ class TestBattletest:
         manager = Manager(
             cluster,
             FakeCloudProvider(),
-            Options(cluster_name="battle", solver="greedy",
+            Options(cluster_name="battle", solver=solver_name,
                     leader_election=False),
         )
         collector = _ExceptionCollector()
