@@ -253,6 +253,59 @@ class TestPoolSelectParity:
                 assert got_rows == want_rows, (trial, f)
 
 
+class TestFuzzInvariants:
+    def test_random_problems_hold_cover_and_capacity_invariants(self):
+        """Seeded fuzz across fleet/workload shapes: every produced plan
+        must cover counts exactly, respect per-node capacity on the packed
+        type, and respect group counts — regardless of whether the LP, the
+        greedy cover, or the rescue columns did the work."""
+        rng = np.random.default_rng(2024)
+        produced = 0
+        for trial in range(24):
+            num_groups = int(rng.integers(1, 9))
+            num_types = int(rng.integers(1, 40))
+            dims = 3
+            vectors = np.zeros((num_groups, dims), np.float32)
+            vectors[:, 0] = rng.integers(1, 17, num_groups) * 250
+            vectors[:, 1] = rng.integers(1, 33, num_groups) * 256
+            vectors[:, 2] = 1.0
+            # FFD-desc order like the encoder produces.
+            order = np.argsort(-vectors[:, 0], kind="stable")
+            vectors = vectors[order]
+            counts = rng.integers(1, 400, num_groups).astype(np.int64)
+            sizes = rng.integers(1, 65, num_types)
+            capacity = np.zeros((num_types, dims), np.float32)
+            capacity[:, 0] = 2000.0 * sizes
+            capacity[:, 1] = 4096.0 * sizes
+            capacity[:, 2] = rng.integers(8, 111, num_types)
+            pool_floor = 0.05 * sizes * rng.uniform(0.5, 1.5, num_types)
+            pool_floor[rng.random(num_types) < 0.15] = np.inf
+            # Zero infeasible groups like compute_mix_candidate does.
+            feasible = (
+                (capacity[None, :, :] >= vectors[:, None, :] - 1e-6)
+                .all(axis=2)
+                .any(axis=1)
+            )
+            solvable = np.where(feasible, counts, 0)
+            if solvable.sum() == 0:
+                continue
+            rounds = mix_pack.mix_candidate(
+                vectors, solvable, capacity, pool_floor
+            )
+            if rounds is None:
+                continue
+            produced += 1
+            covered = np.zeros(num_groups, np.int64)
+            for t, fill, repl in rounds:
+                assert repl > 0
+                assert (fill >= 0).all()
+                demand = fill.astype(np.float64) @ vectors
+                assert (demand <= capacity[t] + 1e-3).all(), (trial, t)
+                covered += repl * fill
+            assert (covered == solvable).all(), trial
+        assert produced >= 12  # the fuzz actually exercised the pipeline
+
+
 class TestSolverIntegration:
     def test_cost_solver_wins_on_complementary_workload(self):
         """End-to-end through CostSolver: on a workload whose optimum needs
