@@ -18,7 +18,7 @@ test:
 battletest:
 	rc=0; \
 	KARPENTER_RANDOM_ORDER=auto python -m pytest tests/ -q --tb=long || rc=1; \
-	KARPENTER_BATTLETEST=1 python -m pytest tests/test_battletest.py -q --tb=long -s || rc=1; \
+	KARPENTER_BATTLETEST=1 python -m pytest tests/test_battletest.py tests/test_spmd.py -q --tb=long -s || rc=1; \
 	exit $$rc
 
 proto:

@@ -1,10 +1,11 @@
-"""Real multi-process SPMD integration: two OS processes join one
-jax.distributed runtime (CPU + gloo collectives) and the PRODUCTION
-CostSolver path replicates solves from rank 0 to the follower loop — the
-local stand-in for a multi-host TPU pod slice. Covers parallel/spmd.py,
-parallel/multihost.py, and the multi-process branch of
-models/solver.cost_solve_dispatch end to end."""
+"""Real multi-process SPMD integration: N OS processes (2 in the normal
+tier, 4 in the battletest tier) join one jax.distributed runtime (CPU +
+gloo collectives) and the PRODUCTION CostSolver path replicates solves
+from rank 0 to the follower loops — the local stand-in for a multi-host
+TPU pod slice. Covers parallel/spmd.py, parallel/multihost.py, and the
+multi-process branch of models/solver.cost_solve_dispatch end to end."""
 
+import os
 import socket
 import subprocess
 import sys
@@ -26,14 +27,16 @@ _RANK_PROGRAM = textwrap.dedent(
 
     from karpenter_tpu.parallel.multihost import init_distributed
 
+    num_processes = int(sys.argv[3])
     assert init_distributed(
         {
             "KARPENTER_COORDINATOR": f"127.0.0.1:{port}",
-            "KARPENTER_NUM_PROCESSES": "2",
+            "KARPENTER_NUM_PROCESSES": str(num_processes),
             "KARPENTER_PROCESS_ID": str(rank),
         }
     )
-    assert jax.process_count() == 2 and jax.device_count() == 4
+    assert jax.process_count() == num_processes
+    assert jax.device_count() == 2 * num_processes
 
     if rank > 0:
         from karpenter_tpu.parallel import spmd
@@ -80,8 +83,27 @@ def _free_port() -> int:
         return sock.getsockname()[1]
 
 
-class TestSpmdTwoProcess:
-    def test_production_solve_spans_two_processes(self):
+class TestSpmdMultiProcess:
+    @pytest.mark.parametrize(
+        "num_processes",
+        [
+            pytest.param(
+                2,
+                marks=pytest.mark.skipif(
+                    os.environ.get("KARPENTER_BATTLETEST") == "1",
+                    reason="2-rank case already ran in the normal tier",
+                ),
+            ),
+            pytest.param(
+                4,
+                marks=pytest.mark.skipif(
+                    os.environ.get("KARPENTER_BATTLETEST") != "1",
+                    reason="4-rank SPMD slice runs in the battletest tier",
+                ),
+            ),
+        ],
+    )
+    def test_production_solve_spans_processes(self, num_processes):
         port = _free_port()
         env = {
             "PATH": "/usr/bin:/bin",
@@ -91,11 +113,14 @@ class TestSpmdTwoProcess:
         }
         procs = [
             subprocess.Popen(
-                [sys.executable, "-c", _RANK_PROGRAM, str(rank), str(port)],
+                [
+                    sys.executable, "-c", _RANK_PROGRAM,
+                    str(rank), str(port), str(num_processes),
+                ],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, cwd=".",
             )
-            for rank in range(2)
+            for rank in range(num_processes)
         ]
         import time
 
@@ -122,4 +147,5 @@ class TestSpmdTwoProcess:
                 f"rank {rank} failed (rc={proc.returncode}):\n{out[-3000:]}"
             )
         assert "lead done" in outputs[0]
-        assert "follower done" in outputs[1]
+        for follower_output in outputs[1:]:
+            assert "follower done" in follower_output
