@@ -17,6 +17,7 @@ test:
 # not mask a Manager-stress regression in the same invocation.
 battletest:
 	rc=0; \
+	python tools/complexity_gate.py || rc=1; \
 	KARPENTER_RANDOM_ORDER=auto python -m pytest tests/ -q --tb=long || rc=1; \
 	KARPENTER_BATTLETEST=1 python -m pytest tests/test_battletest.py tests/test_spmd.py -q --tb=long -s || rc=1; \
 	exit $$rc

@@ -178,3 +178,43 @@ class TestChart:
         ):
             assert module in text, f"chart doesn't wire {module}"
             __import__(module)  # the entrypoint module must exist
+
+
+class TestComplexityGate:
+    """tools/complexity_gate.py — the battletest's gocyclo analogue
+    (ref: /root/reference/Makefile:33-38 gates cyclomatic complexity before
+    the race-detected suites)."""
+
+    def test_counter_matches_known_complexity(self, tmp_path):
+        import sys
+
+        sys.path.insert(0, str(ROOT / "tools"))
+        try:
+            from complexity_gate import function_complexities
+        finally:
+            sys.path.pop(0)
+        sample = tmp_path / "sample.py"
+        sample.write_text(
+            "def f(a, b):\n"
+            "    if a and b:\n"          # +1 if, +1 and
+            "        return 1\n"
+            "    for i in range(3):\n"   # +1
+            "        while a:\n"         # +1
+            "            a -= 1\n"
+            "    return [x for x in b if x]\n"  # +1 comp, +1 if
+        )
+        [(name, _, complexity)] = list(function_complexities(sample))
+        assert name == "f" and complexity == 1 + 6
+
+    def test_repo_passes_and_allowlist_is_live(self):
+        import subprocess
+        import sys
+
+        result = subprocess.run(
+            [sys.executable, "tools/complexity_gate.py"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=ROOT,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr

@@ -1,0 +1,150 @@
+"""Cyclomatic-complexity gate for `make battletest`.
+
+Ref: the reference's battletest runs gocyclo with a ceiling of 10 (11 for
+a handful of grandfathered functions) before the race-detected suites
+(/root/reference/Makefile:33-38). No mccabe/flake8/ruff ships in this
+image, so this is the stdlib-ast equivalent: complexity = 1 + branch
+points (if/elif, loops, and/or, except, with-pattern cases, ternaries,
+comprehension ifs), per function.
+
+The ceiling is DEFAULT_LIMIT; functions in ALLOWED carry a higher
+documented budget (the solver hot paths concentrate decision logic the
+way the reference's packer did — gocyclo grandfathered those too). The
+gate's job is to stop complexity CREEP: new or changed functions must
+come in under the ceiling, and an allowlisted function that grows past
+its recorded budget fails the build.
+
+Run: python tools/complexity_gate.py [paths...]   (default: karpenter_tpu)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_LIMIT = 15
+
+# function qualname -> allowed budget, grandfathered at the complexity
+# each function had when the gate landed (the reference's gocyclo gate
+# likewise carried a short exception list above its ceiling). Every entry
+# is a place the next refactor should look — mostly field-by-field
+# kube-manifest codecs and the candidate-selection hot paths; GROWING one
+# fails the build.
+ALLOWED = {
+    "karpenter_tpu/api/validation.py::validate_provisioner": 23,
+    "karpenter_tpu/cloudprovider/ec2/aws_http.py::AwsHttpEc2Api.describe_instance_types": 21,
+    "karpenter_tpu/cloudprovider/fake.py::FakeCloudProvider.create": 17,
+    "karpenter_tpu/cmd/webhook.py::main": 20,
+    "karpenter_tpu/controllers/metrics.py::MetricsController.reconcile": 33,
+    "karpenter_tpu/kubeapi/client.py::KubeClient.watch": 21,
+    "karpenter_tpu/kubeapi/convert.py::node_from_kube": 17,
+    "karpenter_tpu/kubeapi/convert.py::pod_from_kube": 45,
+    "karpenter_tpu/kubeapi/convert.py::pod_to_kube": 28,
+    "karpenter_tpu/models/solver.py::cost_solve_finish": 16,
+    "karpenter_tpu/ops/encode.py::build_fleet": 24,
+    "karpenter_tpu/ops/mix_pack.py::mix_candidate": 23,
+    "karpenter_tpu/solver_service/server.py::_Handler.solve_stream": 21,
+}
+
+
+class _Counter(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.complexity = 1
+
+    def _bump(self, node: ast.AST) -> None:
+        self.complexity += 1
+        self.generic_visit(node)
+
+    visit_If = visit_For = visit_AsyncFor = visit_While = _bump
+    visit_ExceptHandler = visit_IfExp = visit_Assert = _bump
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        self.complexity += len(node.values) - 1
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node: ast.comprehension) -> None:
+        self.complexity += 1 + len(node.ifs)
+        self.generic_visit(node)
+
+    def visit_Match(self, node) -> None:  # pragma: no cover — py3.10+
+        self.complexity += len(node.cases)
+        self.generic_visit(node)
+
+    # Nested defs are measured separately; don't fold their branches in.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+
+def function_complexities(path: Path):
+    """(qualname, lineno, complexity) per function/lambda. Qualnames carry
+    the class/function nesting path (Class.method, outer.inner, f.<lambda>)
+    so allowlist keys can never collide with a same-named sibling."""
+    tree = ast.parse(path.read_text())
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                name = getattr(child, "name", "<lambda>")
+                qualname = f"{prefix}{name}"
+                counter = _Counter()
+                body = (
+                    [child.body]
+                    if isinstance(child, ast.Lambda)
+                    else child.body
+                )
+                for stmt in body:
+                    counter.visit(stmt)
+                yield qualname, child.lineno, counter.complexity
+                yield from walk(child, f"{qualname}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def main(argv) -> int:
+    roots = [Path(p) for p in argv] or [Path("karpenter_tpu")]
+    missing = [root for root in roots if not root.exists()]
+    if missing:
+        print(f"ERROR: no such path: {', '.join(map(str, missing))}")
+        return 2
+    failures = []
+    worst = []
+    seen_keys = set()
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for path in files:
+            for name, lineno, complexity in function_complexities(path):
+                key = f"{path.as_posix()}::{name}"
+                seen_keys.add(key)
+                limit = ALLOWED.get(key, DEFAULT_LIMIT)
+                worst.append((complexity, key, lineno))
+                if complexity > limit:
+                    failures.append((key, lineno, complexity, limit))
+    # A stale exception (renamed/removed/refactored-under-ceiling function)
+    # must not linger as a silent future budget.
+    if not argv:  # only when scanning the default tree the list describes
+        for key in sorted(set(ALLOWED) - seen_keys):
+            failures.append((key, 0, 0, "stale allowlist entry"))
+    worst.sort(reverse=True)
+    print("complexity gate: top functions")
+    for complexity, key, lineno in worst[:8]:
+        print(f"  {complexity:3d}  {key}:{lineno}")
+    if failures:
+        print("\nFAIL: over budget")
+        for key, lineno, complexity, limit in failures:
+            print(f"  {key}:{lineno} complexity {complexity} > {limit}")
+        return 1
+    print(f"\nOK: {len(worst)} functions within budget (ceiling {DEFAULT_LIMIT})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
