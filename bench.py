@@ -227,6 +227,31 @@ def bench_pod_storm(num_pods=10_000, concurrencies=(8, 32, 128)):
     return results
 
 
+def _config_lp_bound(groups, fleet, greedy_cost):
+    """Aggregate fractional-LP floor of cost_ratio_lowest_price for one
+    config (ops/mix_pack.aggregate_lp_bound over the config's own fleet),
+    or None when scipy/greedy denominators are unavailable."""
+    try:
+        from karpenter_tpu.models.solver import _pool_price_matrix
+        from karpenter_tpu.ops.mix_pack import aggregate_lp_bound
+
+        if not greedy_cost:
+            return None
+        _, pool_prices = _pool_price_matrix(fleet)
+        pool_floor = np.where(
+            np.isfinite(pool_prices), pool_prices, np.inf
+        ).min(axis=1)
+        demand = (
+            groups.counts.astype(np.float64)[:, None] * groups.vectors
+        ).sum(axis=0)
+        bound = aggregate_lp_bound(fleet.capacity, pool_floor, demand)
+        if bound is None:
+            return None
+        return round(bound[0] / greedy_cost, 4)
+    except Exception:
+        return None
+
+
 def main():
     from karpenter_tpu.api.provisioner import Constraints
     from karpenter_tpu.models.solver import CostSolver, GreedySolver
@@ -450,6 +475,11 @@ def main():
             )
             if c_ideal
             else 1.0,
+            # Each config's own fractional floor: the achieved list-price
+            # ratio should be judged against what is attainable AT THIS
+            # SCALE (small configs have higher floors — fewer nodes means
+            # integrality costs more), not against zero.
+            "lp_bound": _config_lp_bound(c_groups, c_fleet, c_ideal),
         }
 
     # Stretch scale, BEYOND the north star: where the device path's flat
@@ -515,23 +545,7 @@ def main():
     # lower-bounds ANY feasible plan's projected cost — integral packings
     # can only be worse (bin-packing integrality). Published so the achieved
     # ratio is judged against what is attainable, not against zero.
-    lowest_price_bound = None
-    try:
-        from karpenter_tpu.models.solver import _pool_price_matrix
-        from karpenter_tpu.ops.mix_pack import aggregate_lp_bound
-
-        _, pool_prices_b = _pool_price_matrix(fleet)
-        pool_floor_b = np.where(
-            np.isfinite(pool_prices_b), pool_prices_b, np.inf
-        ).min(axis=1)
-        demand_b = (
-            groups.counts.astype(np.float64)[:, None] * groups.vectors
-        ).sum(axis=0)
-        lp_bound = aggregate_lp_bound(fleet.capacity, pool_floor_b, demand_b)
-        if lp_bound is not None and greedy_ideal:
-            lowest_price_bound = round(lp_bound[0] / greedy_ideal, 4)
-    except Exception:
-        pass
+    lowest_price_bound = _config_lp_bound(groups, fleet, greedy_ideal)
 
     print(
         json.dumps(

@@ -25,6 +25,10 @@ from pathlib import Path
 
 DEFAULT_LIMIT = 15
 
+# Allowlist keys are repo-root-relative regardless of how the scanned path
+# was spelled (absolute, ./-prefixed, or from another cwd).
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
 # function qualname -> allowed budget, grandfathered at the complexity
 # each function had when the gate landed (the reference's gocyclo gate
 # likewise carried a short exception list above its ceiling). Every entry
@@ -80,8 +84,11 @@ class _Counter(ast.NodeVisitor):
 
 def function_complexities(path: Path):
     """(qualname, lineno, complexity) per function/lambda. Qualnames carry
-    the class/function nesting path (Class.method, outer.inner, f.<lambda>)
-    so allowlist keys can never collide with a same-named sibling."""
+    the class/function nesting path (Class.method, outer.inner), so
+    same-named functions in DIFFERENT scopes cannot share an allowlist
+    budget; lambdas are keyed by line (several can share a scope). Two
+    conditionally-defined same-named defs in one scope do share a key —
+    the higher one governs, so don't allowlist such functions."""
     tree = ast.parse(path.read_text())
 
     def walk(node: ast.AST, prefix: str):
@@ -89,7 +96,7 @@ def function_complexities(path: Path):
             if isinstance(
                 child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
             ):
-                name = getattr(child, "name", "<lambda>")
+                name = getattr(child, "name", f"<lambda:L{child.lineno}>")
                 qualname = f"{prefix}{name}"
                 counter = _Counter()
                 body = (
@@ -110,7 +117,7 @@ def function_complexities(path: Path):
 
 
 def main(argv) -> int:
-    roots = [Path(p) for p in argv] or [Path("karpenter_tpu")]
+    roots = [Path(p) for p in argv] or [REPO_ROOT / "karpenter_tpu"]
     missing = [root for root in roots if not root.exists()]
     if missing:
         print(f"ERROR: no such path: {', '.join(map(str, missing))}")
@@ -121,8 +128,13 @@ def main(argv) -> int:
     for root in roots:
         files = [root] if root.is_file() else sorted(root.rglob("*.py"))
         for path in files:
+            resolved = path.resolve()
+            try:
+                rel = resolved.relative_to(REPO_ROOT).as_posix()
+            except ValueError:  # scanned tree outside the repo
+                rel = path.as_posix()
             for name, lineno, complexity in function_complexities(path):
-                key = f"{path.as_posix()}::{name}"
+                key = f"{rel}::{name}"
                 seen_keys.add(key)
                 limit = ALLOWED.get(key, DEFAULT_LIMIT)
                 worst.append((complexity, key, lineno))
