@@ -17,6 +17,7 @@ import threading
 from karpenter_tpu.cloudprovider import registry
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.runtime import LeaderElector, LeaderLock, Manager, serve_http
+from karpenter_tpu.utils.gctune import tune_gc
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils import options as options_pkg
 
@@ -38,6 +39,7 @@ def build_cluster(options) -> Cluster:
 
 
 def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
+    tune_gc()  # long-running service: GOGC-style collector headroom
     options = options_pkg.parse(argv)
     log = klog.setup(options.log_level)
     log.info(

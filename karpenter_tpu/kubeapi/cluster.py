@@ -326,17 +326,31 @@ class ApiServerCluster(Cluster):
 
     # --- pods ---------------------------------------------------------------
 
-    def apply_pod(self, pod: PodSpec) -> PodSpec:
-        body = convert.pod_to_kube(pod)
-        path = _pod_path(pod.namespace, pod.name)
-        existing = self.api.try_get(path)
+    def _create_or_update(self, collection_path: str, obj_path: str, body: dict):
+        """Create-first apply: POST, and only on 409 (already exists) GET the
+        current resourceVersion and PUT. The common case (new object — every
+        pod of a storm) is one RPC instead of the GET-then-POST pair, which
+        at 10k-pod scale halves the write-plane round trips."""
+        try:
+            return self.api.create(collection_path, body)
+        except ApiError as error:
+            if error.status != 409:
+                raise
+        existing = self.api.try_get(obj_path)
         if existing is None:
-            created = self.api.create(_pod_path(pod.namespace), body)
-        else:
-            body.setdefault("metadata", {})["resourceVersion"] = (
-                existing.get("metadata", {}).get("resourceVersion")
-            )
-            created = self.api.update(path, body)
+            # Deleted between our 409 and the GET: retry the create once.
+            return self.api.create(collection_path, body)
+        body.setdefault("metadata", {})["resourceVersion"] = (
+            existing.get("metadata", {}).get("resourceVersion")
+        )
+        return self.api.update(obj_path, body)
+
+    def apply_pod(self, pod: PodSpec) -> PodSpec:
+        created = self._create_or_update(
+            _pod_path(pod.namespace),
+            _pod_path(pod.namespace, pod.name),
+            convert.pod_to_kube(pod),
+        )
         self._record_rv("pod", created)
         return super().apply_pod(pod)
 
@@ -405,11 +419,7 @@ class ApiServerCluster(Cluster):
                 "selector": {"matchLabels": dict(match_labels)},
             },
         }
-        existing = self.api.try_get(f"{path}/{name}")
-        if existing is None:
-            self.api.create(path, body)
-        else:
-            self.api.update(f"{path}/{name}", body)
+        self._create_or_update(path, f"{path}/{name}", body)
         super().apply_pdb(name, match_labels, min_available)
 
     # --- nodes --------------------------------------------------------------
@@ -469,16 +479,11 @@ class ApiServerCluster(Cluster):
     # --- provisioners --------------------------------------------------------
 
     def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:
-        body = convert.provisioner_to_kube(provisioner)
-        path = f"{PROVISIONERS}/{provisioner.name}"
-        existing = self.api.try_get(path)
-        if existing is None:
-            created = self.api.create(PROVISIONERS, body)
-        else:
-            body.setdefault("metadata", {})["resourceVersion"] = (
-                existing.get("metadata", {}).get("resourceVersion")
-            )
-            created = self.api.update(path, body)
+        created = self._create_or_update(
+            PROVISIONERS,
+            f"{PROVISIONERS}/{provisioner.name}",
+            convert.provisioner_to_kube(provisioner),
+        )
         self._record_rv("provisioner", created)
         return super().apply_provisioner(provisioner)
 
@@ -512,11 +517,7 @@ class ApiServerCluster(Cluster):
             "spec": {"template": convert.pod_to_kube(pod_template)},
         }
         path = f"{DAEMONSETS.replace('/daemonsets', '')}/namespaces/default/daemonsets"
-        existing = self.api.try_get(f"{path}/{name}")
-        if existing is None:
-            self.api.create(path, body)
-        else:
-            self.api.update(f"{path}/{name}", body)
+        self._create_or_update(path, f"{path}/{name}", body)
         super().apply_daemonset(name, pod_template)
 
     # --- leases ---------------------------------------------------------------

@@ -450,6 +450,9 @@ class SolverServer:
 
 
 def main(argv=None) -> None:
+    from karpenter_tpu.utils.gctune import tune_gc
+
+    tune_gc()  # long-running service: GOGC-style collector headroom
     parser = argparse.ArgumentParser(description="karpenter-tpu solver sidecar")
     parser.add_argument("--port", type=int, default=9090)
     parser.add_argument("--host", default="0.0.0.0")

@@ -7,6 +7,7 @@ format for scraping.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Dict, List, Sequence, Tuple
@@ -21,6 +22,26 @@ DURATION_BUCKETS = (
 )
 
 LabelValues = Tuple[str, ...]
+
+
+class _Timer:
+    """Histogram.measure() context manager, hoisted to module level — the
+    previous closure built a fresh class object per measured block, which at
+    one measure per reconcile was real storm-path overhead."""
+
+    __slots__ = ("_histogram", "_labels", "start")
+
+    def __init__(self, histogram: "Histogram", labels: LabelValues):
+        self._histogram = histogram
+        self._labels = labels
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._histogram.observe(time.perf_counter() - self.start, *self._labels)
+        return False
 
 
 class Gauge:
@@ -99,29 +120,43 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, value: float, *label_values: str) -> None:
+        # Per-bin (non-cumulative) storage, one bisect per observe: every
+        # reconcile crosses this under a process-wide lock, and an O(buckets)
+        # loop here convoys the 8-way selection pool during a pod storm
+        # (sampled as the single largest busy stack in bench_pod_storm).
+        # render() restores Prometheus's cumulative view.
         key = tuple(label_values)
+        index = bisect.bisect_left(self.buckets, value)
         with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            for i, bound in enumerate(self.buckets):
-                if value <= bound:
-                    counts[i] += 1
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+            counts[index] += 1  # index == len(buckets) → the +Inf bin
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def observe_many(self, values: Sequence[float], *label_values: str) -> None:
+        """Record a batch of observations under ONE lock acquisition — the
+        reconcile loops observe per-key durations chunk-at-a-time so a
+        128-thread pool doesn't convoy on this lock (one acquire per chunk
+        instead of per reconcile)."""
+        if not values:
+            return
+        key = tuple(label_values)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+            total = 0.0
+            for value in values:
+                counts[bisect.bisect_left(self.buckets, value)] += 1
+                total += value
+            self._sums[key] = self._sums.get(key, 0.0) + total
+            self._totals[key] = self._totals.get(key, 0) + len(values)
+
     def measure(self, *label_values: str):
         """Context manager timing a block (ref: metrics.Measure defer-timer)."""
-        histogram = self
-
-        class _Timer:
-            def __enter__(self):
-                self.start = time.perf_counter()
-                return self
-
-            def __exit__(self, *exc):
-                histogram.observe(time.perf_counter() - self.start, *label_values)
-                return False
-
-        return _Timer()
+        return _Timer(self, label_values)
 
     def count(self, *label_values: str) -> int:
         with self._lock:
@@ -133,9 +168,11 @@ class Histogram:
             for key, counts in sorted(self._counts.items()):
                 base = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
                 sep = "," if base else ""
+                running = 0
                 for bound, count in zip(self.buckets, counts):
+                    running += count
                     lines.append(
-                        f'{self.name}_bucket{{{base}{sep}le="{bound}"}} {count}'
+                        f'{self.name}_bucket{{{base}{sep}le="{bound}"}} {running}'
                     )
                 lines.append(
                     f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {self._totals[key]}'
