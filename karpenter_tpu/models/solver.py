@@ -48,6 +48,13 @@ SOLVE_DISPATCH_TOTAL = REGISTRY.counter(
     "Cost solves by routed dispatch path (host|device)",
     ["path"],
 )
+# Boot-measured dispatch calibration (calibrate_break_even): the probed
+# fetch floor, host solve rate, and the derived routing thresholds.
+BREAK_EVEN_GAUGE = REGISTRY.gauge(
+    "solver_break_even",
+    "Host/device break-even calibration measured at boot",
+    ["quantity"],
+)
 
 
 class Solver(abc.ABC):
@@ -55,6 +62,11 @@ class Solver(abc.ABC):
     constraints (the scheduler groups them; ref: scheduling/scheduler.go:67).
     `solve` densifies specs then delegates to `solve_encoded`, the
     tensor-level entry point the benchmark and sidecar call directly."""
+
+    # Device-backed solvers carry XLA compile debt the first time each
+    # (groups, types) bucket is hit; deployments that embed one warm the
+    # bucket ladder at boot (models/warmup.py) behind their readiness gate.
+    needs_device_warmup = False
 
     def solve(
         self,
@@ -678,6 +690,8 @@ class TPUSolver(Solver):
     powers of two so repeat solves hit the jit cache.
     """
 
+    needs_device_warmup = True
+
     def __init__(self, mode: str = "ffd", quirk: bool = False):
         self.mode = mode
         self.quirk = quirk
@@ -900,7 +914,9 @@ def compute_mix_candidate(
 # types host-solves in ~49ms vs ~94ms on device (same cost ratios under
 # both accountings); at 50k × 400 the device wins (~93ms vs ~157ms host)
 # and additionally scales via mesh sharding. 10k is the last measured
-# point where host wins.
+# point where host wins — it is also the CAP on boot calibration below:
+# past it the host's own superlinear growth (types × pods FFD walk) is
+# unvalidated territory regardless of how slow the fetch is.
 HOST_SOLVE_MAX_PODS = 10_000
 # The BATCHED paths (solve_encoded_many, the sidecar's SolveStream) share
 # ONE device fetch across K schedules, so the per-schedule device cost is
@@ -908,6 +924,135 @@ HOST_SOLVE_MAX_PODS = 10_000
 # there must clear a much lower bar (and it runs serially on the intake
 # thread): only schedules whose host solve is a few ms qualify.
 HOST_SOLVE_MAX_PODS_BATCHED = 2_000
+
+# Device compute for the fused kernel once the fetch is paid: ~20-25ms and
+# roughly flat across the ladder (the round loop, not the payload,
+# dominates) — measured on the bench rig at 10k×200 (94ms total − 70ms
+# floor) and 50k×400 (93ms − 70ms). Used by break-even calibration as the
+# device-side cost the host must beat on top of the fetch floor.
+DEVICE_COMPUTE_EST_MS = 22.0
+
+
+@dataclass
+class BreakEven:
+    """Boot-measured host/device dispatch calibration (VERDICT r4 weak #4:
+    the 10k constant encodes the bench rig's ~70ms tunnel floor; co-located
+    TPUs have sub-ms floors and a far lower break-even)."""
+
+    fetch_floor_ms: float
+    host_ms_per_pod: float
+    max_pods: int
+    max_pods_batched: int
+
+
+_break_even: Optional[BreakEven] = None
+_break_even_lock = threading.Lock()
+
+
+def _probe_fetch_floor_ms(reps: int = 3) -> float:
+    """One device->host round trip with a negligible payload — the same
+    fetch path _to_host uses (bench.py publishes the identical probe as
+    device_fetch_floor_ms). min-of-reps: the floor, not the noise."""
+    import time as _time
+
+    probe = jnp.zeros((8,), jnp.int32) + 1
+    jax.block_until_ready(probe)
+    samples = []
+    for _ in range(reps):
+        start = _time.perf_counter()
+        jax.device_get(probe)
+        samples.append((_time.perf_counter() - start) * 1e3)
+    return min(samples)
+
+
+def _probe_host_rate_ms_per_pod(num_pods: int = 2_000, num_types: int = 64) -> float:
+    """Measure the compiled host solve on a synthetic mid-ladder shape and
+    return ms per pod. Returns inf when the native library is unavailable
+    (host path can't run at all)."""
+    import time as _time
+
+    from karpenter_tpu.ops import native as native_mod
+
+    if not native_mod.available():
+        return float("inf")
+    from karpenter_tpu.models.warmup import make_synthetic_problem
+
+    vectors, counts, capacity = make_synthetic_problem(
+        64, num_types, pods_per_group=num_pods // 64
+    )
+    counts = counts.astype(np.int64)
+    start = _time.perf_counter()
+    native_mod.ffd_pack_rounds(
+        vectors, counts, capacity, capacity.copy(), quirk=False
+    )
+    elapsed_ms = (_time.perf_counter() - start) * 1e3
+    return elapsed_ms / float(counts.sum())
+
+
+def calibrate_break_even(
+    fetch_floor_ms: Optional[float] = None,
+    host_ms_per_pod: Optional[float] = None,
+    device_compute_ms: Optional[float] = None,
+) -> BreakEven:
+    """Derive the host/device break-even from measured quantities instead
+    of the baked-in rig constant. Host wins while
+    host_ms_per_pod × n < fetch_floor + device_compute; the result is
+    capped at HOST_SOLVE_MAX_PODS (the last point host-wins was ever
+    validated) and floored at 0 (a sub-ms fetch floor routes everything
+    but trivial solves to the device). Called from boot warmup
+    (models/warmup.py), which also MEASURES device_compute_ms on the live
+    backend (a warm mid-ladder solve minus the fetch floor) — the
+    DEVICE_COMPUTE_EST_MS constant is only the fallback when no
+    measurement is supplied. Explicit arguments override probes (unit
+    tests stub timings this way); processes that never warm keep the
+    measured-rig defaults.
+
+    Both the calibration and the probes export through /metrics
+    (karpenter_solver_break_even gauge family)."""
+    global _break_even
+    with _break_even_lock:
+        floor = (
+            _probe_fetch_floor_ms() if fetch_floor_ms is None else fetch_floor_ms
+        )
+        rate = (
+            _probe_host_rate_ms_per_pod()
+            if host_ms_per_pod is None
+            else host_ms_per_pod
+        )
+        device_ms = (
+            DEVICE_COMPUTE_EST_MS if device_compute_ms is None else device_compute_ms
+        )
+        if rate <= 0 or not np.isfinite(rate):
+            max_pods = 0  # no host path at all
+        else:
+            max_pods = int((floor + device_ms) / rate)
+        max_pods = min(max_pods, HOST_SOLVE_MAX_PODS)
+        # The batched bar scales with the single-solve one (today's 2k is
+        # 1/5 of 10k): those paths amortize one fetch over the whole batch.
+        max_batched = min(max_pods // 5, HOST_SOLVE_MAX_PODS_BATCHED)
+        _break_even = BreakEven(
+            fetch_floor_ms=floor,
+            host_ms_per_pod=rate,
+            max_pods=max_pods,
+            max_pods_batched=max_batched,
+        )
+        BREAK_EVEN_GAUGE.set(floor, "fetch_floor_ms")
+        BREAK_EVEN_GAUGE.set(rate, "host_ms_per_pod")
+        BREAK_EVEN_GAUGE.set(device_ms, "device_compute_ms")
+        BREAK_EVEN_GAUGE.set(max_pods, "host_max_pods")
+        BREAK_EVEN_GAUGE.set(max_batched, "host_max_pods_batched")
+        return _break_even
+
+
+def break_even() -> Optional[BreakEven]:
+    return _break_even
+
+
+def reset_break_even() -> None:
+    """Test hook: return the gate to the uncalibrated defaults."""
+    global _break_even
+    with _break_even_lock:
+        _break_even = None
 
 
 def cost_solve_host(
@@ -947,6 +1092,37 @@ def cost_solve_host(
     )
 
 
+# While a deployment's boot warmup is compiling the bucket ladder, solves
+# prefer the host path — identical plans at steady-state host latency
+# instead of multi-second cold-compile stalls (the in-process Manager
+# analogue of the sidecar's "warming" health state, where clients
+# host-solve until grpc.health.v1 reports ok). Refcounted, not boolean:
+# overlapping warmups (a Manager embedding CostSolver plus an in-process
+# sidecar) must not have the first finisher cancel the second's window.
+_WARMING_HOST_PREFERENCE = threading.Event()
+_warming_refs = 0
+_warming_lock = threading.Lock()
+
+# The warming preference covers solves up to the largest host measurement
+# on record — the stretch baselines run the compiled host packer at
+# 100k×400 in ~245ms and 200k×800 in ~872ms (BASELINE.md), both far under
+# a multi-second cold compile. Past that the host path is genuinely
+# unmeasured, so warming solves fall through to the device and pay the
+# compile rather than gamble.
+HOST_WARMING_MAX_PODS = 200_000
+
+
+def set_warming_host_preference(active: bool) -> None:
+    global _warming_refs
+    with _warming_lock:
+        _warming_refs += 1 if active else -1
+        _warming_refs = max(_warming_refs, 0)
+        if _warming_refs > 0:
+            _WARMING_HOST_PREFERENCE.set()
+        else:
+            _WARMING_HOST_PREFERENCE.clear()
+
+
 def host_solve_enabled(num_pods: int, batched: bool = False) -> bool:
     """Policy gate for the host path (KARPENTER_HOST_SOLVE=0 forces the
     device path, =1 forces host regardless of size). Requires the native
@@ -966,12 +1142,20 @@ def host_solve_enabled(num_pods: int, batched: bool = False) -> bool:
         return False
     if flag in ("1", "true", "on"):
         return True
+    if _WARMING_HOST_PREFERENCE.is_set() and num_pods <= HOST_WARMING_MAX_PODS:
+        # Boot warmup in flight: every device bucket is potentially cold,
+        # including the sharded one — host answers at steady state now.
+        return True
     if sharded_solve_active():
         # Multi-chip runtime: the operator provisioned a mesh precisely so
         # solves ride it (and the sharded path is what dryrun/parity checks
         # must exercise) — the host path is a single-chip latency trade.
         return False
-    limit = HOST_SOLVE_MAX_PODS_BATCHED if batched else HOST_SOLVE_MAX_PODS
+    calibrated = _break_even
+    if calibrated is not None:
+        limit = calibrated.max_pods_batched if batched else calibrated.max_pods
+    else:
+        limit = HOST_SOLVE_MAX_PODS_BATCHED if batched else HOST_SOLVE_MAX_PODS
     return num_pods <= limit
 
 
@@ -1307,8 +1491,19 @@ class CostSolver(Solver):
     baseline. Thin object shell over cost_solve_dense — the same core the
     gRPC sidecar serves."""
 
+    needs_device_warmup = True
+
     def __init__(self, lp_steps: int = 300):
         self.lp_steps = lp_steps
+
+    @staticmethod
+    def host_fallback_available() -> bool:
+        """True when the warming-time host path can serve solves (native
+        FFD present) — lets the Manager keep provisioning during boot
+        warmup instead of holding batches."""
+        from karpenter_tpu.ops import native as native_mod
+
+        return native_mod.available()
 
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         if fleet.num_types == 0 or groups.num_groups == 0:

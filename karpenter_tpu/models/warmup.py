@@ -1,0 +1,120 @@
+"""Boot-time bucket-ladder precompile, shared by every deployment shape.
+
+The device solve path jit-compiles one kernel per (groups, types) bucket;
+the first solve at a cold bucket pays seconds of XLA compile. The reference
+has no compile step at all (cmd/controller/main.go:61-99 goes straight from
+registration to serving), so a deployment must pay that debt at boot —
+never on a live batch. The solver sidecar runs this behind its
+grpc.health.v1 gate (solver_service/server.py), and the in-process Manager
+runs it behind /readyz (runtime.py) — same contract, both callers.
+
+Shapes come from KARPENTER_WARMUP_SHAPES ("GxT,GxT,..."; the default covers
+the small/medium/headline buckets). On multi-chip runtimes
+cost_solve_dispatch's mesh auto-selection means this also compiles the
+sharded kernel.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from karpenter_tpu.utils import logging as klog
+
+DEFAULT_SHAPES = "8x8,8x16,16x64,16x512"
+
+log = klog.named("warmup")
+
+
+def make_synthetic_problem(num_groups: int, num_types: int, pods_per_group: int = 1):
+    """One synthetic dense solve problem — (vectors, counts, capacity) —
+    shared by the warmup compile pass and the break-even probes so the
+    shapes they compile and the shapes they time can never drift apart."""
+    rng = np.random.default_rng(0)
+    vectors = np.zeros((num_groups, 8), np.float32)
+    vectors[:, 0] = rng.integers(1, 9, num_groups) * 250
+    vectors[:, 1] = rng.integers(1, 17, num_groups) * 256
+    vectors[:, 2] = 1.0
+    counts = np.full(num_groups, pods_per_group, np.int32)
+    sizes = np.arange(1, num_types + 1, dtype=np.float32)
+    capacity = np.zeros((num_types, 8), np.float32)
+    capacity[:, 0] = 4000.0 * sizes
+    capacity[:, 1] = 16384.0 * sizes
+    capacity[:, 2] = 110.0
+    return vectors, counts, capacity
+
+
+def warmup_ladder(shapes: str | None = None) -> float:
+    """Precompile the bucket ladder; returns elapsed seconds. Each shape
+    failure is logged and skipped — warmup must never kill a boot."""
+    from karpenter_tpu.models import solver as solver_models
+
+    if shapes is None:
+        shapes = os.environ.get("KARPENTER_WARMUP_SHAPES", DEFAULT_SHAPES)
+    start = time.perf_counter()
+    # Solves racing this warmup prefer the host path (steady-state latency)
+    # over cold device buckets; cleared in the finally below.
+    solver_models.set_warming_host_preference(True)
+    try:
+        _compile_shapes(shapes)
+    finally:
+        solver_models.set_warming_host_preference(False)
+    # With the ladder warm the device path is live — measure the actual
+    # fetch floor, host rate, AND warm device compute on THIS rig and
+    # derive the host/device break-even from them (instead of the baked-in
+    # bench-rig constants). Device compute = a warm re-solve of the
+    # mid-ladder shape minus the fetch floor, measured on whatever backend
+    # this process actually runs (a jax-CPU fallback rig times ITS kernel,
+    # not the TPU's).
+    try:
+        floor_ms = solver_models._probe_fetch_floor_ms()
+        warm_solve_ms = _timed_device_solve_ms(16, 64)
+        device_compute_ms = max(warm_solve_ms - floor_ms, 1.0)
+        cal = solver_models.calibrate_break_even(
+            fetch_floor_ms=floor_ms, device_compute_ms=device_compute_ms
+        )
+        log.info(
+            "dispatch break-even: fetch floor %.2fms, host %.4fms/pod "
+            "-> host <= %d pods (batched <= %d)",
+            cal.fetch_floor_ms, cal.host_ms_per_pod,
+            cal.max_pods, cal.max_pods_batched,
+        )
+    except Exception:  # noqa: BLE001 — calibration must never kill boot
+        log.warning("break-even calibration failed", exc_info=True)
+    elapsed = time.perf_counter() - start
+    log.info("bucket ladder warm in %.1fs (%s)", elapsed, shapes)
+    return elapsed
+
+
+def _compile_shapes(shapes: str) -> None:
+    from karpenter_tpu.models import solver as solver_models
+
+    for token in shapes.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        try:
+            num_groups, num_types = (int(x) for x in token.split("x"))
+            _timed_device_solve_ms(num_groups, num_types)
+        except Exception:  # noqa: BLE001 — warmup must never kill boot
+            log.warning("warmup shape %s failed", token, exc_info=True)
+
+
+def _timed_device_solve_ms(num_groups: int, num_types: int) -> float:
+    """Run one device solve at the given shape (compiling it if cold) and
+    return its wall time — the warmup compile pass and the device-compute
+    probe are the same call."""
+    from karpenter_tpu.models import solver as solver_models
+
+    vectors, counts, capacity = make_synthetic_problem(num_groups, num_types)
+    prices = (0.1 * np.arange(1, num_types + 1, dtype=np.float32))
+    start = time.perf_counter()
+    solver_models._to_host(
+        solver_models.cost_solve_dispatch(
+            vectors, counts, capacity, capacity.copy(), prices, 300,
+            count=False,  # warmup, not a routed solve
+        )
+    )
+    return (time.perf_counter() - start) * 1e3

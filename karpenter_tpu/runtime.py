@@ -342,8 +342,8 @@ class Manager:
         self.cloud = cloud
         self.options = options
         self.log = klog.named("manager")
-        solver = make_solver(options.solver, options.solver_endpoint)
-        self.provisioning = ProvisioningController(cluster, cloud, solver)
+        self.solver = make_solver(options.solver, options.solver_endpoint)
+        self.provisioning = ProvisioningController(cluster, cloud, self.solver)
         self.selection = SelectionController(cluster, self.provisioning)
         self.termination = TerminationController(cluster, cloud)
         self.node = NodeController(cluster)
@@ -351,6 +351,16 @@ class Manager:
         self.metrics = MetricsController(cluster)
         self.podgc = PodGcController(cluster)
         self.ready = threading.Event()
+        # Set once the solver's compile debt is paid (immediately for host
+        # solvers). Gates /readyz AND the batch loop: a batch window that
+        # closes during warmup holds its pods until the ladder is compiled,
+        # so the first live solve runs at steady state — the reference boots
+        # with zero compile debt (cmd/controller/main.go:61-99), and with
+        # this, so does the default in-process deployment.
+        self.warm = threading.Event()
+        self._warming_can_serve = bool(
+            getattr(self.solver, "host_fallback_available", lambda: False)()
+        )
         self._stop = threading.Event()
 
         # Reconcile loops. The reference runs selection at
@@ -421,6 +431,12 @@ class Manager:
 
     def _batch_loop(self) -> None:
         while not self._stop.wait(timeout=BATCH_IDLE_SECONDS / 5):
+            if not self.warm.is_set() and not self._warming_can_serve:
+                # No host fallback: batches accumulate until the ladder is
+                # compiled, so no live batch ever pays the jit stall. With a
+                # fallback, provisioning continues — solves route host-side
+                # via the warming preference (models/solver.py).
+                continue
             for worker in list(self.provisioning.workers.values()):
                 if worker.batch_ready():
                     try:
@@ -455,7 +471,30 @@ class Manager:
         for node in self.cluster.list_nodes():
             self.loops["node"].enqueue(node.name)
         self.loops["podgc"].enqueue("sweep")
-        self.ready.set()
+        if getattr(self.solver, "needs_device_warmup", False):
+            threading.Thread(
+                target=self._warmup, name="solver-warmup", daemon=True
+            ).start()
+        else:
+            self.warm.set()
+            self.ready.set()
+
+    def _warmup(self) -> None:
+        """In-process analogue of the sidecar's boot warmup
+        (solver_service/server.py): reconcile loops serve immediately;
+        /readyz and the batch loop wait for the ladder."""
+        try:
+            from karpenter_tpu.models.warmup import warmup_ladder
+
+            warmup_ladder()
+        except Exception:  # noqa: BLE001 — warmup must never wedge boot
+            self.log.exception("solver warmup failed; serving anyway")
+        self.warm.set()
+        if not self._stop.is_set():
+            # A manager stopped mid-warmup (deposed leader) must stay
+            # not-ready — re-asserting readiness here would flip /readyz
+            # back to 200 on a replica whose loops are all stopped.
+            self.ready.set()
 
     def stop(self) -> None:
         self._stop.set()

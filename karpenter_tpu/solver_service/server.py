@@ -396,50 +396,15 @@ class SolverServer:
         """Precompile the bucket ladder (and, via cost_solve_dispatch's mesh
         auto-selection, the sharded kernel on multi-chip runtimes) BEFORE
         health reports ok, so warmup_compile_s is paid at boot, never by a
-        live batch. Shapes come from KARPENTER_WARMUP_SHAPES ("GxT,GxT,...",
-        default covers the small/medium/headline buckets).
+        live batch (models/warmup.py — shared with the in-process Manager).
 
         Ref: the reference has no compile step at all — its first batch is
         never seconds late; with this, neither is ours (VERDICT r3 §missing
         3). Serving starts immediately; readiness (health != ok) keeps
         traffic away until the ladder is warm."""
-        import os
+        from karpenter_tpu.models.warmup import warmup_ladder
 
-        shapes = os.environ.get(
-            "KARPENTER_WARMUP_SHAPES", "8x16,16x64,16x512"
-        )
-        start = time.perf_counter()
-        for token in shapes.split(","):
-            token = token.strip()
-            if not token:
-                continue
-            try:
-                num_groups, num_types = (int(x) for x in token.split("x"))
-                rng = np.random.default_rng(0)
-                vectors = np.zeros((num_groups, 8), np.float32)
-                vectors[:, 0] = rng.integers(1, 9, num_groups) * 250
-                vectors[:, 1] = rng.integers(1, 17, num_groups) * 256
-                vectors[:, 2] = 1.0
-                counts = np.ones(num_groups, np.int32)
-                sizes = np.arange(1, num_types + 1, dtype=np.float32)
-                capacity = np.zeros((num_types, 8), np.float32)
-                capacity[:, 0] = 4000.0 * sizes
-                capacity[:, 1] = 16384.0 * sizes
-                capacity[:, 2] = 110.0
-                solver_models._to_host(
-                    solver_models.cost_solve_dispatch(
-                        vectors, counts, capacity, capacity.copy(),
-                        (0.1 * sizes).astype(np.float32), 300,
-                        count=False,  # warmup, not a routed solve
-                    )
-                )
-            except Exception:  # noqa: BLE001 — warmup must never kill boot
-                log.warning("warmup shape %s failed", token, exc_info=True)
-        log.info(
-            "bucket ladder warm in %.1fs (%s)",
-            time.perf_counter() - start,
-            shapes,
-        )
+        warmup_ladder()
         self.handler.warmed.set()
 
     def stop(self, grace: Optional[float] = None) -> None:
