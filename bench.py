@@ -243,15 +243,30 @@ def bench_pod_storm(num_pods=10_000, concurrencies=(8, 32, 128)):
 
 
 def _config_lp_bound(groups, fleet, greedy_cost):
-    """Aggregate fractional-LP floor of cost_ratio_lowest_price for one
-    config (ops/mix_pack.aggregate_lp_bound over the config's own fleet),
-    or None when scipy/greedy denominators are unavailable."""
+    """Two published floors of cost_ratio_lowest_price for one config:
+
+    - lp_bound_aggregate: the aggregate fractional LP (capacity covers
+      total demand) — always a valid lower bound, but it ignores per-node
+      dimensional fragmentation and sits several points below anything
+      buildable from real node fills at mid-ladder scale.
+    - lp_bound: the ATTAINABLE floor — the cutting-stock covering LP over
+      actual single-node fills, certified optimal by exact MILP pricing
+      (mix_pack.certified_lp_floor: no feasible column anywhere prices
+      below the LP duals). Published as THE floor when certified; when
+      certification doesn't converge the aggregate bound is published
+      instead (a subset-column LP objective is not a valid bound).
+
+    Returns {lp_bound, lp_bound_aggregate, lp_bound_certified} or {}.
+    """
     try:
         from karpenter_tpu.models.solver import _pool_price_matrix
-        from karpenter_tpu.ops.mix_pack import aggregate_lp_bound
+        from karpenter_tpu.ops.mix_pack import (
+            aggregate_lp_bound,
+            certified_lp_floor,
+        )
 
         if not greedy_cost:
-            return None
+            return {}
         _, pool_prices = _pool_price_matrix(fleet)
         pool_floor = np.where(
             np.isfinite(pool_prices), pool_prices, np.inf
@@ -260,11 +275,19 @@ def _config_lp_bound(groups, fleet, greedy_cost):
             groups.counts.astype(np.float64)[:, None] * groups.vectors
         ).sum(axis=0)
         bound = aggregate_lp_bound(fleet.capacity, pool_floor, demand)
-        if bound is None:
-            return None
-        return round(bound[0] / greedy_cost, 4)
+        aggregate = round(bound[0] / greedy_cost, 4) if bound else None
+        floor = certified_lp_floor(
+            groups.vectors, groups.counts, fleet.capacity, pool_floor
+        )
+        out = {"lp_bound_aggregate": aggregate, "lp_bound_certified": False}
+        if floor is not None and floor[1]:
+            out["lp_bound"] = round(floor[0] / greedy_cost, 4)
+            out["lp_bound_certified"] = True
+        else:
+            out["lp_bound"] = aggregate
+        return out
     except Exception:
-        return None
+        return {}
 
 
 def main():
@@ -490,11 +513,12 @@ def main():
             )
             if c_ideal
             else 1.0,
-            # Each config's own fractional floor: the achieved list-price
-            # ratio should be judged against what is attainable AT THIS
-            # SCALE (small configs have higher floors — fewer nodes means
-            # integrality costs more), not against zero.
-            "lp_bound": _config_lp_bound(c_groups, c_fleet, c_ideal),
+            # Each config's own floors: the achieved list-price ratio is
+            # judged against what is ATTAINABLE at this scale — lp_bound is
+            # the exact-pricing-certified cutting-stock LP optimum (see
+            # _config_lp_bound); the looser aggregate bound is published
+            # alongside for continuity.
+            **_config_lp_bound(c_groups, c_fleet, c_ideal),
         }
 
     # Stretch scale, BEYOND the north star: where the device path's flat
@@ -507,7 +531,9 @@ def main():
         "s1_100k_400": (100_000, 400),
         "s2_200k_800": (200_000, 800),
     }.items():
-        s_pods, s_catalog, _ = make_workload(num_pods=n_pods, num_types=n_types)
+        s_pods, s_catalog, s_market = make_workload(
+            num_pods=n_pods, num_types=n_types
+        )
         s_groups = group_pods(s_pods)
         s_fleet = build_fleet(
             s_catalog, constraints, s_pods,
@@ -527,17 +553,28 @@ def main():
         s_p50 = float(np.percentile(s_lat, 50))
         s_base_p50 = float(np.percentile(s_base, 50))
         s_ideal = s_greedy.projected_cost()
+        # Market accounting + floors at stretch scale too (VERDICT r4
+        # missing #2): the cost story is two-legged everywhere the latency
+        # story is told.
+        s_g_cost = simulate_plan_cost(
+            s_greedy, constraints, s_market, ZONES, depth_slack=default_slack
+        )
+        s_o_cost = simulate_plan_cost(
+            s_ours, constraints, s_market, ZONES, depth_slack=default_slack
+        )
         stretch[label] = {
             "pods": n_pods,
             "types": n_types,
             "solve_p50_ms": round(s_p50, 2),
             "baseline_ms": round(s_base_p50, 2),
             "vs_baseline": round(s_base_p50 / s_p50, 2) if s_p50 else 0.0,
+            "cost_ratio": round(s_o_cost / s_g_cost, 4) if s_g_cost else 1.0,
             "cost_ratio_lowest_price": round(
                 s_ours.projected_cost() / s_ideal, 4
             )
             if s_ideal
             else 1.0,
+            **_config_lp_bound(s_groups, s_fleet, s_ideal),
         }
 
     # Watch->selection->batch->solve->bind pipeline under a 10k-pod storm,
@@ -555,11 +592,10 @@ def main():
     lowest_price_ratio = (
         cost_result.projected_cost() / greedy_ideal if greedy_ideal else 1.0
     )
-    # The hard floor of that ratio: the aggregate fractional LP (cover total
-    # demand with fractional nodes at each type's cheapest advertised pool)
-    # lower-bounds ANY feasible plan's projected cost — integral packings
-    # can only be worse (bin-packing integrality). Published so the achieved
-    # ratio is judged against what is attainable, not against zero.
+    # The floors of that ratio (see _config_lp_bound): the certified
+    # cutting-stock LP optimum (attainable up to integrality) published as
+    # THE bound, the looser aggregate LP alongside. Judged against what is
+    # attainable, not against zero.
     lowest_price_bound = _config_lp_bound(groups, fleet, greedy_ideal)
 
     print(
@@ -595,7 +631,15 @@ def main():
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),
-                "cost_ratio_lowest_price_lp_bound": lowest_price_bound,
+                "cost_ratio_lowest_price_lp_bound": lowest_price_bound.get(
+                    "lp_bound"
+                ),
+                "cost_ratio_lowest_price_lp_bound_aggregate": (
+                    lowest_price_bound.get("lp_bound_aggregate")
+                ),
+                "cost_ratio_lowest_price_lp_bound_certified": (
+                    lowest_price_bound.get("lp_bound_certified", False)
+                ),
                 "cost_ratio_sweep": sweep_cells,
                 "cost_ratio_sweep_worst_mean": round(sweep_worst_mean, 4),
                 "pods": len(pods),

@@ -338,3 +338,75 @@ class TestSolverIntegration:
         )
         assert packed == len(pods)
         assert cost.projected_cost() < greedy.projected_cost() * 0.9
+
+
+class TestCertifiedLpFloor:
+    """certified_lp_floor: the cutting-stock LP optimum with an
+    exact-pricing certificate — the ATTAINABLE floor bench publishes per
+    ladder config (the aggregate LP ignores per-node fragmentation and is
+    structurally loose at mid scale)."""
+
+    def test_certifies_and_orders_between_aggregate_and_integral(self):
+        vectors, counts, capacity, pool_floor = simple_problem()
+        floor = mix_pack.certified_lp_floor(
+            vectors, counts, capacity, pool_floor
+        )
+        assert floor is not None
+        objective, certified = floor
+        assert certified
+        # Valid ordering: aggregate LP <= cutting-stock LP <= any integral
+        # plan built of real fills (here: the integerized mix candidate).
+        demand = (counts[:, None] * vectors.astype(np.float64)).sum(axis=0)
+        aggregate = mix_pack.aggregate_lp_bound(capacity, pool_floor, demand)
+        assert aggregate is not None
+        assert aggregate[0] <= objective + 1e-6
+        rounds = mix_pack.mix_candidate(vectors, counts, capacity, pool_floor)
+        assert rounds is not None
+        integral_cost = sum(
+            repl
+            * mix_pack.price_columns(
+                fill[None, :], vectors, capacity, pool_floor
+            )[0]
+            for _, fill, repl in rounds
+        )
+        assert objective <= integral_cost + 1e-6
+
+    def test_pricing_loop_discovers_columns_the_enumeration_missed(self):
+        """A three-group complementary triple: pair enumeration tops off
+        greedily in FFD order and can miss the balanced triple fill; exact
+        pricing must recover it (or certify nothing better exists) — either
+        way the certified floor must not exceed the triple plan's cost."""
+        vectors = np.array(
+            [
+                [3000.0, 1024.0, 1.0],
+                [1000.0, 5120.0, 1.0],
+                [1000.0, 2048.0, 1.0],
+            ],
+            np.float32,
+        )
+        counts = np.array([30, 30, 30], np.int64)
+        capacity = np.array(
+            [
+                [5000.0, 8192.0, 16.0],  # fits exactly one of each
+                [3200.0, 2048.0, 16.0],
+                [1200.0, 6144.0, 16.0],
+            ],
+            np.float32,
+        )
+        pool_floor = np.array([0.30, 0.22, 0.20])
+        floor = mix_pack.certified_lp_floor(
+            vectors, counts, capacity, pool_floor
+        )
+        assert floor is not None and floor[1]
+        # 30 triple nodes at 0.30 cover everything.
+        assert floor[0] <= 30 * 0.30 + 1e-6
+
+    def test_returns_none_on_empty_problem(self):
+        vectors = np.zeros((0, 3), np.float32)
+        counts = np.zeros((0,), np.int64)
+        capacity = np.zeros((0, 3), np.float32)
+        pool_floor = np.zeros((0,))
+        assert (
+            mix_pack.certified_lp_floor(vectors, counts, capacity, pool_floor)
+            is None
+        )
