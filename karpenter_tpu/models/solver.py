@@ -750,6 +750,7 @@ def cost_solve_dense(
     prices: np.ndarray,
     pool_prices,
     lp_steps: int = 300,
+    explain: Optional[dict] = None,
 ) -> Optional[DenseSolveResult]:
     """The flagship solve on dense tensors only — shared by the in-process
     CostSolver and the gRPC sidecar (which has no PodSpec/InstanceType
@@ -777,7 +778,8 @@ def cost_solve_dense(
         if callable(pool_prices):
             pool_prices = pool_prices()
         dense = cost_solve_host(
-            vectors, counts, capacity, total, prices, pool_prices
+            vectors, counts, capacity, total, prices, pool_prices,
+            explain=explain,
         )
         if dense is not None:
             return dense
@@ -803,7 +805,7 @@ def cost_solve_dense(
 
     return cost_solve_finish(
         fetched, vectors, counts, capacity, total, prices, pool_prices,
-        mix_plan=mix_plan,
+        mix_plan=mix_plan, explain=explain,
     )
 
 
@@ -1062,6 +1064,7 @@ def cost_solve_host(
     total: np.ndarray,
     prices: np.ndarray,
     pool_prices: np.ndarray,
+    explain: Optional[dict] = None,
 ) -> Optional[DenseSolveResult]:
     """Host-only cost solve for problems under HOST_SOLVE_MAX_PODS: the
     compiled-C++ greedy FFD (reference-parity guarantee — greedy is always
@@ -1089,6 +1092,7 @@ def cost_solve_host(
         pool_prices,
         mix_plan=mix_plan,
         host_candidates=[ffd_result],
+        explain=explain,
     )
 
 
@@ -1204,34 +1208,15 @@ def cost_solve_dispatch(
     )
 
 
-def cost_solve_finish(
-    fetched,
-    vectors: np.ndarray,
-    counts: np.ndarray,
-    capacity: np.ndarray,
-    total: np.ndarray,
-    prices: np.ndarray,
-    pool_prices: np.ndarray,
-    mix_plan: Optional[
-        Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]
-    ] = None,
-    host_candidates: Optional[
-        List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]
-    ] = None,
-) -> Optional[DenseSolveResult]:
-    """Host-side candidate scoring + LP realization over fetched kernel
-    outputs (the second half of cost_solve_dense). mix_plan, when given, is
-    the column-LP candidate computed in the dispatch-to-fetch overlap window
-    (compute_mix_candidate) and competes on equal scoring terms. fetched may
-    be None (the cost_solve_host path): scoring then runs over
-    host_candidates + mix_plan only and the device-LP realization is
-    skipped."""
-    num_groups = int(vectors.shape[0])
+def _collect_candidates(fetched, num_groups: int, host_candidates, mix_plan):
+    """Assemble the candidate pool for scoring — kernel outputs (unpacked
+    from the fused fetch), host candidates, and the mix plan — in round
+    form, with a parallel label list for explain output. Returns
+    (candidates, labels, lp_assignment, feasible_any, lp_objective)."""
     lp_assignment = feasible_any = None
     lp_objective = np.inf
-    # Candidates stay in round form; only the winner pays the decode into
-    # concrete per-node pod lists.
     candidates: List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]] = []
+    labels: List[str] = []
     if fetched is not None:
         if isinstance(fetched, FusedHandle):
             rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
@@ -1246,7 +1231,7 @@ def cost_solve_finish(
             rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
                 fetched
             )
-        for rounds in (rounds_ffd, rounds_cost):
+        for label, rounds in (("kernel_ffd", rounds_ffd), ("kernel_cost", rounds_cost)):
             if not bool(rounds.overflow):
                 candidates.append(
                     (
@@ -1254,9 +1239,46 @@ def cost_solve_finish(
                         rounds.unschedulable[:num_groups],
                     )
                 )
-    candidates.extend(host_candidates or [])
+                labels.append(label)
+    for index, host_candidate in enumerate(host_candidates or []):
+        candidates.append(host_candidate)
+        labels.append("host_ffd" if index == 0 else f"host_{index}")
     if mix_plan is not None:
         candidates.append(mix_plan)
+        labels.append("mix")
+    return candidates, labels, lp_assignment, feasible_any, lp_objective
+
+
+def cost_solve_finish(
+    fetched,
+    vectors: np.ndarray,
+    counts: np.ndarray,
+    capacity: np.ndarray,
+    total: np.ndarray,
+    prices: np.ndarray,
+    pool_prices: np.ndarray,
+    mix_plan: Optional[
+        Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]
+    ] = None,
+    host_candidates: Optional[
+        List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]]
+    ] = None,
+    explain: Optional[dict] = None,
+) -> Optional[DenseSolveResult]:
+    """Host-side candidate scoring + LP realization over fetched kernel
+    outputs (the second half of cost_solve_dense). mix_plan, when given, is
+    the column-LP candidate computed in the dispatch-to-fetch overlap window
+    (compute_mix_candidate) and competes on equal scoring terms. fetched may
+    be None (the cost_solve_host path): scoring then runs over
+    host_candidates + mix_plan only and the device-LP realization is
+    skipped. An `explain` dict, when passed, is filled with every scored
+    candidate — [(label, DenseSolveResult, score_tuple)] under
+    "candidates" — so analysis tooling (tools/rank_consistency.py) can
+    compare the expected-price ranking against realized market cost."""
+    num_groups = int(vectors.shape[0])
+    candidates, labels, lp_assignment, feasible_any, lp_objective = (
+        _collect_candidates(fetched, num_groups, host_candidates, mix_plan)
+    )
 
     # Score from rounds: a node's realized price is the cheapest of its
     # offered options, which for the cost solve is the cheapest feasible
@@ -1293,8 +1315,13 @@ def cost_solve_finish(
         dominate, later rows hedge. Against the market simulator's full
         (seed × correlation × slack) grid this ranks candidate plans
         consistently with their realized cost in 22/24 cells, versus 19/24
-        for the uniform mean it replaces. Memoized per fill — the same
-        fill recurs across candidates and replicated rounds."""
+        for the uniform mean it replaces. The two inconsistent cells are
+        decay-INVARIANT (tools/rank_consistency.py sweeps 0.3→uniform):
+        their realized order flips on market pool depth, unobservable in
+        the advertised prices this model sees — bounded at 0.37% / 3.29%
+        regret vs our own best candidate (docs/solver.md). Memoized per
+        fill — the same fill recurs across candidates and replicated
+        rounds."""
         key = fill.tobytes()
         price = price_memo.get(key)
         if price is None:
@@ -1333,19 +1360,29 @@ def cost_solve_finish(
         )
         if lp_candidate is not None:
             candidates.append(lp_candidate)
+            labels.append("lp_realized")
             scores[id(lp_candidate)] = score(lp_candidate)
     if not candidates:
         return None
 
-    best_rounds, best_unschedulable = min(candidates, key=lambda c: scores[id(c)])
+    def materialize(candidate) -> DenseSolveResult:
+        rounds, unschedulable = candidate
+        options: Dict[bytes, Tuple[List[int], Optional[List[PoolRow]]]] = {}
+        for t, fill, _ in rounds:
+            options[fill.tobytes()] = options_for(t, fill)
+        return DenseSolveResult(
+            rounds=rounds, unschedulable=unschedulable, options=options
+        )
+
+    if explain is not None:
+        explain["candidates"] = [
+            (label, materialize(candidate), scores[id(candidate)])
+            for label, candidate in zip(labels, candidates)
+        ]
+    best = min(candidates, key=lambda c: scores[id(c)])
     # Materialize options for every round of the winner (scoring already
     # computed them; this is a dict lookup).
-    options: Dict[bytes, Tuple[List[int], Optional[List[PoolRow]]]] = {}
-    for t, fill, _ in best_rounds:
-        options[fill.tobytes()] = options_for(t, fill)
-    return DenseSolveResult(
-        rounds=best_rounds, unschedulable=best_unschedulable, options=options
-    )
+    return materialize(best)
 
 
 def _batch_pool_options(
@@ -1505,7 +1542,12 @@ class CostSolver(Solver):
 
         return native_mod.available()
 
-    def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
+    def solve_encoded(
+        self,
+        groups: PodGroups,
+        fleet: InstanceFleet,
+        explain: Optional[dict] = None,
+    ) -> ffd.PackResult:
         if fleet.num_types == 0 or groups.num_groups == 0:
             return ffd.pack_groups(fleet, groups)
 
@@ -1528,6 +1570,7 @@ class CostSolver(Solver):
             fleet.prices,
             pool_prices_fn,
             lp_steps=self.lp_steps,
+            explain=explain,
         )
         if dense is None:
             return ffd.pack_groups(fleet, groups)

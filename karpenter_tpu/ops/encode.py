@@ -79,8 +79,11 @@ class PodGroups:
 
 
 def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
-    buckets: Dict[bytes, List[PodSpec]] = {}
-    vectors: Dict[bytes, np.ndarray] = {}
+    # One dict holding (vector, members) per distinct request shape: this
+    # loop runs once per pod of a 50k batch, so it carries exactly one dict
+    # probe and one append per pod.
+    groups: Dict[bytes, Tuple[np.ndarray, List[PodSpec]]] = {}
+    lookup = groups.get
     for pod in pods:
         # The cache is populated at PodSpec construction
         # (api/pods._dense_request_cache — one definition of the format);
@@ -90,30 +93,28 @@ def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
             from karpenter_tpu.api.pods import _dense_request_cache
 
             pod.dense_vector = cached = _dense_request_cache(pod.requests)
-        vec, key = cached
-        members = buckets.get(key)
-        if members is None:
-            buckets[key] = [pod]
-            vectors[key] = vec
+        entry = lookup(cached[1])
+        if entry is None:
+            groups[cached[1]] = (cached[0], [pod])
         else:
-            members.append(pod)
+            entry[1].append(pod)
     cpu = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
     mem = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_MEMORY]
     # Desc by cpu, then memory, then the full vector for determinism.
-    keys = sorted(
-        buckets.keys(),
-        key=lambda k: (
-            -vectors[k][cpu],
-            -vectors[k][mem],
-            tuple(-x for x in vectors[k].tolist()),
+    entries = sorted(
+        groups.values(),
+        key=lambda entry: (
+            -entry[0][cpu],
+            -entry[0][mem],
+            tuple(-x for x in entry[0].tolist()),
         ),
     )
     return PodGroups(
-        vectors=np.stack([vectors[k] for k in keys])
-        if keys
+        vectors=np.stack([vec for vec, _ in entries])
+        if entries
         else np.zeros((0, wellknown.NUM_RESOURCE_DIMS), np.float32),
-        counts=np.array([len(buckets[k]) for k in keys], dtype=np.int32),
-        members=[buckets[k] for k in keys],
+        counts=np.array([len(members) for _, members in entries], dtype=np.int32),
+        members=[members for _, members in entries],
     )
 
 
@@ -181,6 +182,39 @@ def _passes_accelerator_filters(
     return True
 
 
+def _slow_kept(
+    instance_types: Sequence[InstanceType],
+    constraints: Constraints,
+    pods_need: np.ndarray,
+    daemon_groups: PodGroups,
+    allowed_zones,
+    allowed_capacity,
+) -> List[Tuple[InstanceType, np.ndarray, np.ndarray, float]]:
+    """Per-type walk for constrained envelopes / daemon overhead — the
+    general path (_fast_kept handles the unconstrained hot shape)."""
+    kept: List[Tuple[InstanceType, np.ndarray, np.ndarray, float]] = []
+    for instance_type in instance_types:
+        if not _passes_constraint_filters(instance_type, constraints):
+            continue
+        total = resource_vector(instance_type.capacity)
+        if not _passes_accelerator_filters(total, pods_need):
+            continue
+        usable = total - resource_vector(instance_type.overhead)
+        if (usable < 0).any():
+            continue  # overhead exceeds capacity (ref: packable.go:64-68)
+        usable = _greedy_fill(usable, daemon_groups)
+        if usable is None:
+            continue  # daemons don't fit (ref: packable.go:69-73)
+        price = instance_type.min_price(
+            zones=[z for z in instance_type.zones() if allowed_zones.contains(z)],
+            capacity_types=[
+                c for c in instance_type.capacity_types() if allowed_capacity.contains(c)
+            ],
+        )
+        kept.append((instance_type, usable, total, price))
+    return kept
+
+
 def _greedy_fill(remaining: np.ndarray, groups: PodGroups) -> Optional[np.ndarray]:
     """Pack daemons-style: every pod of every group must fit, else None."""
     remaining = remaining.copy()
@@ -190,6 +224,46 @@ def _greedy_fill(remaining: np.ndarray, groups: PodGroups) -> Optional[np.ndarra
         if (remaining < 0).any():
             return None
     return remaining
+
+
+_ENVELOPE_KEYS = (
+    wellknown.INSTANCE_TYPE_LABEL,
+    wellknown.ARCH_LABEL,
+    wellknown.OS_LABEL,
+    wellknown.ZONE_LABEL,
+    wellknown.CAPACITY_TYPE_LABEL,
+)
+
+
+def _fast_kept(
+    instance_types: Sequence[InstanceType], pods_need: np.ndarray
+) -> List[Tuple[InstanceType, np.ndarray, np.ndarray, float]]:
+    """Vectorized filter for the hot shape — unconstrained envelope, no
+    daemons: the accelerator anti-waste and overhead checks collapse to
+    [T, R] array masks, and every type's price is its unrestricted
+    cheapest offering. Bit-identical kept set to the per-type walk."""
+    if not instance_types:
+        return []
+    total = np.stack([resource_vector(it.capacity) for it in instance_types])
+    usable = total - np.stack(
+        [resource_vector(it.overhead) for it in instance_types]
+    )
+    mask = (usable >= 0).all(axis=1)
+    # Offering-less types are unlaunchable (no zone/capacity-type to match);
+    # the per-type walk drops them because any() over an empty offered set
+    # is False even under an unconstrained envelope.
+    mask &= np.array([bool(it.offerings) for it in instance_types])
+    for index in _ACCEL_INDEXES:
+        if pods_need[index] > 0:
+            mask &= total[:, index] > 0
+        else:
+            mask &= total[:, index] == 0
+    if pods_need[_POD_ENI_INDEX] > 0:
+        mask &= total[:, _POD_ENI_INDEX] > 0
+    return [
+        (instance_types[i], usable[i], total[i], instance_types[i].min_price())
+        for i in np.nonzero(mask)[0]
+    ]
 
 
 def build_fleet(
@@ -215,31 +289,20 @@ def build_fleet(
         )
     daemon_groups = group_pods(list(daemons))
 
-    allowed_zones = constraints.effective_requirements().allowed(wellknown.ZONE_LABEL)
-    allowed_capacity = constraints.effective_requirements().allowed(
-        wellknown.CAPACITY_TYPE_LABEL
-    )
+    requirements = constraints.effective_requirements()
+    allowed_zones = requirements.allowed(wellknown.ZONE_LABEL)
+    allowed_capacity = requirements.allowed(wellknown.CAPACITY_TYPE_LABEL)
 
-    kept: List[Tuple[InstanceType, np.ndarray, np.ndarray, float]] = []
-    for instance_type in instance_types:
-        if not _passes_constraint_filters(instance_type, constraints):
-            continue
-        total = resource_vector(instance_type.capacity)
-        if not _passes_accelerator_filters(total, pods_need):
-            continue
-        usable = total - resource_vector(instance_type.overhead)
-        if (usable < 0).any():
-            continue  # overhead exceeds capacity (ref: packable.go:64-68)
-        usable = _greedy_fill(usable, daemon_groups)
-        if usable is None:
-            continue  # daemons don't fit (ref: packable.go:69-73)
-        price = instance_type.min_price(
-            zones=[z for z in instance_type.zones() if allowed_zones.contains(z)],
-            capacity_types=[
-                c for c in instance_type.capacity_types() if allowed_capacity.contains(c)
-            ],
+    unconstrained = daemon_groups.num_groups == 0 and all(
+        requirements.allowed(key).is_any() for key in _ENVELOPE_KEYS
+    )
+    if unconstrained:
+        kept = _fast_kept(instance_types, pods_need)
+    else:
+        kept = _slow_kept(
+            instance_types, constraints, pods_need, daemon_groups,
+            allowed_zones, allowed_capacity,
         )
-        kept.append((instance_type, usable, total, price))
 
     cpu = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
     mem = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_MEMORY]

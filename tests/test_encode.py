@@ -1,0 +1,171 @@
+"""Encode fast path (ops/encode.py): the unconstrained build_fleet walk is
+vectorized (_fast_kept); it must stay bit-identical to the general
+per-type path (_slow_kept) that handles constrained envelopes and daemon
+overhead. Ref: packable.go:45-93 — same filters, two implementations."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Constraints
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.cloudprovider import InstanceType, Offering
+from karpenter_tpu.ops import encode
+
+
+def catalog(num_types=12, with_gpu=False):
+    types = []
+    for i in range(num_types):
+        size = 1 + i
+        capacity = {"cpu": 2 * size, "memory": f"{8 * size}Gi", "pods": 110}
+        if with_gpu and i % 3 == 0:
+            capacity["nvidia.com/gpu"] = 4
+        types.append(
+            InstanceType(
+                name=f"t{i}.x",
+                capacity=capacity,
+                overhead={"cpu": "100m", "memory": "255Mi"},
+                offerings=[
+                    Offering(zone="us-a", capacity_type="on-demand",
+                             price=0.1 * size),
+                    Offering(zone="us-b", capacity_type="spot",
+                             price=0.03 * size),
+                ],
+            )
+        )
+    return types
+
+
+def pods(n=6, **requests):
+    requests = requests or {"cpu": "500m", "memory": "512Mi"}
+    return [
+        PodSpec(name=f"p{i}", unschedulable=True, requests=requests)
+        for i in range(n)
+    ]
+
+
+def _slow(types, constraints, need, daemons=()):
+    requirements = constraints.effective_requirements()
+    return encode._slow_kept(
+        types, constraints, need, encode.group_pods(list(daemons)),
+        requirements.allowed(wellknown.ZONE_LABEL),
+        requirements.allowed(wellknown.CAPACITY_TYPE_LABEL),
+    )
+
+
+def _assert_kept_equal(fast, slow):
+    assert len(fast) == len(slow)
+    for (it_f, usable_f, total_f, price_f), (it_s, usable_s, total_s, price_s) in zip(
+        fast, slow
+    ):
+        assert it_f is it_s
+        assert np.array_equal(usable_f, usable_s)
+        assert np.array_equal(total_f, total_s)
+        assert price_f == price_s
+
+
+class TestFastKeptParity:
+    def test_plain_workload(self):
+        types = catalog()
+        batch = pods()
+        groups = encode.group_pods(batch)
+        need = groups.vectors.max(axis=0)
+        _assert_kept_equal(
+            encode._fast_kept(types, need), _slow(types, Constraints(), need)
+        )
+
+    def test_offeringless_type_dropped_like_the_slow_path(self):
+        """A type with no offerings is unlaunchable; both paths must drop
+        it (the slow path rejects it because its offered zone set is
+        empty)."""
+        types = catalog() + [
+            InstanceType(
+                name="ghost.x",
+                capacity={"cpu": 8, "memory": "32Gi", "pods": 110},
+                overhead={"cpu": "100m", "memory": "255Mi"},
+                offerings=[],
+            )
+        ]
+        need = encode.group_pods(pods()).vectors.max(axis=0)
+        fast = encode._fast_kept(types, need)
+        _assert_kept_equal(fast, _slow(types, Constraints(), need))
+        assert all(it.name != "ghost.x" for it, *_ in fast)
+
+    def test_accelerator_anti_waste(self):
+        """GPU demand keeps only GPU types; no GPU demand drops them —
+        both directions, same as the per-type walk."""
+        types = catalog(with_gpu=True)
+        for requests in (
+            {"cpu": "500m", "nvidia.com/gpu": 1},
+            {"cpu": "500m", "memory": "512Mi"},
+        ):
+            groups = encode.group_pods(pods(**requests))
+            need = groups.vectors.max(axis=0)
+            fast = encode._fast_kept(types, need)
+            _assert_kept_equal(fast, _slow(types, Constraints(), need))
+        gpu_need = encode.group_pods(
+            pods(**{"cpu": "500m", "nvidia.com/gpu": 1})
+        ).vectors.max(axis=0)
+        kept_names = {it.name for it, *_ in encode._fast_kept(types, gpu_need)}
+        assert kept_names and all(
+            "nvidia.com/gpu" in t.capacity for t in types if t.name in kept_names
+        )
+
+    def test_pod_eni_one_directional(self):
+        types = catalog()
+        need = encode.group_pods(
+            pods(**{"cpu": "100m", wellknown.RESOURCE_AWS_POD_ENI: 1})
+        ).vectors.max(axis=0)
+        fast = encode._fast_kept(types, need)
+        _assert_kept_equal(fast, _slow(types, Constraints(), need))
+        assert fast == []  # no type offers pod-ENI capacity
+
+
+class TestBuildFleetRouting:
+    def test_unconstrained_uses_fast_path(self, monkeypatch):
+        called = []
+        real = encode._fast_kept
+        monkeypatch.setattr(
+            encode, "_fast_kept", lambda *a: called.append(1) or real(*a)
+        )
+        fleet = encode.build_fleet(catalog(), Constraints(), pods())
+        assert called and fleet.num_types == len(catalog())
+
+    def test_zone_constraint_routes_to_general_path_and_filters_prices(
+        self, monkeypatch
+    ):
+        monkeypatch.setattr(
+            encode, "_fast_kept",
+            lambda *a: pytest.fail("fast path used for constrained envelope"),
+        )
+        constraints = Constraints(
+            requirements=Requirements(
+                [Requirement.in_(wellknown.ZONE_LABEL, ["us-a"])]
+            )
+        )
+        fleet = encode.build_fleet(catalog(), constraints, pods())
+        # Only on-demand us-a offerings remain priceable.
+        assert fleet.allowed_zones == ["us-a"]
+        assert np.allclose(
+            fleet.prices,
+            [0.1 * (1 + i) for i in range(len(catalog()))],
+        )
+
+    def test_daemons_route_to_general_path_and_reserve(self, monkeypatch):
+        plain = encode.build_fleet(catalog(), Constraints(), pods())
+        monkeypatch.setattr(
+            encode, "_fast_kept",
+            lambda *a: pytest.fail("fast path used with daemons"),
+        )
+        daemon = PodSpec(name="ds", requests={"cpu": "1", "memory": "1Gi"})
+        fleet = encode.build_fleet(
+            catalog(), Constraints(), pods(), daemons=[daemon]
+        )
+        cpu = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
+        shared = min(fleet.num_types, plain.num_types)
+        assert shared > 0
+        # Daemon reservation shrinks usable capacity by the daemon's vector.
+        assert (
+            plain.capacity[-1][cpu] - fleet.capacity[-1][cpu] == 1000.0
+        )
