@@ -72,6 +72,18 @@ class ReconcileLoop:
         # pipeline (bench_pod_storm, sampled).
         self.chunk = max(1, chunk)
         self.log = klog.named(name)
+        # Wake coalescing for chunked pools (guarded by _cv): _waiting
+        # counts workers inside cv.wait(). A notify is needed ONLY when
+        # every worker is waiting — any non-waiting worker re-checks the
+        # heap under the cv before it can sleep, so it picks up new keys
+        # without a wake (the counter window is race-free: enqueue holds
+        # the cv, and a worker not counted as waiting is by definition on
+        # its way to that re-check). Waking a thread per enqueue at high
+        # concurrency is pure context-switch churn (sampled as the top
+        # residual cost of the 128-thread pod storm). chunk=1 loops keep
+        # per-key notifies: their reconciles block on RPCs, where per-key
+        # parallelism is the point.
+        self._waiting = 0
         self._heap: list = []  # (due_time, seq, key)
         self._queued: set = set()
         self._due: dict = {}  # key -> earliest pending due time
@@ -95,20 +107,54 @@ class ReconcileLoop:
             if due is not None and due <= _time.monotonic():
                 return
         with self._cv:
-            due = _time.monotonic() + delay
-            if key in self._queued and due >= self._due.get(key, float("inf")):
-                # An entry already due at-or-before this one covers it. An
-                # EARLIER enqueue (e.g. a watch event while the key sits in a
-                # long backoff) must pull the work forward, like workqueue.Add
-                # during rate-limited backoff — the old entry is lazily
-                # dropped when it pops.
-                return
-            self._queued.add(key)
-            self._due[key] = due
-            self._seq += 1
-            heapq.heappush(self._heap, (due, self._seq, key))
-            WORKQUEUE_DEPTH.set(len(self._queued), self.name)
+            if self._enqueue_locked(key, delay, _time.monotonic()):
+                WORKQUEUE_DEPTH.set(len(self._queued), self.name)
+                self._notify_locked(1)
+
+    def enqueue_many(self, pairs) -> None:
+        """Enqueue a batch of (key, delay) under ONE lock round — the
+        chunked reconcile loop requeues every key of a chunk at once, and
+        per-key locking here was the top contention point of a 128-thread
+        pod storm (sampled)."""
+        import time as _time
+
+        if not pairs:
+            return
+        with self._cv:
+            now = _time.monotonic()
+            added = 0
+            for key, delay in pairs:
+                added += 1 if self._enqueue_locked(key, delay, now) else 0
+            if added:
+                WORKQUEUE_DEPTH.set(len(self._queued), self.name)
+                self._notify_locked(added)
+
+    def _notify_locked(self, added: int) -> None:
+        """Wake waiters for `added` new entries (caller holds _cv). Chunked
+        pools notify only when the whole pool is asleep: any awake worker
+        re-checks the heap before sleeping and drains every due key up to
+        its chunk, so it collects these entries without a wake."""
+        if self.chunk == 1:
+            self._cv.notify(min(added, self.concurrency))
+        elif self._waiting >= len(self._threads):
+            # Empty _threads (pre-start enqueue) compares 0 >= 0: notify is
+            # a harmless no-op and the seeding path stays unsurprising.
             self._cv.notify()
+
+    def _enqueue_locked(self, key, delay: float, now: float) -> bool:
+        """Insert under the held cv. An entry already due at-or-before this
+        one covers it; an EARLIER enqueue (e.g. a watch event while the key
+        sits in a long backoff) pulls the work forward, like workqueue.Add
+        during rate-limited backoff — the old entry is lazily dropped when
+        it pops."""
+        due = now + delay
+        if key in self._queued and due >= self._due.get(key, float("inf")):
+            return False
+        self._queued.add(key)
+        self._due[key] = due
+        self._seq += 1
+        heapq.heappush(self._heap, (due, self._seq, key))
+        return True
 
     def start(self) -> None:
         for i in range(self.concurrency):
@@ -134,7 +180,11 @@ class ReconcileLoop:
                     timeout = (
                         self._heap[0][0] - _time.monotonic() if self._heap else None
                     )
-                    self._cv.wait(timeout=timeout)
+                    self._waiting += 1
+                    try:
+                        self._cv.wait(timeout=timeout)
+                    finally:
+                        self._waiting -= 1
                 if self._stop:
                     return
                 keys = self._pop_due_locked()
@@ -184,8 +234,7 @@ class ReconcileLoop:
         for outcome, count in outcomes.items():
             if count:
                 RECONCILE_TOTAL.inc(self.name, outcome, amount=count)
-        for key, delay in requeues:
-            self.enqueue(key, delay=delay)
+        self.enqueue_many(requeues)
 
 
 class LeaderElector:

@@ -701,3 +701,48 @@ class TestBootWarmup:
         S.set_warming_host_preference(True)
         assert S._WARMING_HOST_PREFERENCE.is_set()
         S.set_warming_host_preference(False)
+
+
+class TestWakeCoalescing:
+    def test_enqueue_while_all_workers_busy_does_not_lose_the_wake(self):
+        """Lost-wakeup regression (chunked pools coalesce notifies): a
+        notify that fires while every worker is busy reaches no one; after
+        the pool drains and sleeps, later enqueues must still wake a
+        worker — the pending-wake counter is reset whenever work is taken
+        without waiting."""
+        import threading
+
+        from karpenter_tpu.runtime import ReconcileLoop
+
+        gate = threading.Event()
+        seen = []
+
+        def reconcile(key):
+            seen.append(key)
+            if key == "slow":
+                gate.wait(timeout=10.0)
+            return None
+
+        loop = ReconcileLoop("coalesce", reconcile, concurrency=2, chunk=64)
+        loop.start()
+        try:
+            # Occupy both workers.
+            loop.enqueue("slow")
+            loop.enqueue(("busy", 1), delay=0.0)
+            assert wait_until(lambda: len(seen) >= 1, timeout=5.0)
+            # These notifies fire while workers are busy (reach no one).
+            for i in range(5):
+                loop.enqueue(("storm", i))
+            gate.set()
+            assert wait_until(
+                lambda: sum(1 for k in seen if k[0] == "storm") == 5,
+                timeout=5.0,
+            ), f"storm keys never reconciled: {seen}"
+            # Pool is idle now; a fresh enqueue must still wake a worker.
+            loop.enqueue(("after-idle", 0))
+            assert wait_until(
+                lambda: ("after-idle", 0) in seen, timeout=5.0
+            ), "enqueue after idle was lost — wake counter leaked"
+        finally:
+            gate.set()
+            loop.stop()
