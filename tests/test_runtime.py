@@ -746,3 +746,29 @@ class TestWakeCoalescing:
         finally:
             gate.set()
             loop.stop()
+
+    def test_enqueue_many_pulls_delayed_keys_forward(self):
+        """Batch enqueue preserves the single-enqueue contract: an earlier
+        due time overrides a pending later one (workqueue.Add during
+        rate-limited backoff), and a later one is covered by the pending
+        entry."""
+        import threading
+
+        from karpenter_tpu.runtime import ReconcileLoop
+
+        seen = []
+        loop = ReconcileLoop("many", lambda k: seen.append(k) and None,
+                             concurrency=1, chunk=8)
+        loop.start()
+        try:
+            loop.enqueue("parked", delay=60.0)
+            loop.enqueue_many([("parked", 0.0), ("fresh", 0.0)])
+            assert wait_until(lambda: "parked" in seen and "fresh" in seen,
+                              timeout=5.0), seen
+            # A later-due batch entry for an already-pending key is a no-op.
+            loop.enqueue("slow", delay=60.0)
+            loop.enqueue_many([("slow", 120.0)])
+            with loop._cv:
+                assert loop._due["slow"] < __import__("time").monotonic() + 61
+        finally:
+            loop.stop()
