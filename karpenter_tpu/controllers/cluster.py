@@ -66,8 +66,13 @@ class Cluster:
                 raise NotFoundError(f"pod {namespace}/{name}")
 
     def try_get_pod(self, namespace: str, name: str) -> Optional[PodSpec]:
-        with self._lock:
-            return self._pods.get((namespace, name))
+        # Lock-free: a single dict read is atomic under the GIL, and
+        # mutators replace whole entries (never partially mutate the
+        # mapping), so the read sees either the previous or the current
+        # object — the same guarantee the lock gave a point read. This is
+        # THE hottest read in a pod storm (one per selection reconcile),
+        # and 128 selection workers convoyed on the cluster lock here.
+        return self._pods.get((namespace, name))
 
     def list_pods(
         self,

@@ -153,15 +153,23 @@ class ProvisionerWorker:
 
     # --- batching (ref: provisioner.go:137-163) -----------------------------
 
+    # Set by the runtime's batch loop: workers pulse it the moment a window
+    # FILLS, so a full batch provisions immediately instead of waiting out
+    # the loop's poll interval (idle-closed windows still ride the poll —
+    # their closing edge is a clock passing, not an event).
+    batch_full: Optional[threading.Event] = None
+
     def add(self, pod: PodSpec) -> None:
         """Accept a pod unconditionally: into the open batch window, or the
         overflow backlog once the window is full."""
+        filled = False
         with self._lock:
             if pod.uid not in self._pending_uids:
                 if len(self._pending) >= MAX_PODS_PER_BATCH:
                     self._overflow.append(pod)
                 else:
                     self._pending.append(pod)
+                    filled = len(self._pending) >= MAX_PODS_PER_BATCH
                 self._pending_uids.add(pod.uid)
                 # Window clock moves only on GENUINE adds: duplicate
                 # re-verify adds would otherwise keep refreshing _last_add
@@ -171,6 +179,8 @@ class ProvisionerWorker:
                 if self._first_add is None:
                     self._first_add = now
                 self._last_add = now
+        if filled and self.batch_full is not None:
+            self.batch_full.set()
 
     def take_backlog(self) -> List[PodSpec]:
         """Drain EVERYTHING (batch + overflow) for hand-off to a replacement
@@ -404,6 +414,9 @@ class ProvisioningController:
         self.solver = solver
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, int] = {}
+        # Runtime wiring (runtime.Manager): propagated to every worker so a
+        # filling batch window wakes the batch loop immediately.
+        self.batch_full: Optional[threading.Event] = None
 
     def reconcile(self, name: str) -> None:
         provisioner = self.cluster.try_get_provisioner(name)
@@ -437,6 +450,7 @@ class ProvisioningController:
             replacement = ProvisionerWorker(
                 effective, self.cluster, self.cloud, self.solver
             )
+            replacement.batch_full = self.batch_full
             # Hand the old worker's accepted backlog (batch + overflow) to
             # the replacement: mid-storm spec-hash flips (ICE blackouts
             # changing effective offerings) must not dump tens of thousands

@@ -84,6 +84,7 @@ class ReconcileLoop:
         # per-key notifies: their reconciles block on RPCs, where per-key
         # parallelism is the point.
         self._waiting = 0
+        self._pops = 0  # chunk pops ever (start()'s grabbed-work escape)
         self._heap: list = []  # (due_time, seq, key)
         self._queued: set = set()
         self._due: dict = {}  # key -> earliest pending due time
@@ -157,12 +158,27 @@ class ReconcileLoop:
         return True
 
     def start(self) -> None:
+        import time as _time
+
         for i in range(self.concurrency):
             thread = threading.Thread(
                 target=self._run, name=f"{self.name}-{i}", daemon=True
             )
             thread.start()
             self._threads.append(thread)
+        # Wait for the pool to park (every worker in cv.wait) before
+        # declaring the loop started: a high-concurrency pool's boot
+        # stampede — N fresh threads racing through the cv for the first
+        # time — otherwise lands on top of the first real traffic. Bounded
+        # wait; a pool that grabbed real work immediately is also "ready"
+        # (_pops counts chunk pops, so consumed-and-emptied work still
+        # satisfies the escape instead of spinning out the full deadline).
+        deadline = _time.monotonic() + 1.0
+        while _time.monotonic() < deadline:
+            with self._cv:
+                if self._waiting >= self.concurrency or self._pops:
+                    break
+            _time.sleep(0.001)
 
     def stop(self) -> None:
         with self._cv:
@@ -206,6 +222,8 @@ class ReconcileLoop:
             self._queued.discard(key)
             self._due.pop(key, None)
             keys.append(key)
+        if keys:
+            self._pops += 1
         WORKQUEUE_DEPTH.set(len(self._queued), self.name)
         return keys
 
@@ -410,6 +428,11 @@ class Manager:
         self._warming_can_serve = bool(
             getattr(self.solver, "host_fallback_available", lambda: False)()
         )
+        # Pulsed by workers when a batch window FILLS (ProvisionerWorker
+        # .batch_full); the batch loop waits on it so full windows
+        # provision immediately.
+        self._batch_full = threading.Event()
+        self.provisioning.batch_full = self._batch_full
         self._stop = threading.Event()
 
         # Reconcile loops. The reference runs selection at
@@ -418,8 +441,9 @@ class Manager:
         # informer cache (CPU-bound under the GIL) and the loop is keyed +
         # collapse-deduped, with the batch overflow held by the worker —
         # so the envelope is picked from pod-storm data (bench.py
-        # bench_pod_storm: 10k-pod drain is flat-to-worse from 8 to 128
-        # threads; 8 keeps up; see Options.selection_concurrency).
+        # bench_pod_storm: 10k-pod drain ~1.8s at 8 threads and within
+        # ~20% of that at 128 — extra threads buy nothing under the GIL,
+        # they only pay wake/cache tax; see Options.selection_concurrency).
         self.loops = {
             "selection": ReconcileLoop(
                 "selection",
@@ -479,7 +503,15 @@ class Manager:
     # --- batch loop ---------------------------------------------------------
 
     def _batch_loop(self) -> None:
-        while not self._stop.wait(timeout=BATCH_IDLE_SECONDS / 5):
+        while not self._stop.is_set():
+            # Wake on the next poll tick OR the instant a window fills —
+            # a storm's full batches provision without paying up to a poll
+            # interval of latency each (idle-closed windows still close on
+            # the tick, since their edge is a clock passing, not an event).
+            self._batch_full.wait(timeout=BATCH_IDLE_SECONDS / 5)
+            self._batch_full.clear()
+            if self._stop.is_set():
+                return
             if not self.warm.is_set() and not self._warming_can_serve:
                 # No host fallback: batches accumulate until the ladder is
                 # compiled, so no live batch ever pays the jit stall. With a
@@ -547,6 +579,7 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        self._batch_full.set()  # unblock the batch loop promptly
         for loop in self.loops.values():
             loop.stop()
         self.termination.evictions.stop()

@@ -752,8 +752,6 @@ class TestWakeCoalescing:
         due time overrides a pending later one (workqueue.Add during
         rate-limited backoff), and a later one is covered by the pending
         entry."""
-        import threading
-
         from karpenter_tpu.runtime import ReconcileLoop
 
         seen = []
@@ -769,6 +767,6 @@ class TestWakeCoalescing:
             loop.enqueue("slow", delay=60.0)
             loop.enqueue_many([("slow", 120.0)])
             with loop._cv:
-                assert loop._due["slow"] < __import__("time").monotonic() + 61
+                assert loop._due["slow"] < time.monotonic() + 61
         finally:
             loop.stop()
