@@ -167,8 +167,8 @@ class Cluster:
                 raise NotFoundError(f"node {name}")
 
     def try_get_node(self, name: str) -> Optional[NodeSpec]:
-        with self._lock:
-            return self._nodes.get(name)
+        # Lock-free point read — same GIL-atomicity argument as try_get_pod.
+        return self._nodes.get(name)
 
     def list_nodes(
         self, predicate: Optional[Callable[[NodeSpec], bool]] = None
@@ -212,12 +212,19 @@ class Cluster:
         return provisioner
 
     def try_get_provisioner(self, name: str) -> Optional[Provisioner]:
-        with self._lock:
-            return self._provisioners.get(name)
+        # Lock-free point read — same GIL-atomicity argument as try_get_pod.
+        return self._provisioners.get(name)
 
     def list_provisioners(self) -> List[Provisioner]:
+        # Copy under the lock, sort OUTSIDE it (the list_pods/list_nodes
+        # pattern): the convoy on this path came from the O(n log n) sort
+        # with its Python key lambda running under the shared lock, while
+        # selection routes every reconcile through here. The copy itself is
+        # not safely lock-free — list() allocation can trigger a GC pass
+        # whose callbacks yield the GIL mid-materialization.
         with self._lock:
-            return sorted(self._provisioners.values(), key=lambda p: p.name)
+            provisioners = list(self._provisioners.values())
+        return sorted(provisioners, key=lambda p: p.name)
 
     def update_provisioner_status(self, provisioner: Provisioner) -> None:
         """Persist a status mutation (resources/conditions/lastScaleTime).
