@@ -302,7 +302,35 @@ def _config_lp_bound(groups, fleet, greedy_cost):
         return {}
 
 
+def _backend_platform() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — reporting must not kill the print
+        return "unknown"
+
+
 def main():
+    # Device liveness gate BEFORE any jax-importing karpenter module loads:
+    # if the accelerator (or its tunnel) is wedged, fall back to jax-CPU +
+    # forced host solves so the run still completes and prints — flagged
+    # with device_unavailable so nobody mistakes the degraded numbers for
+    # accelerator numbers.
+    import os
+
+    from karpenter_tpu.utils.jaxenv import device_alive
+
+    device_unavailable = not device_alive()
+    if device_unavailable:
+        os.environ["KARPENTER_HOST_SOLVE"] = "1"
+        # The axon sitecustomize overrides env vars; force the CPU backend
+        # in-process (shared helper — import jax alone does not touch the
+        # wedged device; backends initialize lazily).
+        from karpenter_tpu.utils.jaxenv import force_cpu_backend
+
+        force_cpu_backend()
+
     from karpenter_tpu.api.provisioner import Constraints
     from karpenter_tpu.models.solver import CostSolver, GreedySolver
     from karpenter_tpu.ops.encode import build_fleet, group_pods
@@ -672,6 +700,15 @@ def main():
                 "cost_ratio_sweep_worst_mean": round(sweep_worst_mean, 4),
                 "pods": len(pods),
                 "types": len(catalog),
+                # True = the accelerator probe failed and this whole run
+                # executed on jax-CPU with forced host solves: pipeline and
+                # cost numbers remain meaningful, latency numbers are NOT
+                # accelerator numbers. backend records the platform the
+                # solves ACTUALLY ran on (a run launched with
+                # JAX_PLATFORMS=cpu passes the probe yet is still a CPU
+                # run — trust backend, not the flag alone).
+                "device_unavailable": device_unavailable,
+                "backend": _backend_platform(),
             }
         )
     )

@@ -10,19 +10,9 @@ selecting cpu via env alone then hangs in backend init. So: update the already
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
+from karpenter_tpu.utils.jaxenv import force_cpu_backend
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    import jax._src.xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover — jax internals moved; env var still set
-    pass
+force_cpu_backend(host_devices=8)
 
 
 def pytest_collection_modifyitems(config, items):
