@@ -14,7 +14,12 @@ from __future__ import annotations
 import os
 
 
-def device_alive(timeout_s: float = 180.0) -> bool:
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; jax.device_get(jnp.ones((8,)) + 1)"
+)
+
+
+def device_alive(timeout_s: float = 180.0, _probe_code: str = _PROBE_CODE) -> bool:
     """Probe the default accelerator in a SUBPROCESS with a hard timeout: a
     wedged tunnel hangs jax inside C (uninterruptible from Python), so the
     probe must be killable from outside. The child does exactly what a
@@ -26,12 +31,7 @@ def device_alive(timeout_s: float = 180.0) -> bool:
 
     try:
         probe = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax, jax.numpy as jnp; "
-                "jax.device_get(jnp.ones((8,)) + 1)",
-            ],
+            [sys.executable, "-c", _probe_code],
             timeout=timeout_s,
             capture_output=True,
         )

@@ -1,0 +1,42 @@
+"""utils/jaxenv: the device-liveness probe and CPU-backend pin that keep
+the driver entry points (bench, __graft_entry__) from hanging forever on a
+wedged accelerator tunnel."""
+
+import sys
+
+from karpenter_tpu.utils.jaxenv import device_alive, force_cpu_backend
+
+
+class TestDeviceAlive:
+    def test_healthy_probe(self):
+        assert device_alive(timeout_s=30.0, _probe_code="pass") is True
+
+    def test_hung_probe_is_killed_at_the_timeout(self):
+        """The wedged-tunnel case: the child never returns on its own; the
+        probe must declare dead at the deadline instead of hanging with it."""
+        assert (
+            device_alive(
+                timeout_s=1.0, _probe_code="import time; time.sleep(600)"
+            )
+            is False
+        )
+
+    def test_failing_probe_forwards_stderr(self, capfd):
+        assert (
+            device_alive(
+                timeout_s=30.0,
+                _probe_code="import sys; sys.stderr.write('no libtpu here'); "
+                "raise SystemExit(3)",
+            )
+            is False
+        )
+        assert "no libtpu here" in capfd.readouterr().err
+
+
+class TestForceCpuBackend:
+    def test_pins_cpu(self):
+        # conftest already pinned cpu for the suite; the helper must be
+        # idempotent and return a jax running on the cpu platform.
+        jax = force_cpu_backend()
+        assert jax.devices()[0].platform == "cpu"
+        assert sys.modules["jax"] is jax
