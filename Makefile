@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test battletest proto native bench clean
+.PHONY: test battletest degraded-smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -21,6 +21,14 @@ battletest:
 	KARPENTER_RANDOM_ORDER=auto python -m pytest tests/ -q --tb=long || rc=1; \
 	KARPENTER_BATTLETEST=1 python -m pytest tests/test_battletest.py tests/test_spmd.py -q --tb=long -s || rc=1; \
 	exit $$rc
+
+# Both driver entry points under a simulated wedged accelerator (the probe
+# child hangs forever, injected via KARPENTER_PROBE_CODE): entry()'s compile
+# check and dryrun_multichip must complete degraded. The hard 60s timeout is
+# the guardrail — if either entry point re-grows a path that waits on the
+# dead device, this target fails fast instead of wedging a driver run.
+degraded-smoke:
+	timeout -k 10 60 python tools/degraded_smoke.py
 
 proto:
 	protoc -I protos --python_out=karpenter_tpu/solver_service protos/solver.proto

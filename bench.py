@@ -312,24 +312,18 @@ def _backend_platform() -> str:
 
 
 def main():
-    # Device liveness gate BEFORE any jax-importing karpenter module loads:
-    # if the accelerator (or its tunnel) is wedged, fall back to jax-CPU +
-    # forced host solves so the run still completes and prints — flagged
-    # with device_unavailable so nobody mistakes the degraded numbers for
+    # Device liveness verdict BEFORE any jax-importing karpenter module
+    # loads (backend_health is jax-free at import): a DEGRADED verdict pins
+    # the jax-CPU backend and the solve dispatch deliberately routes to the
+    # native host hybrid (models/solver.host_solve_enabled consults the
+    # same verdict) so the run still completes and prints — flagged with
+    # device_unavailable so nobody mistakes the degraded numbers for
     # accelerator numbers.
-    import os
+    from karpenter_tpu.utils import backend_health
 
-    from karpenter_tpu.utils.jaxenv import device_alive
-
-    device_unavailable = not device_alive()
-    if device_unavailable:
-        os.environ["KARPENTER_HOST_SOLVE"] = "1"
-        # The axon sitecustomize overrides env vars; force the CPU backend
-        # in-process (shared helper — import jax alone does not touch the
-        # wedged device; backends initialize lazily).
-        from karpenter_tpu.utils.jaxenv import force_cpu_backend
-
-        force_cpu_backend()
+    device_unavailable = (
+        backend_health.ensure_backend().state == backend_health.DEGRADED
+    )
 
     from karpenter_tpu.api.provisioner import Constraints
     from karpenter_tpu.models.solver import CostSolver, GreedySolver
@@ -608,7 +602,7 @@ def main():
         s_o_cost = simulate_plan_cost(
             s_ours, constraints, s_market, ZONES, depth_slack=default_slack
         )
-        stretch[label] = {
+        stretch_cell = {
             "pods": n_pods,
             "types": n_types,
             "solve_p50_ms": round(s_p50, 2),
@@ -622,6 +616,18 @@ def main():
             else 1.0,
             **_config_lp_bound(s_groups, s_fleet, s_ideal),
         }
+        if device_unavailable:
+            # Degraded-mode accounting: on a dead accelerator the hybrid
+            # either beats the compiled baseline outright, or the extra
+            # latency is an EXPLICIT trade for the cost win — never a
+            # silent loss to our own baseline (r05 weak #5). True only when
+            # the cost win actually exists; a cell slower AND not cheaper
+            # stays False, visible as an unjustified loss.
+            stretch_cell["latency_for_cost"] = (
+                stretch_cell["vs_baseline"] < 1.0
+                and stretch_cell["cost_ratio"] < 1.0
+            )
+        stretch[label] = stretch_cell
 
     # Watch->selection->batch->solve->bind pipeline under a 10k-pod storm,
     # per selection-concurrency setting (justifies Options.selection_concurrency).
@@ -709,6 +715,22 @@ def main():
                 # run — trust backend, not the flag alone).
                 "device_unavailable": device_unavailable,
                 "backend": _backend_platform(),
+            }
+        )
+    )
+    # Compact summary as the LAST line of output: a log collector that keeps
+    # only the tail (the driver keeps 4 KB) always retains the headline keys
+    # — the full JSON above grew past the tail window in r04 and r05 and cut
+    # off p50_ms.
+    print(
+        json.dumps(
+            {
+                "p50_ms": round(p50, 3),
+                "p99_ms": round(p99, 3),
+                "end_to_end_ms": round(end_to_end_ms, 3),
+                "cost_ratio": round(cost_ratio, 4),
+                "backend": _backend_platform(),
+                "device_unavailable": device_unavailable,
             }
         )
     )

@@ -671,7 +671,12 @@ def fetch_bytes(tree) -> int:
 
 
 def _kernel_rounds_to_list(host_rounds: "PackRounds", num_groups: int):
-    num_rounds = int(host_rounds.num_rounds)
+    # Defense against round-budget overflow (the kernel clamps the count,
+    # but pre-packing tuple callers may hand over raw state): never read
+    # past the static round buffer.
+    num_rounds = min(
+        int(host_rounds.num_rounds), int(host_rounds.round_type.shape[0])
+    )
     return [
         (
             int(host_rounds.round_type[r]),
@@ -1138,6 +1143,7 @@ def host_solve_enabled(num_pods: int, batched: bool = False) -> bool:
     import os
 
     from karpenter_tpu.ops import native as native_mod
+    from karpenter_tpu.utils import backend_health
 
     flag = os.environ.get("KARPENTER_HOST_SOLVE", "").lower()
     if flag in ("0", "false", "off"):
@@ -1145,6 +1151,15 @@ def host_solve_enabled(num_pods: int, batched: bool = False) -> bool:
     if not native_mod.available():
         return False
     if flag in ("1", "true", "on"):
+        return True
+    if backend_health.degraded() and num_pods <= HOST_WARMING_MAX_PODS:
+        # DEGRADED backend verdict: the "device" is the jax-CPU fallback,
+        # which loses to the compiled packer at every measured size (the
+        # r05 stretch solves silently ran 5-13% behind their own baseline).
+        # Deliberately route to the native hybrid (compiled C++ FFD + the
+        # dominance-priced candidate scoring of cost_solve_host) up to the
+        # largest measured host solve; past 200k pods the host path is
+        # unvalidated territory and solves fall through to jax-CPU.
         return True
     if _WARMING_HOST_PREFERENCE.is_set() and num_pods <= HOST_WARMING_MAX_PODS:
         # Boot warmup in flight: every device bucket is potentially cold,
@@ -1331,7 +1346,16 @@ def cost_solve_finish(
                 weights = PRIORITY_DECAY ** np.arange(len(row_prices))
                 price = float((weights / weights.sum()) @ row_prices)
             else:
-                price = float(prices[type_indices].min())
+                # Degenerate: no pool anywhere can host this fill, and the
+                # anchor t may be a padded phantom type index past the real
+                # catalog (kernel rounds keep the padded type axis). Price
+                # it unhostable — never cheap, never an IndexError — so the
+                # candidate loses on cost unless every rival is equally
+                # degenerate.
+                in_range = [i for i in type_indices if i < prices.shape[0]]
+                price = (
+                    float(prices[in_range].min()) if in_range else float("inf")
+                )
             price_memo[key] = price
         return price
 

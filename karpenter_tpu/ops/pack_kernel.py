@@ -218,13 +218,18 @@ def pack_kernel(
         iters=jnp.asarray(0, jnp.int32),
     )
     final = jax.lax.while_loop(cond, body, init)
+    # num_rounds can exceed the static mr budget (the 2G+8 bound is
+    # heuristic): jax clamps the out-of-bounds scatter into the last slot,
+    # silently corrupting it while num_rounds keeps counting. Surface that
+    # as overflow — the candidate is unusable and scoring must skip it —
+    # and clamp the reported count so hosts never read past the buffer.
     return PackRounds(
         round_type=final.round_type,
         round_fill=final.round_fill,
         round_repl=final.round_repl,
-        num_rounds=final.num_rounds,
+        num_rounds=jnp.minimum(final.num_rounds, mr),
         unschedulable=final.unschedulable,
-        overflow=final.counts.sum() > 0,
+        overflow=(final.counts.sum() > 0) | (final.num_rounds > mr),
     )
 
 

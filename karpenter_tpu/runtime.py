@@ -553,9 +553,26 @@ class Manager:
             self.loops["node"].enqueue(node.name)
         self.loops["podgc"].enqueue("sweep")
         if getattr(self.solver, "needs_device_warmup", False):
-            threading.Thread(
-                target=self._warmup, name="solver-warmup", daemon=True
-            ).start()
+            from karpenter_tpu.utils import backend_health
+
+            # One verdict before any in-process device touch: a wedged
+            # accelerator at boot must produce an explicit degraded mode
+            # (pinned CPU backend, host-hybrid routing, /readyz up) — not a
+            # warmup thread hanging in C behind a 503 forever.
+            boot = backend_health.ensure_backend()
+            if boot.state == backend_health.DEGRADED:
+                self.log.warning(
+                    "accelerator backend degraded at boot (%s): skipping "
+                    "device warmup; solves route to the native host hybrid "
+                    "(backend_probe_result=0 in /metrics)",
+                    boot.reason,
+                )
+                self.warm.set()
+                self.ready.set()
+            else:
+                threading.Thread(
+                    target=self._warmup, name="solver-warmup", daemon=True
+                ).start()
         else:
             self.warm.set()
             self.ready.set()

@@ -423,6 +423,19 @@ def main(argv=None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--workers", type=int, default=4)
     args = parser.parse_args(argv)
+    # One device-liveness verdict before ANY in-process device touch (the
+    # distributed init and the warmup ladder below both touch the backend):
+    # a wedged accelerator pins the CPU backend and the dispatch gate routes
+    # solves to the native host hybrid (models/solver.host_solve_enabled) —
+    # the sidecar serves degraded instead of hanging in backend init.
+    from karpenter_tpu.utils import backend_health
+
+    boot_verdict = backend_health.ensure_backend()
+    if boot_verdict.state == backend_health.DEGRADED:
+        log.warning(
+            "accelerator backend degraded at boot (%s): serving on the CPU "
+            "backend with host-hybrid routing", boot_verdict.reason
+        )
     # Multi-host slice (KARPENTER_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID or
     # KARPENTER_MULTIHOST=auto): join the jax.distributed runtime BEFORE the
     # first device touch, so jax.devices() is the global set and

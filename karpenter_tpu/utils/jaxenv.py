@@ -1,75 +1,10 @@
-"""Backend environment helpers shared by the test conftest, the driver
-entry points, and the bench's device-unavailable fallback.
+"""Compatibility shim: the device-liveness probe and CPU-backend pin moved
+into utils/backend_health, where the BackendHealth state machine owns the
+single liveness verdict for the whole process (probe, TTL re-probe, metrics,
+and degraded-mode routing). Import from there; these re-exports keep old
+callers working."""
 
-Under the axon TPU harness a sitecustomize registers the 'axon' PJRT
-backend at interpreter start — before env vars can steer backend choice —
-and selecting cpu via env alone then hangs in backend init. The working
-sequence (update the already-imported jax config, then drop the axon
-factory before any backend initializes) pokes a private jax attribute, so
-it lives in exactly one place.
-"""
-
-from __future__ import annotations
-
-import os
-
-
-_PROBE_CODE = (
-    "import jax, jax.numpy as jnp; jax.device_get(jnp.ones((8,)) + 1)"
+from karpenter_tpu.utils.backend_health import (  # noqa: F401
+    device_alive,
+    force_cpu_backend,
 )
-
-
-def device_alive(timeout_s: float = 180.0, _probe_code: str = _PROBE_CODE) -> bool:
-    """Probe the default accelerator in a SUBPROCESS with a hard timeout: a
-    wedged tunnel hangs jax inside C (uninterruptible from Python), so the
-    probe must be killable from outside. The child does exactly what a
-    first device touch would do. On failure the child's stderr (which
-    names the actual cause — import error, libtpu, backend init) is
-    forwarded to this process's stderr."""
-    import subprocess
-    import sys
-
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", _probe_code],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        if probe.returncode != 0:
-            sys.stderr.write(
-                "device probe failed:\n" + probe.stderr.decode(errors="replace")
-            )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        sys.stderr.write(f"device probe hung past {timeout_s}s (wedged tunnel?)\n")
-        return False
-
-
-def force_cpu_backend(host_devices: int | None = None, reset: bool = False):
-    """Pin jax to the CPU backend in-process; returns the jax module.
-
-    host_devices: also request an N-device virtual CPU mesh (must be set
-    before the CPU backend initializes). reset: clear already-initialized
-    backends first — needed when the caller already touched a device
-    (e.g. counted jax.devices()) before deciding to switch.
-    """
-    if host_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={host_devices}"
-        ).strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        import jax._src.xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-    except Exception:  # pragma: no cover — jax internals moved; env still set
-        pass
-    if reset:
-        import jax.extend.backend
-
-        jax.extend.backend.clear_backends()
-    return jax

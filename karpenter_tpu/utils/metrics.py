@@ -85,10 +85,15 @@ class Gauge:
         ]
         with self._lock:
             for label_values, value in sorted(self._values.items()):
-                labels = ",".join(
-                    f'{n}="{v}"' for n, v in zip(self.label_names, label_values)
-                )
-                lines.append(f"{self.name}{{{labels}}} {value}")
+                if self.label_names:
+                    labels = ",".join(
+                        f'{n}="{v}"' for n, v in zip(self.label_names, label_values)
+                    )
+                    lines.append(f"{self.name}{{{labels}}} {value}")
+                else:
+                    # Label-free series (e.g. backend_probe_result) render
+                    # without the empty brace pair.
+                    lines.append(f"{self.name} {value}")
         return lines
 
 

@@ -10,7 +10,7 @@ selecting cpu via env alone then hangs in backend init. So: update the already
 
 import os
 
-from karpenter_tpu.utils.jaxenv import force_cpu_backend
+from karpenter_tpu.utils.backend_health import force_cpu_backend
 
 force_cpu_backend(host_devices=8)
 

@@ -1,0 +1,396 @@
+"""BackendHealth: one probe, one verdict, deliberate degraded-mode routing.
+
+Every transition of UNKNOWN -> PROBING -> HEALTHY | DEGRADED(reason) is
+driven here with an injectable probe + FakeClock (extending the injectable-
+probe pattern of the original device-liveness tests), plus the subprocess
+probe's timeout-stderr forwarding, the TTL re-probe, the idempotent CPU pin
+(axon factory ALWAYS popped — the r05 rc:124 regression), and the degraded
+routing consulted by the solve dispatch gate."""
+
+import os
+import threading
+
+import pytest
+
+from karpenter_tpu.utils import backend_health as bh_mod
+from karpenter_tpu.utils.backend_health import (
+    DEGRADED,
+    HEALTHY,
+    PROBING,
+    UNKNOWN,
+    BackendHealth,
+    ProbeResult,
+    run_subprocess_probe,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def const_probe(ok=True, reason="", calls=None):
+    """A probe stub that records its timeout argument per call."""
+
+    def probe(timeout_s):
+        if calls is not None:
+            calls.append(timeout_s)
+        return ProbeResult(ok=ok, duration_s=0.01, reason=reason)
+
+    return probe
+
+
+def scripted_probe(results, calls=None):
+    """A probe stub yielding a scripted sequence of results."""
+    queue = list(results)
+
+    def probe(timeout_s):
+        if calls is not None:
+            calls.append(timeout_s)
+        return queue.pop(0)
+
+    return probe
+
+
+class TestStateMachine:
+    def test_starts_unknown_and_probes_to_healthy(self):
+        bh = BackendHealth(probe=const_probe(ok=True), clock=FakeClock())
+        assert bh.state() == UNKNOWN
+        assert not bh.degraded() and not bh.healthy()
+        verdict = bh.verdict()
+        assert verdict.state == HEALTHY
+        assert bh.healthy()
+        assert bh.transitions == [(UNKNOWN, PROBING), (PROBING, HEALTHY)]
+
+    def test_probe_failure_degrades_with_reason(self):
+        bh = BackendHealth(
+            probe=const_probe(ok=False, reason="no libtpu attached"),
+            clock=FakeClock(),
+        )
+        verdict = bh.verdict()
+        assert verdict.state == DEGRADED
+        assert "no libtpu attached" in verdict.reason
+        assert bh.degraded()
+        assert bh.transitions == [(UNKNOWN, PROBING), (PROBING, DEGRADED)]
+
+    def test_probe_exception_degrades(self):
+        def broken(timeout_s):
+            raise RuntimeError("probe infra down")
+
+        bh = BackendHealth(probe=broken, clock=FakeClock())
+        verdict = bh.verdict()
+        assert verdict.state == DEGRADED
+        assert "probe infra down" in verdict.reason
+
+    def test_verdict_is_cached_within_ttl(self):
+        calls = []
+        clock = FakeClock()
+        bh = BackendHealth(probe=const_probe(calls=calls), clock=clock)
+        first = bh.verdict()
+        clock.advance(bh.ttl_s / 2)
+        second = bh.verdict()
+        assert len(calls) == 1
+        assert second == first
+
+    def test_force_reprobes_inside_ttl(self):
+        calls = []
+        bh = BackendHealth(probe=const_probe(calls=calls), clock=FakeClock())
+        bh.verdict()
+        bh.verdict(force=True)
+        assert len(calls) == 2
+
+    def test_ttl_reprobe_picks_recovered_tunnel_back_up(self):
+        clock = FakeClock()
+        bh = BackendHealth(
+            probe=scripted_probe(
+                [
+                    ProbeResult(False, 0.1, "wedged tunnel"),
+                    ProbeResult(True, 0.1),
+                ]
+            ),
+            clock=clock,
+        )
+        assert bh.verdict().state == DEGRADED
+        clock.advance(bh.ttl_s / 2)
+        assert bh.verdict().state == DEGRADED  # cached, no re-probe yet
+        clock.advance(bh.ttl_s)
+        assert bh.verdict().state == HEALTHY  # expired -> re-probe -> recovery
+        assert bh.transitions == [
+            (UNKNOWN, PROBING),
+            (PROBING, DEGRADED),
+            (DEGRADED, PROBING),
+            (PROBING, HEALTHY),
+        ]
+
+    def test_degraded_predicate_kicks_background_reprobe_after_ttl(self):
+        clock = FakeClock()
+        release = threading.Event()
+        probed = threading.Event()
+        results = [ProbeResult(False, 0.1, "wedged tunnel")]
+
+        def probe(timeout_s):
+            if results:
+                return results.pop(0)
+            probed.set()
+            assert release.wait(timeout=10.0)
+            return ProbeResult(True, 0.1)
+
+        bh = BackendHealth(probe=probe, clock=clock)
+        assert bh.verdict().state == DEGRADED
+        clock.advance(bh.ttl_s + 1)
+        # The routing predicate stays cheap: it answers the STALE verdict
+        # while the background re-probe is in flight.
+        assert bh.degraded() is True
+        assert probed.wait(timeout=10.0)
+        assert bh.state() == PROBING
+        assert bh.degraded() is True  # still settled-degraded mid-probe
+        release.set()
+        bh._reprobe_thread.join(timeout=10.0)
+        assert bh.degraded() is False
+        assert bh.healthy()
+
+    def test_gauges_export_outcome_and_duration(self):
+        bh = BackendHealth(
+            probe=const_probe(ok=False, reason="dead"), clock=FakeClock()
+        )
+        bh.verdict()
+        assert bh_mod.PROBE_RESULT.get() == 0.0
+        assert bh_mod.PROBE_DURATION.get() == pytest.approx(0.01)
+        rendered = bh_mod.REGISTRY.render()
+        assert "karpenter_backend_probe_result 0.0" in rendered
+        assert "karpenter_backend_probe_duration_seconds" in rendered
+        bh2 = BackendHealth(probe=const_probe(ok=True), clock=FakeClock())
+        bh2.verdict()
+        assert bh_mod.PROBE_RESULT.get() == 1.0
+
+
+class TestSubprocessProbe:
+    def test_timeout_forwards_partial_stderr(self, capfd):
+        """The wedged-tunnel case: the child writes WHERE it got to, then
+        hangs forever. The probe must kill it at the deadline AND surface
+        the partial stderr — on r05 a hung probe reported nothing."""
+        clock = FakeClock()
+        bh = BackendHealth(
+            probe=lambda timeout_s: run_subprocess_probe(
+                1.0,
+                probe_code=(
+                    "import sys, time; "
+                    "sys.stderr.write('tunnel wedged at backend init'); "
+                    "sys.stderr.flush(); time.sleep(600)"
+                ),
+            ),
+            clock=clock,
+        )
+        verdict = bh.verdict()
+        assert verdict.state == DEGRADED
+        assert "hung past" in verdict.reason
+        err = capfd.readouterr().err
+        assert "tunnel wedged at backend init" in err
+
+    def test_failure_forwards_stderr(self, capfd):
+        bh = BackendHealth(
+            probe=lambda timeout_s: run_subprocess_probe(
+                30.0,
+                probe_code=(
+                    "import sys; sys.stderr.write('no libtpu here'); "
+                    "raise SystemExit(3)"
+                ),
+            ),
+            clock=FakeClock(),
+        )
+        verdict = bh.verdict()
+        assert verdict.state == DEGRADED
+        assert "exited 3" in verdict.reason
+        assert "no libtpu here" in capfd.readouterr().err
+
+    def test_probe_code_env_seam(self, monkeypatch):
+        """KARPENTER_PROBE_CODE is the process-level fault-injection seam
+        (make degraded-smoke injects a hang through it)."""
+        monkeypatch.setenv("KARPENTER_PROBE_CODE", "raise SystemExit(7)")
+        result = run_subprocess_probe(30.0)
+        assert not result.ok and "exited 7" in result.reason
+
+    def test_timeout_env_seam(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PROBE_TIMEOUT_S", "11.5")
+        calls = []
+        bh = BackendHealth(probe=const_probe(calls=calls), clock=FakeClock())
+        bh.verdict()
+        assert calls == [11.5]
+
+    def test_malformed_timeout_env_degrades_instead_of_wedging(
+        self, monkeypatch
+    ):
+        """A bad KARPENTER_PROBE_TIMEOUT_S must settle DEGRADED, not raise
+        out of _run_probe and strand the machine in PROBING forever."""
+        monkeypatch.setenv("KARPENTER_PROBE_TIMEOUT_S", "30s")
+        bh = BackendHealth(probe=const_probe(ok=True), clock=FakeClock())
+        verdict = bh.verdict()
+        assert verdict.state == DEGRADED
+        assert "probe raised" in verdict.reason
+        assert bh.state() == DEGRADED  # settled — future re-probes can run
+
+    def test_child_never_inherits_the_cpu_pin(self, monkeypatch):
+        """After a DEGRADED verdict pin_cpu writes JAX_PLATFORMS=cpu into
+        os.environ; the TTL re-probe's child must NOT inherit it, or it
+        would probe the CPU backend, trivially pass, and flip the verdict
+        to a false HEALTHY while the accelerator is still dead."""
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        result = run_subprocess_probe(
+            30.0,
+            probe_code=(
+                "import os, sys; "
+                "sys.exit(9 if 'JAX' + '_PLATFORMS' in os.environ else 0)"
+            ),
+        )
+        assert result.ok, result.reason
+
+
+@pytest.fixture
+def axon_factory():
+    """Plant a sentinel 'axon' PJRT factory (the harness's sitecustomize
+    analogue) and report whether it survived."""
+    import jax._src.xla_bridge as xla_bridge
+
+    xla_bridge._backend_factories["axon"] = object()
+    try:
+        yield lambda: "axon" in xla_bridge._backend_factories
+    finally:
+        xla_bridge._backend_factories.pop("axon", None)
+
+
+class TestPinCpu:
+    def test_pops_axon_even_when_env_already_says_cpu(self, axon_factory):
+        """THE r05 rc:124 bug: with JAX_PLATFORMS=cpu inherited, the old
+        entry points skipped the pin entirely and hung in backend init."""
+        assert os.environ.get("JAX_PLATFORMS") == "cpu"  # conftest pinned
+        jax = bh_mod.pin_cpu()
+        assert not axon_factory()
+        assert jax.devices()[0].platform == "cpu"
+
+    def test_idempotent_and_host_device_flag_never_stacks(self, monkeypatch):
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_foo=1 --xla_force_host_platform_device_count=4",
+        )
+        bh_mod.pin_cpu(host_devices=8)
+        bh_mod.pin_cpu(host_devices=8)
+        flags = os.environ["XLA_FLAGS"].split()
+        assert "--xla_foo=1" in flags
+        assert (
+            flags.count("--xla_force_host_platform_device_count=8") == 1
+        )
+        assert not any(f.endswith("=4") for f in flags)
+
+
+class TestEnsureBackend:
+    """The shared entry-point backend-setup discipline (entry(), bench,
+    Manager boot, sidecar main)."""
+
+    def test_env_cpu_pins_without_probing(self, axon_factory):
+        calls = []
+        bh = BackendHealth(probe=const_probe(calls=calls), clock=FakeClock())
+        assert os.environ.get("JAX_PLATFORMS") == "cpu"
+        verdict = bh.ensure_backend()
+        assert calls == []  # no probe: the configured backend IS the cpu
+        assert verdict.state == HEALTHY and verdict.reason == "cpu-pinned"
+        assert not axon_factory()  # ...but the axon factory is still popped
+
+    def test_degraded_probe_pins_cpu_before_any_device_touch(
+        self, axon_factory, monkeypatch
+    ):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        bh = BackendHealth(
+            probe=const_probe(ok=False, reason="wedged"), clock=FakeClock()
+        )
+        verdict = bh.ensure_backend()
+        assert verdict.state == DEGRADED
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert not axon_factory()
+
+    def test_healthy_probe_leaves_the_accelerator_backend_alone(
+        self, axon_factory, monkeypatch
+    ):
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        bh = BackendHealth(probe=const_probe(ok=True), clock=FakeClock())
+        verdict = bh.ensure_backend()
+        assert verdict.state == HEALTHY
+        assert os.environ.get("JAX_PLATFORMS") is None
+        assert axon_factory()  # no pin: the live accelerator keeps its factory
+
+
+class TestEntryPointSetup:
+    def test_entry_pops_axon_before_any_in_process_device_call(
+        self, axon_factory
+    ):
+        """entry() with JAX_PLATFORMS=cpu inherited (the exact r05 scenario)
+        must pin the CPU backend — popping the axon factory — before the
+        caller's jit compile touches a device."""
+        import __graft_entry__
+
+        assert os.environ.get("JAX_PLATFORMS") == "cpu"
+        fn, args = __graft_entry__.entry()
+        assert not axon_factory()
+        rounds = fn(*args)  # the compile check completes on the cpu backend
+        assert int(rounds.num_rounds) > 0
+
+    def test_dryrun_source_has_no_probe_and_no_env_guard(self):
+        """dryrun_multichip pins the virtual CPU mesh unconditionally: by
+        contract it contains no probe call and no JAX_PLATFORMS guard."""
+        import inspect
+
+        import __graft_entry__
+
+        source = inspect.getsource(__graft_entry__.dryrun_multichip)
+        assert "device_alive" not in source
+        assert "ensure_backend" not in source
+        assert "JAX" + "_PLATFORMS" not in source
+
+
+@pytest.fixture
+def process_backend():
+    """Run a test against the process-wide BACKEND singleton, restoring it
+    to UNKNOWN after (other tests must keep routing on a clean verdict)."""
+    bh_mod.BACKEND.reset()
+    try:
+        yield bh_mod.BACKEND
+    finally:
+        bh_mod.BACKEND.reset()
+
+
+class TestDegradedRouting:
+    def test_degraded_routes_stretch_scale_to_native_hybrid(
+        self, process_backend, monkeypatch
+    ):
+        """The dispatch gate's decision table: DEGRADED x >=100k pods goes
+        to the native hybrid instead of silently losing to its own baseline
+        on jax-CPU; past the largest measured host solve it falls through;
+        HEALTHY keeps the calibrated device routing."""
+        from karpenter_tpu.models import solver as solver_models
+        from karpenter_tpu.ops import native
+
+        if not native.available():
+            pytest.skip("native host library unavailable")
+        monkeypatch.delenv("KARPENTER_HOST_SOLVE", raising=False)
+        # Pin the single-device policy so the sharded gate doesn't shadow
+        # the verdict comparison on the suite's 8-device mesh.
+        monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
+
+        monkeypatch.setattr(
+            process_backend, "_probe", const_probe(ok=False, reason="wedged")
+        )
+        assert process_backend.verdict(force=True).state == DEGRADED
+        assert solver_models.host_solve_enabled(150_000) is True
+        assert solver_models.host_solve_enabled(
+            solver_models.HOST_WARMING_MAX_PODS + 1
+        ) is False  # beyond the largest measured host solve: unvalidated
+
+        monkeypatch.setattr(
+            process_backend, "_probe", const_probe(ok=True)
+        )
+        assert process_backend.verdict(force=True).state == HEALTHY
+        # Healthy again: stretch scale routes back to the device.
+        assert solver_models.host_solve_enabled(150_000) is False
+
+    def test_unknown_verdict_changes_nothing(self, process_backend):
+        """No verdict recorded (the common in-process test path): routing
+        falls through to the calibrated thresholds untouched."""
+        from karpenter_tpu.models import solver as solver_models
+
+        assert process_backend.state() == UNKNOWN
+        assert solver_models.host_solve_enabled(150_000) is False

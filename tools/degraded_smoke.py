@@ -1,0 +1,69 @@
+"""Degraded-mode smoke: both driver entry points under a WEDGED accelerator.
+
+Simulates the exact r05 rc:124 failure — a probe child that hangs forever
+(what a wedged tunnel looks like from outside), injected through the
+KARPENTER_PROBE_CODE seam with a short KARPENTER_PROBE_TIMEOUT_S so the
+budget is spent on the actual checks. Each entry point runs in its own
+subprocess, exactly as the driver invokes them (and because XLA parses
+XLA_FLAGS once per process, dryrun's virtual mesh needs a process where no
+backend initialized first). `make degraded-smoke` wraps the whole thing in
+a hard 60s timeout: if either entry point ever re-grows a path that waits
+on the dead device, the target times out instead of wedging a driver run.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENTRY_CHECK = """
+import __graft_entry__
+from karpenter_tpu.utils import backend_health
+
+fn, args = __graft_entry__.entry()
+verdict = backend_health.BACKEND.snapshot()
+assert verdict.state == backend_health.DEGRADED, (
+    f"wedged probe did not degrade the verdict: {verdict}"
+)
+import jax
+
+rounds = jax.jit(fn)(*args)  # the compile check, on the pinned CPU
+assert int(rounds.num_rounds) > 0
+print(f"entry() OK degraded ({verdict.reason})")
+"""
+
+DRYRUN_CHECK = """
+import __graft_entry__
+
+__graft_entry__.dryrun_multichip(2)
+"""
+
+
+def main() -> None:
+    # Force the probe path (no inherited cpu pin) and wedge the probe.
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["KARPENTER_PROBE_CODE"] = "import time; time.sleep(600)"
+    env["KARPENTER_PROBE_TIMEOUT_S"] = "5"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    start = time.perf_counter()
+    for label, code in (("entry", ENTRY_CHECK), ("dryrun", DRYRUN_CHECK)):
+        leg = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO, env=env, timeout=60
+        )
+        assert leg.returncode == 0, (
+            f"{label} check failed under a wedged probe (rc {leg.returncode})"
+        )
+    total_s = time.perf_counter() - start
+    assert total_s < 60.0, f"degraded smoke overran its budget: {total_s:.1f}s"
+    print(
+        f"degraded-smoke OK: entry() compile check + dryrun_multichip(2) in "
+        f"{total_s:.1f}s under a wedged probe"
+    )
+
+
+if __name__ == "__main__":
+    main()
