@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test battletest degraded-smoke proto native bench clean
+.PHONY: test battletest degraded-smoke crash-smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -29,6 +29,15 @@ battletest:
 # dead device, this target fails fast instead of wedging a driver run.
 degraded-smoke:
 	timeout -k 10 60 python tools/degraded_smoke.py
+
+# The crashpoint battletest matrix (tests/test_crash_consistency.py): every
+# named injection site killed mid-pipeline, controllers restarted over the
+# surviving state, convergence asserted (pods bound exactly once, zero
+# leaked instances after the GC grace, deterministic launch identity across
+# the crash). The hard 120s timeout is the guardrail — a crash path that
+# re-grows a wait on unreconstructable state fails fast, not forever.
+crash-smoke:
+	timeout -k 10 120 python tools/crash_smoke.py
 
 proto:
 	protoc -I protos --python_out=karpenter_tpu/solver_service protos/solver.proto

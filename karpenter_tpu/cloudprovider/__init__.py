@@ -73,6 +73,22 @@ class InstanceType:
         return min(prices) if prices else float("inf")
 
 
+@dataclass(frozen=True)
+class CloudInstance:
+    """A provider-side instance carrying this cluster's ownership tag, as
+    returned by `CloudProvider.list_instances`. This is the GC controller's
+    view of "what we are paying for": `provider_id` is the join key against
+    Nodes, `launched_at` (0.0 = unknown) is observability for leak triage."""
+
+    instance_id: str
+    provider_id: str
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    state: str = "running"
+    launched_at: float = 0.0
+
+
 @dataclass
 class NodeSpec:
     """A launched (or to-be-launched) node as the control plane sees it."""
@@ -126,6 +142,7 @@ class CloudProvider(abc.ABC):
         quantity: int,
         callback: Callable[[NodeSpec], None],
         pool_options: Optional[Sequence] = None,
+        launch_id: Optional[str] = None,
     ) -> List[Exception]:
         """Launch `quantity` nodes satisfying constraints, choosing among the
         offered instance_types; invoke callback per launched node. Returns
@@ -135,11 +152,35 @@ class CloudProvider(abc.ABC):
         launch request to specific price-ranked (type, zone) pools — the
         cost-aware plan's override rows. None = derive rows from
         instance_types x offerings (reference semantics,
-        ref: instance.go getOverrides:173-207)."""
+        ref: instance.go getOverrides:173-207).
+
+        `launch_id` is the caller's stable identity for this logical launch
+        (the provisioning worker derives it from the batch content). A
+        provider that supports idempotent launches MUST treat a repeated
+        launch_id as the same purchase: re-deliver the instances the first
+        attempt bought (adoption) instead of buying again, and derive any
+        wire-level idempotency token (EC2 ClientToken) from it so a retried
+        or crash-re-issued call is a server-side no-op. None = every call is
+        a fresh purchase (legacy behavior)."""
 
     @abc.abstractmethod
     def delete(self, node: NodeSpec) -> None:
         ...
+
+    def list_instances(self) -> List[CloudInstance]:
+        """Every live instance carrying this cluster's ownership tag,
+        whether or not a Node exists for it — the ground truth the leaked-
+        capacity GC (controllers/instancegc.py) reconciles Nodes against.
+        Providers that cannot enumerate owned capacity return [] (the GC is
+        then inert for them)."""
+        return []
+
+    def terminate_instance(self, instance: CloudInstance) -> None:
+        """Terminate a (possibly Node-less) instance by provider identity.
+        Not-found must be success: the GC races normal termination."""
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot terminate untracked instances"
+        )
 
     @abc.abstractmethod
     def get_instance_types(self, constraints: Optional[Constraints] = None) -> List[InstanceType]:

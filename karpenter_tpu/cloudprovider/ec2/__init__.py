@@ -18,6 +18,7 @@ from typing import Callable, List, Optional, Sequence
 
 from karpenter_tpu.api.provisioner import Constraints, Provisioner
 from karpenter_tpu.cloudprovider import (
+    CloudInstance,
     CloudProvider,
     InstanceType,
     NodeSpec,
@@ -97,16 +98,19 @@ class Ec2CloudProvider(CloudProvider):
         quantity: int,
         callback: Callable[[NodeSpec], None],
         pool_options: Optional[Sequence] = None,
+        launch_id: Optional[str] = None,
     ) -> List[Exception]:
         """Ref: aws/cloudprovider.go Create:111-133 — one throttled fleet
-        launch per packing; each launched node flows through the callback."""
+        launch per packing; each launched node flows through the callback.
+        `launch_id` propagates to deterministic CreateFleet ClientTokens
+        (restart-safe launches; see instances._launch)."""
         errors: List[Exception] = []
         try:
             provider = Ec2Provider.deserialize(constraints)
             self._throttle()
             nodes = self.instances.create(
                 constraints, provider, instance_types, quantity,
-                pool_options=pool_options,
+                pool_options=pool_options, launch_id=launch_id,
             )
         except Exception as error:  # noqa: BLE001 — reported, not raised
             return [error] * quantity
@@ -121,6 +125,36 @@ class Ec2CloudProvider(CloudProvider):
 
     def delete(self, node: NodeSpec) -> None:
         self.instances.terminate(node)
+
+    def list_instances(self) -> List[CloudInstance]:
+        """Everything tagged as ours and not already terminating — the
+        leaked-capacity GC's ground truth (DescribeInstances by the
+        framework ownership tag that merge_tags stamps on every launch)."""
+        from karpenter_tpu.cloudprovider.ec2.instances import PROVIDER_ID_FORMAT
+        from karpenter_tpu.cloudprovider.ec2.vendor import FRAMEWORK_TAG_KEY_FORMAT
+
+        filters = {FRAMEWORK_TAG_KEY_FORMAT.format(self.cluster_name): "owned"}
+        out: List[CloudInstance] = []
+        for instance in self.api.describe_instances_by_tag(filters):
+            if instance.state in ("terminated", "shutting-down"):
+                continue
+            out.append(
+                CloudInstance(
+                    instance_id=instance.instance_id,
+                    provider_id=PROVIDER_ID_FORMAT.format(
+                        zone=instance.zone, instance_id=instance.instance_id
+                    ),
+                    instance_type=instance.instance_type,
+                    zone=instance.zone,
+                    capacity_type="spot" if instance.spot else "on-demand",
+                    state=instance.state,
+                    launched_at=instance.launched_at,
+                )
+            )
+        return out
+
+    def terminate_instance(self, instance: CloudInstance) -> None:
+        self.instances.terminate_by_id(instance.instance_id)
 
     def get_instance_types(
         self, constraints: Optional[Constraints] = None

@@ -20,12 +20,24 @@ a real AWS/GCP binding is one class implementing `Ec2Api`.
 from __future__ import annotations
 
 import abc
+import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence
 
 # Setup-resource cache TTL shared by subnet/SG/AMI/launch-template
 # discovery (ref: aws/cloudprovider.go:53 CacheTTL 60s).
 SETUP_CACHE_TTL = 60.0
+
+
+def derive_client_token(*parts: str) -> str:
+    """Deterministic idempotency token from the logical call's identity.
+    Two processes (or one process before and after a crash) issuing the
+    same logical call derive the SAME token, so the second execution is a
+    server-side no-op instead of a duplicate purchase. 64-char budget per
+    the EC2 ClientToken contract; 32 hex chars of SHA-256 is comfortably
+    collision-free at fleet-call volumes."""
+    digest = hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
+    return f"ktpu-{digest}"
 
 # --- Error model (ref: aws/errors.go:22-43) --------------------------------
 
@@ -140,13 +152,36 @@ class FleetOverride:
 class FleetRequest:
     """Ref: ec2.CreateFleetInput (instance.go:116-133). type=instant
     semantics: the call synchronously returns launched ids + per-pool
-    errors; partial fulfillment is allowed."""
+    errors; partial fulfillment is allowed.
+
+    `client_token` is the EC2 idempotency token. Empty = the binding mints
+    a random one per logical call (retries of that call still reuse it).
+    Callers that need RESTART idempotency (a re-issued launch after a crash
+    or ambiguous 5xx must be a server-side no-op) derive it deterministically
+    from the launch content — see instances.InstanceProvider._launch."""
 
     launch_template_name: str
     overrides: List[FleetOverride]
     capacity_type: str
     quantity: int
     tags: Dict[str, str] = field(default_factory=dict)
+    client_token: str = ""
+
+    def idempotency_payload(self) -> str:
+        """Canonical serialization of everything EC2 compares under a reused
+        ClientToken. Token derivation (instances._launch) and the fake's
+        IdempotentParameterMismatch check both key on this one method, so
+        the two sides of the contract cannot drift apart."""
+        rows = sorted(
+            f"{o.instance_type}/{o.subnet_id}/{o.zone}/{o.priority}"
+            for o in self.overrides
+        )
+        tags = sorted(f"{k}={v}" for k, v in self.tags.items())
+        return "|".join(
+            [self.launch_template_name, self.capacity_type, str(self.quantity)]
+            + rows
+            + tags
+        )
 
 
 @dataclass(frozen=True)
@@ -167,7 +202,9 @@ class FleetResult:
 
 @dataclass(frozen=True)
 class Instance:
-    """Ref: ec2.Instance fields read by instanceToNode (instance.go:232-268)."""
+    """Ref: ec2.Instance fields read by instanceToNode (instance.go:232-268).
+    `tags` and `launched_at` (epoch seconds, 0.0 = unknown) feed the
+    leaked-capacity GC's by-cluster-tag listing."""
 
     instance_id: str
     instance_type: str
@@ -177,6 +214,8 @@ class Instance:
     architecture: str = "x86_64"
     spot: bool = False
     state: str = "running"
+    tags: Mapping[str, str] = field(default_factory=dict)
+    launched_at: float = 0.0
 
 
 # --- The boundary ----------------------------------------------------------
@@ -218,6 +257,14 @@ class Ec2Api(abc.ABC):
     @abc.abstractmethod
     def describe_instances(self, instance_ids: Sequence[str]) -> List[Instance]:
         ...
+
+    @abc.abstractmethod
+    def describe_instances_by_tag(
+        self, filters: Mapping[str, str]
+    ) -> List[Instance]:
+        """Every instance matching a tag selector (same filter grammar as
+        describe_subnets), terminated ones included with their state — the
+        leaked-capacity GC's DescribeInstances-by-cluster-tag sweep."""
 
     @abc.abstractmethod
     def terminate_instances(self, instance_ids: Sequence[str]) -> None:

@@ -38,6 +38,7 @@ from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 from karpenter_tpu.cloudprovider.ec2.api import (
     ApiError,
     Ec2Api,
+    derive_client_token,
     FleetError,
     FleetRequest,
     FleetResult,
@@ -50,11 +51,21 @@ from karpenter_tpu.cloudprovider.ec2.api import (
 )
 
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.metrics import REGISTRY
 
 log = klog.named("aws")
 
 EC2_API_VERSION = "2016-11-15"
 _SSM_TARGET_PREFIX = "AmazonSSM"
+
+# Retries by action and error code: a rising rate is the first visible sign
+# of throttling or a flaky NAT path, well before calls start exhausting
+# their budget and failing outright.
+AWS_RETRY_TOTAL = REGISTRY.counter(
+    "aws_retry_total",
+    "AWS call attempts retried, by API action and error code",
+    ["action", "code"],
+)
 
 
 # --- HTTP layer -------------------------------------------------------------
@@ -291,6 +302,19 @@ def _tags(element: Optional[ET.Element]) -> Dict[str, str]:
     }
 
 
+def _parse_launch_time(value: str) -> float:
+    """ISO-8601 launchTime -> epoch seconds; 0.0 when absent/unparseable
+    (the GC treats 0.0 as unknown and falls back to sighting age)."""
+    if not value:
+        return 0.0
+    try:
+        return datetime.datetime.fromisoformat(
+            value.replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return 0.0
+
+
 # --- The binding ------------------------------------------------------------
 
 
@@ -354,6 +378,7 @@ class AwsHttpEc2Api(Ec2Api):
                     raise
                 delay = self.retry.delay(attempt, error.code)
                 attempt += 1
+                AWS_RETRY_TOTAL.inc(what, error.code)
                 log.debug(
                     "%s attempt %d failed (%s); retrying in %.2fs",
                     what, attempt, error.code, delay,
@@ -625,10 +650,17 @@ class AwsHttpEc2Api(Ec2Api):
     def create_launch_template(self, template: LaunchTemplate) -> LaunchTemplate:
         params: Dict[str, str] = {
             "LaunchTemplateName": template.name,
-            # Same idempotency rationale as CreateFleet: a retried create
-            # whose first attempt executed server-side must not surface
-            # AlreadyExists (one token per logical call, reused by retries).
-            "ClientToken": str(uuid.uuid4()),
+            # Same idempotency rationale as CreateFleet, strengthened to
+            # survive a controller RESTART: the token derives from the
+            # template's content identity (the name already embeds the
+            # content hash — launchtemplates._template_name), so a retried
+            # attempt re-sends the identical token (one body per logical
+            # call in _ec2_call) AND a restarted controller re-ensuring the
+            # same template is a server-side no-op rather than an
+            # AlreadyExists surprise.
+            "ClientToken": derive_client_token(
+                "CreateLaunchTemplate", template.name, template.image_id
+            ),
             "LaunchTemplateData.ImageId": template.image_id,
             "LaunchTemplateData.UserData": template.user_data,
         }
@@ -665,8 +697,11 @@ class AwsHttpEc2Api(Ec2Api):
             # Idempotency token: a retried CreateFleet (5xx whose first
             # attempt may have executed server-side) must not double-launch.
             # The whole retry loop re-sends ONE token since the body is built
-            # once per logical call in _ec2_call.
-            "ClientToken": str(uuid.uuid4()),
+            # once per logical call in _ec2_call. When the caller supplies a
+            # deterministic token (restart-safe launches, see FleetRequest),
+            # it is forwarded verbatim; otherwise a random per-call token
+            # preserves the retry-only guarantee.
+            "ClientToken": request.client_token or str(uuid.uuid4()),
             "LaunchTemplateConfigs.1.LaunchTemplateSpecification.LaunchTemplateName":
                 request.launch_template_name,
             "LaunchTemplateConfigs.1.LaunchTemplateSpecification.Version": "$Latest",
@@ -714,6 +749,16 @@ class AwsHttpEc2Api(Ec2Api):
             f"InstanceId.{index}": instance_id
             for index, instance_id in enumerate(instance_ids, start=1)
         }
+        return self._describe_instances(params)
+
+    def describe_instances_by_tag(
+        self, filters: Mapping[str, str]
+    ) -> List[Instance]:
+        """DescribeInstances with tag filters — the leaked-capacity GC's
+        sweep over everything this cluster is paying for, Node or not."""
+        return self._describe_instances(self._filter_params(filters))
+
+    def _describe_instances(self, params: Mapping[str, str]) -> List[Instance]:
         items = self._ec2_paginated(
             "DescribeInstances", params, "reservationSet/item"
         )
@@ -730,6 +775,10 @@ class AwsHttpEc2Api(Ec2Api):
                         architecture=_text(item, "architecture", "x86_64"),
                         spot=_text(item, "instanceLifecycle") == "spot",
                         state=_text(item, "instanceState/name", "running"),
+                        tags=_tags(item),
+                        launched_at=_parse_launch_time(
+                            _text(item, "launchTime")
+                        ),
                     )
                 )
         return instances

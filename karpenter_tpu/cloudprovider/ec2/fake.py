@@ -13,6 +13,7 @@ from __future__ import annotations
 import hashlib
 import itertools
 import threading
+from dataclasses import replace
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 from karpenter_tpu.cloudprovider.ec2.api import (
@@ -140,6 +141,20 @@ class FakeEc2(Ec2Api):
 
         self.launch_templates: Dict[str, LaunchTemplate] = {}
         self.instances: Dict[str, Instance] = {}
+        # Terminated instances stay DESCRIBABLE with state="terminated",
+        # exactly like EC2 (corpses linger in DescribeInstances for about an
+        # hour): the launch path's liveness filter and corpse-replay
+        # recovery only exist on the real wire surface, so the fake must
+        # not hide dead instances for them to be testable.
+        self.corpses: Dict[str, Instance] = {}
+        # ClientToken -> (request fingerprint, instance ids) of the fleet
+        # that token bought. A repeated token replays those ids instead of
+        # launching again — INCLUDING since-terminated ones, which is what
+        # EC2 does (idempotency replays the recorded result, not a liveness
+        # check) — the server-side half of restart-safe launches. A reused
+        # token with DIFFERENT request parameters is rejected, also like
+        # EC2 (IdempotentParameterMismatch).
+        self._fleet_tokens: Dict[str, Tuple[str, List[str]]] = {}
         self.calls: Dict[str, List] = {
             "create_fleet": [],
             "create_launch_template": [],
@@ -213,6 +228,14 @@ class FakeEc2(Ec2Api):
                     "InvalidLaunchTemplateName.NotFoundException",
                     request.launch_template_name,
                 )
+            if request.client_token and request.client_token in self._fleet_tokens:
+                fingerprint, replay = self._fleet_tokens[request.client_token]
+                if fingerprint != request.idempotency_payload():
+                    raise ApiError(
+                        "IdempotentParameterMismatch",
+                        "client token reused with different parameters",
+                    )
+                return FleetResult(instance_ids=list(replay))
             template = self.launch_templates[request.launch_template_name]
             result = FleetResult()
             pools = sorted(
@@ -251,9 +274,15 @@ class FakeEc2(Ec2Api):
                     image_id=template.image_id,
                     architecture=info.architectures[0] if info else "x86_64",
                     spot=request.capacity_type == "spot",
+                    tags=dict(request.tags),
                 )
                 self.instances[instance_id] = instance
                 result.instance_ids.append(instance_id)
+            if request.client_token:
+                self._fleet_tokens[request.client_token] = (
+                    request.idempotency_payload(),
+                    list(result.instance_ids),
+                )
             return result
 
     def _info(self, name: str) -> Optional[InstanceTypeInfo]:
@@ -266,18 +295,35 @@ class FakeEc2(Ec2Api):
 
     def describe_instances(self, instance_ids: Sequence[str]) -> List[Instance]:
         with self._lock:
-            missing = [i for i in instance_ids if i not in self.instances]
+            known = {**self.corpses, **self.instances}
+            missing = [i for i in instance_ids if i not in known]
             if missing:
                 raise ApiError("InvalidInstanceID.NotFound", ",".join(missing))
-            return [self.instances[i] for i in instance_ids]
+            return [known[i] for i in instance_ids]
+
+    def describe_instances_by_tag(
+        self, filters: Mapping[str, str]
+    ) -> List[Instance]:
+        # Corpses show up here too — callers (the leaked-capacity GC's
+        # listing) are expected to filter on state, as with real EC2.
+        with self._lock:
+            return [
+                instance
+                for instance in list(self.instances.values())
+                + list(self.corpses.values())
+                if match_tags(instance.tags, filters)
+            ]
 
     def terminate_instances(self, instance_ids: Sequence[str]) -> None:
         with self._lock:
             self.calls["terminate_instances"].append(list(instance_ids))
             for instance_id in instance_ids:
+                if instance_id in self.corpses:
+                    continue  # terminating a terminated instance is a no-op
                 if instance_id not in self.instances:
                     raise ApiError("InvalidInstanceID.NotFound", instance_id)
-                del self.instances[instance_id]
+                live = self.instances.pop(instance_id)
+                self.corpses[instance_id] = replace(live, state="terminated")
 
     # --- ssm ---------------------------------------------------------------
 

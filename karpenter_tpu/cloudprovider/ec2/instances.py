@@ -26,6 +26,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
     FleetRequest,
     FleetResult,
     Instance,
+    derive_client_token,
     is_not_found,
 )
 from karpenter_tpu.cloudprovider.ec2.instancetypes import InstanceTypeProvider
@@ -33,9 +34,27 @@ from karpenter_tpu.cloudprovider.ec2.launchtemplates import LaunchTemplateProvid
 from karpenter_tpu.cloudprovider.ec2.network import SubnetProvider
 from karpenter_tpu.cloudprovider.ec2.vendor import Ec2Provider, merge_tags
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.crashpoints import crashpoint
 
 DESCRIBE_RETRY_ATTEMPTS = 3  # ref: instance.go:57-61
 DESCRIBE_RETRY_DELAY = 1.0
+
+# States in which the instance is not (or will not stay) usable capacity.
+# EC2 keeps terminated instances DESCRIBABLE for about an hour, and a
+# ClientToken replay hands back the ORIGINAL instance ids regardless of
+# their state — so adoption must filter on liveness or it would register
+# Nodes backed by corpses.
+DEAD_INSTANCE_STATES = frozenset(
+    {"shutting-down", "terminated", "stopping", "stopped"}
+)
+
+# When a replayed token yields ONLY corpses (controller down past the GC
+# grace: the sweep terminated the orphans, then the restart re-issued the
+# launch), the launch walks to the next token generation and buys fresh.
+# The walk is deterministic — generation g derives from (launch_id, g) — so
+# a crash mid-walk replays the same sequence. The cap bounds the pathology
+# of every prior generation having been bought and reaped.
+MAX_LAUNCH_GENERATIONS = 4
 
 PROVIDER_ID_FORMAT = "aws:///{zone}/{instance_id}"
 
@@ -78,15 +97,36 @@ class InstanceProvider:
         instance_types: Sequence[InstanceType],
         quantity: int,
         pool_options=None,
+        launch_id: Optional[str] = None,
     ) -> List[NodeSpec]:
         """Launch up to `quantity` nodes; partial fulfillment returns fewer
         (ref: instance.go Create:49-89). instance_types should be sorted
         smallest-first — spot priority derives from that order. `pool_options`
-        (price-ranked PoolOption rows) pins per-pool override rows instead."""
-        instance_ids = self._launch(
-            constraints, provider, instance_types, quantity, pool_options
-        )
-        instances = self._describe_with_retry(instance_ids)
+        (price-ranked PoolOption rows) pins per-pool override rows instead.
+        `launch_id` makes the fleet calls restart-idempotent (deterministic
+        ClientTokens; see _launch)."""
+        instances: List[Instance] = []
+        for generation in range(MAX_LAUNCH_GENERATIONS):
+            generation_id = launch_id
+            if launch_id and generation:
+                generation_id = f"{launch_id}|g{generation}"
+            instance_ids = self._launch(
+                constraints, provider, instance_types, quantity, pool_options,
+                launch_id=generation_id,
+            )
+            # Capacity is bought (instance ids in hand); nothing upstream
+            # knows yet — the canonical crash/leak window the GC +
+            # idempotent tokens exist for.
+            crashpoint("cloud.after-create-fleet")
+            described = self._describe_with_retry(instance_ids)
+            instances = [
+                i for i in described if i.state not in DEAD_INSTANCE_STATES
+            ]
+            if instances or not launch_id:
+                break
+            # Every id the fleet calls handed back is a corpse: the token
+            # replayed a pre-crash purchase whose capacity was since
+            # terminated. Walk to the next deterministic generation.
         by_name = {t.name: t for t in instance_types}
         nodes, strays = [], []
         for instance in instances:
@@ -105,7 +145,12 @@ class InstanceProvider:
 
     def terminate(self, node: NodeSpec) -> None:
         """Ref: instance.go Terminate:91-105 — not-found is success."""
-        instance_id = parse_instance_id(node.provider_id)
+        self.terminate_by_id(parse_instance_id(node.provider_id))
+
+    def terminate_by_id(self, instance_id: str) -> None:
+        """Not-found is success (raced normal termination / already gone) —
+        the one terminate contract, shared by node deletion and the
+        leaked-capacity GC."""
         try:
             self.api.terminate_instances([instance_id])
         except Exception as error:  # noqa: BLE001 — coded errors only
@@ -122,8 +167,26 @@ class InstanceProvider:
         instance_types: Sequence[InstanceType],
         quantity: int,
         pool_options=None,
+        launch_id: Optional[str] = None,
     ) -> List[str]:
-        """Ref: instance.go launchInstances:107-146."""
+        """Ref: instance.go launchInstances:107-146.
+
+        With `launch_id`, every CreateFleet call in the template walk gets a
+        ClientToken derived from (cluster, launch_id, call index, and the
+        FULL request content — template, capacity type, quantity, override
+        rows, tags): the walk is deterministic (templates is
+        insertion-ordered from the same inputs), so a controller that
+        crashed after a fleet call and re-issues the same logical launch
+        replays the identical token sequence and ADOPTS the instances the
+        first attempt bought instead of buying twice. Binding the token to
+        the request content matters for the OTHER restart path: the ICE
+        blackout cache empties on restart (and subnets/offerings drift), so
+        a re-solve can rebuild DIFFERENT override rows for the same logical
+        launch — EC2 rejects a reused token whose parameters changed
+        (IdempotentParameterMismatch), which would wedge the launch loop
+        until the idempotency window expires. A drifted request instead
+        mints a fresh token and buys fresh; the first attempt's orphans are
+        the leaked-capacity GC's job."""
         capacity_type = self.pick_capacity_type(constraints, instance_types)
         templates = self.launch_template_provider.get(
             constraints,
@@ -134,6 +197,7 @@ class InstanceProvider:
         subnets = self.subnet_provider.get(provider)
         allowed_zones = constraints.effective_requirements().zones()
         result = FleetResult()
+        fleet_call_index = 0
         for template_name, template_types in templates.items():
             if pool_options:
                 overrides = self.build_pool_overrides(
@@ -146,15 +210,23 @@ class InstanceProvider:
                 )
             if not overrides:
                 continue
-            fleet = self.api.create_fleet(
-                FleetRequest(
-                    launch_template_name=template_name,
-                    overrides=overrides,
-                    capacity_type=capacity_type,
-                    quantity=quantity - len(result.instance_ids),
-                    tags=merge_tags(self.cluster_name, "", dict(provider.tags)),
-                )
+            request = FleetRequest(
+                launch_template_name=template_name,
+                overrides=overrides,
+                capacity_type=capacity_type,
+                quantity=quantity - len(result.instance_ids),
+                tags=merge_tags(self.cluster_name, "", dict(provider.tags)),
             )
+            if launch_id:
+                request.client_token = derive_client_token(
+                    "CreateFleet",
+                    self.cluster_name,
+                    launch_id,
+                    str(fleet_call_index),
+                    request.idempotency_payload(),
+                )
+            fleet_call_index += 1
+            fleet = self.api.create_fleet(request)
             self._record_unavailable(fleet, capacity_type)
             result.instance_ids.extend(fleet.instance_ids)
             result.errors.extend(fleet.errors)

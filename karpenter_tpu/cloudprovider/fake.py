@@ -9,6 +9,7 @@ is configured.
 
 from __future__ import annotations
 
+import copy
 import itertools
 import threading
 import time
@@ -17,12 +18,14 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.provisioner import Constraints, Provisioner
 from karpenter_tpu.cloudprovider import (
+    CloudInstance,
     CloudProvider,
     InstanceType,
     InsufficientCapacityError,
     NodeSpec,
     Offering,
 )
+from karpenter_tpu.utils.crashpoints import crashpoint
 
 ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
 
@@ -130,6 +133,16 @@ class FakeCloudProvider(CloudProvider):
         self.clock = clock
         self.create_calls: List[Tuple[Constraints, List[str], int]] = []
         self.deleted_nodes: List[str] = []
+        # Crash-consistency surfaces: every live instance this cloud is
+        # "billing" for (provider_id -> CloudInstance), the NodeSpecs each
+        # launch_id bought (replayed on a re-issued launch so a restarted
+        # controller ADOPTS instead of re-buying), and a per-call log of
+        # (launch_id, quantity, adopted, launched) — the ClientToken
+        # analogue the crash battletest asserts determinism on.
+        self.instances: Dict[str, CloudInstance] = {}
+        self.terminated_instances: List[str] = []
+        self._launches: Dict[str, List[NodeSpec]] = {}
+        self.launch_log: List[Dict] = []
         # (instance_type, zone, capacity_type) triples that fail with ICE
         # (ref: aws/fake/ec2api.go InsufficientCapacityPools:54).
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
@@ -186,6 +199,97 @@ class FakeCloudProvider(CloudProvider):
             )
         return out
 
+    def _adopt_prior_launch(
+        self, launch_id: Optional[str], quantity: int
+    ) -> List[NodeSpec]:
+        """Idempotent re-issue (a restarted controller replaying the same
+        batch): instances the first attempt already bought are ADOPTED —
+        re-delivered through the callback with their original NodeSpec —
+        and only the shortfall is purchased. Instances terminated since
+        (e.g. GC'd) are dropped from the replay and re-bought."""
+        if launch_id is None:
+            return []
+        with self._lock:
+            prior = self._launches.get(launch_id, [])
+            # Deep copies: the registration path mutates the NodeSpec it
+            # receives, and the stored record must stay pristine (like a
+            # fresh DescribeInstances conversion would be).
+            return [
+                copy.deepcopy(node) for node in prior
+                if node.provider_id in self.instances
+            ][:quantity]
+
+    @staticmethod
+    def _rank_candidates(
+        instance_types, pool_options, allowed_zones, allowed_capacity
+    ) -> List[Tuple]:
+        """(sort_key, instance_type, offering) rows honoring constraints —
+        pinned price-ranked pools in priority order when given, else
+        lowest-price-first across offered types (the fleet-API behavior the
+        reference delegates to EC2)."""
+        candidates: List[Tuple] = []
+        if pool_options:
+            for rank, pool in enumerate(pool_options):
+                if not allowed_zones.contains(pool.zone):
+                    continue
+                for offering in pool.instance_type.offerings:
+                    if offering.zone != pool.zone:
+                        continue
+                    if not allowed_capacity.contains(offering.capacity_type):
+                        continue
+                    candidates.append((rank, pool.instance_type, offering))
+        else:
+            for it in instance_types:
+                for offering in it.offerings:
+                    if not allowed_zones.contains(offering.zone):
+                        continue
+                    if not allowed_capacity.contains(offering.capacity_type):
+                        continue
+                    candidates.append((offering.price, it, offering))
+        candidates.sort(key=lambda c: c[0])
+        return candidates
+
+    def _buy(self, it: InstanceType, offering: Offering, launch_id) -> NodeSpec:
+        """Commit one purchase: mint the instance + NodeSpec and record both.
+        The purchase is committed HERE — before any callback runs — exactly
+        like CreateFleet returning instance ids: a crash between this point
+        and node registration leaks the instance until the GC reaps it or a
+        restart adopts it."""
+        sequence = next(_node_counter)
+        instance_id = f"fi-{sequence:08d}"
+        # Unique per instance (like aws:///zone/id), so the leaked-
+        # capacity GC can join instances against Nodes.
+        provider_id = f"fake:///{offering.zone}/{instance_id}"
+        node = NodeSpec(
+            name=f"fake-node-{sequence}",
+            labels={
+                wellknown.INSTANCE_TYPE_LABEL: it.name,
+                wellknown.ZONE_LABEL: offering.zone,
+                wellknown.CAPACITY_TYPE_LABEL: offering.capacity_type,
+                wellknown.ARCH_LABEL: it.architecture,
+                wellknown.OS_LABEL: sorted(it.operating_systems)[0],
+            },
+            capacity=dict(it.capacity),
+            instance_type=it.name,
+            zone=offering.zone,
+            capacity_type=offering.capacity_type,
+            provider_id=provider_id,
+        )
+        with self._lock:
+            self.instances[provider_id] = CloudInstance(
+                instance_id=instance_id,
+                provider_id=provider_id,
+                instance_type=it.name,
+                zone=offering.zone,
+                capacity_type=offering.capacity_type,
+                launched_at=self._now(),
+            )
+            if launch_id is not None:
+                self._launches.setdefault(launch_id, []).append(
+                    copy.deepcopy(node)
+                )
+        return node
+
     def create(
         self,
         constraints: Constraints,
@@ -193,65 +297,32 @@ class FakeCloudProvider(CloudProvider):
         quantity: int,
         callback: Callable[[NodeSpec], None],
         pool_options: Optional[Sequence] = None,
+        launch_id: Optional[str] = None,
     ) -> List[Exception]:
         self.create_calls.append(
             (constraints, [it.name for it in instance_types], quantity)
         )
+        adopted = self._adopt_prior_launch(launch_id, quantity)
         errors: List[Exception] = []
+        launched_nodes: List[NodeSpec] = []
         requirements = constraints.effective_requirements()
         allowed_zones = requirements.allowed(wellknown.ZONE_LABEL)
         allowed_capacity = requirements.allowed(wellknown.CAPACITY_TYPE_LABEL)
-        for _ in range(quantity):
+        # Loop-invariant: candidates depend only on the call's inputs (ICE
+        # feedback is checked per pool below, against the live set).
+        candidates = self._rank_candidates(
+            instance_types, pool_options, allowed_zones, allowed_capacity
+        )
+        for _ in range(quantity - len(adopted)):
             launched = False
             last_error: Optional[Exception] = None
-            if pool_options:
-                # Pinned price-ranked pools: walk them in priority order,
-                # honoring constraints and the pool's own (type, zone).
-                candidates = []
-                for rank, pool in enumerate(pool_options):
-                    if not allowed_zones.contains(pool.zone):
-                        continue
-                    for offering in pool.instance_type.offerings:
-                        if offering.zone != pool.zone:
-                            continue
-                        if not allowed_capacity.contains(offering.capacity_type):
-                            continue
-                        candidates.append((rank, pool.instance_type, offering))
-            else:
-                # Lowest-price-first across offered types, honoring
-                # constraints — the fleet-API behavior the reference
-                # delegates to EC2.
-                candidates = []
-                for it in instance_types:
-                    for offering in it.offerings:
-                        if not allowed_zones.contains(offering.zone):
-                            continue
-                        if not allowed_capacity.contains(offering.capacity_type):
-                            continue
-                        candidates.append((offering.price, it, offering))
-            candidates.sort(key=lambda c: c[0])
             for _, it, offering in candidates:
                 pool = (it.name, offering.zone, offering.capacity_type)
                 if pool in self.insufficient_capacity_pools:
                     last_error = InsufficientCapacityError(*pool)
                     self.cache_unavailable(*pool)
                     continue
-                node = NodeSpec(
-                    name=f"fake-node-{next(_node_counter)}",
-                    labels={
-                        wellknown.INSTANCE_TYPE_LABEL: it.name,
-                        wellknown.ZONE_LABEL: offering.zone,
-                        wellknown.CAPACITY_TYPE_LABEL: offering.capacity_type,
-                        wellknown.ARCH_LABEL: it.architecture,
-                        wellknown.OS_LABEL: sorted(it.operating_systems)[0],
-                    },
-                    capacity=dict(it.capacity),
-                    instance_type=it.name,
-                    zone=offering.zone,
-                    capacity_type=offering.capacity_type,
-                    provider_id=f"fake:///{it.name}/{offering.zone}",
-                )
-                callback(node)
+                launched_nodes.append(self._buy(it, offering, launch_id))
                 launched = True
                 break
             if not launched:
@@ -259,10 +330,35 @@ class FakeCloudProvider(CloudProvider):
                     last_error
                     or RuntimeError("no offering satisfies constraints")
                 )
+        self.launch_log.append(
+            {
+                "launch_id": launch_id,
+                "quantity": quantity,
+                "adopted": [n.provider_id for n in adopted],
+                "launched": [n.provider_id for n in launched_nodes],
+            }
+        )
+        # The capacity is bought; the node objects don't exist yet. This is
+        # the canonical leak window.
+        crashpoint("cloud.after-create-fleet")
+        for node in adopted + launched_nodes:
+            callback(node)
         return errors
 
     def delete(self, node: NodeSpec) -> None:
         self.deleted_nodes.append(node.name)
+        with self._lock:
+            self.instances.pop(node.provider_id, None)
+
+    def list_instances(self) -> List[CloudInstance]:
+        with self._lock:
+            return list(self.instances.values())
+
+    def terminate_instance(self, instance: CloudInstance) -> None:
+        with self._lock:
+            removed = self.instances.pop(instance.provider_id, None)
+            if removed is not None:
+                self.terminated_instances.append(instance.instance_id)
 
     def default(self, provisioner: Provisioner) -> None:
         """Default capacity-type to on-demand if unconstrained

@@ -27,6 +27,14 @@ class NotFoundError(KeyError):
     pass
 
 
+class AlreadyExistsError(Exception):
+    """In-memory analogue of the apiserver's 409 AlreadyExists. Carries
+    `status` so callers that branch on coded apiserver errors (e.g. the
+    provisioning adopt-on-409 path) behave identically on both backends."""
+
+    status = 409
+
+
 class Cluster:
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()
@@ -152,6 +160,22 @@ class Cluster:
     # --- nodes -------------------------------------------------------------
 
     def create_node(self, node: NodeSpec) -> NodeSpec:
+        """Strict create, like the apiserver: a duplicate name is a 409, not
+        a silent overwrite — the provisioning adopt-on-409 path depends on
+        creates failing loudly. Remote-sourced state (watch events) goes
+        through `apply_node` instead."""
+        with self._lock:
+            if node.name in self._nodes:
+                raise AlreadyExistsError(f"node {node.name} already exists")
+            if not node.created_at:
+                node.created_at = self.clock.now()
+            self._nodes[node.name] = node
+        self._notify("node", node)
+        return node
+
+    def apply_node(self, node: NodeSpec) -> NodeSpec:
+        """Upsert from an authoritative source (the kubeapi watch pump, a
+        write-through whose create the real apiserver already admitted)."""
         with self._lock:
             if not node.created_at:
                 node.created_at = self.clock.now()

@@ -10,6 +10,7 @@ schedules, solves, enforces limits, launches capacity, and binds pods.
 from __future__ import annotations
 
 import copy
+import hashlib
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -27,6 +28,7 @@ from karpenter_tpu.controllers.scheduling import Scheduler
 from karpenter_tpu.models.solver import GreedySolver, Solver
 from karpenter_tpu.ops.ffd import PackResult
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.crashpoints import any_armed, crashpoint
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.tracing import TRACER
 
@@ -304,7 +306,45 @@ class ProvisionerWorker:
         except Exception:
             return False
 
+    @staticmethod
+    def _launch_identity(provisioner_name: str, packing) -> str:
+        """Stable identity of one logical launch, derived from the batch
+        CONTENT: (provisioner, node count, the sorted uids of every pod the
+        packing serves, and WHAT is being bought — the instance-type options
+        and any pinned pool rows). A controller that crashes after the fleet
+        call and re-solves the same still-unbound pods reproduces the same
+        packing and therefore the same identity — the cloud provider turns
+        that into a deterministic idempotency token (EC2 ClientToken) and
+        adopts the instances the first attempt bought instead of buying
+        twice. Pods that DID get bound before the crash drop out of the
+        re-batch, changing the identity, so partially-applied launches never
+        alias fresh ones. Including the purchase content guards the other
+        aliasing direction: a re-solve that picks DIFFERENT pools (blackout
+        caches are empty after a restart, catalogs drift) mints a fresh
+        token and buys fresh capacity rather than replaying a token against
+        mismatched parameters (EC2 would reject the call with
+        IdempotentParameterMismatch); the first attempt's orphan is the
+        leaked-capacity GC's job."""
+        pod_uids = sorted(
+            pod.uid or f"{pod.namespace}/{pod.name}" for pod in packing.pods
+        )
+        type_names = sorted(t.name for t in packing.instance_type_options)
+        pools = [
+            f"{pool.instance_type.name}/{pool.zone}/{pool.priority}"
+            for pool in (packing.pool_options or [])
+        ]
+        payload = "|".join(
+            [provisioner_name, str(packing.node_quantity)]
+            + pod_uids
+            + ["types"]
+            + type_names
+            + ["pools"]
+            + pools
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
     def _launch(self, constraints, result: PackResult, stats: ProvisionStats):
+        crashpoint("provision.before-launch")
         for packing in result.packings:
             # Re-GET the provisioner before every launch: abort if it was
             # deleted mid-pass, and enforce limits against fresh status
@@ -332,6 +372,7 @@ class ProvisionerWorker:
                 packing.node_quantity,
                 bind_callback,
                 pool_options=packing.pool_options,
+                launch_id=self._launch_identity(self.provisioner.name, packing),
             )
             stats.launch_errors.extend(errors)
 
@@ -354,11 +395,24 @@ class ProvisionerWorker:
         ]
         if wellknown.TERMINATION_FINALIZER not in node.finalizers:
             node.finalizers.append(wellknown.TERMINATION_FINALIZER)
-        self.cluster.create_node(node)
+        crashpoint("provision.before-register")
+        try:
+            self.cluster.create_node(node)
+        except Exception as error:  # noqa: BLE001 — coded errors only
+            if getattr(error, "status", None) != 409:
+                raise
+            # AlreadyExists: a restarted controller re-registering a node a
+            # pre-crash pass already created (the cloud provider adopted the
+            # instance and replayed the same NodeSpec). The object is the
+            # durable record — proceed to bind against it.
+            klog.named("provisioning").info(
+                "node %s already registered; adopting", node.name
+            )
         # Bind every pod concurrently; a failed bind is logged, not fatal
         # (ref: provisioner.go:239-247 counts successes and moves on — the
         # unbound pod stays unschedulable and retries through selection).
         def bind(pod: PodSpec) -> None:
+            crashpoint("provision.mid-bind")
             try:
                 self.cluster.bind_pod(pod, node)
             except Exception as error:  # noqa: BLE001
@@ -375,9 +429,14 @@ class ProvisionerWorker:
                     "failed to bind %s/%s to %s", pod.namespace, pod.name, node.name
                 )
 
-        if len(pods) <= 1:
+        # Serial path for singleton binds AND whenever a crash test is armed:
+        # a mid-bind kill must leave the deterministic minimal surviving
+        # state (pods before the crash index bound, none after), not
+        # whatever sibling binds the executor happened to finish first.
+        if len(pods) <= 1 or any_armed():
             for pod in pods:
                 bind(pod)
+            crashpoint("provision.after-bind")
             return
         futures = []
         for index, pod in enumerate(pods):
@@ -394,6 +453,7 @@ class ProvisionerWorker:
                 break
         for future in futures:
             future.result()
+        crashpoint("provision.after-bind")
 
 
 class ProvisioningController:

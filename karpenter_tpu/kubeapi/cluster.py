@@ -286,7 +286,7 @@ class ApiServerCluster(Cluster):
             node = convert.node_from_kube(obj)
             existing = super().try_get_node(node.name)
             if existing is None or node.deletion_timestamp is None:
-                super().create_node(node)
+                super().apply_node(node)
             else:
                 # Deletion flows through the finalizer protocol locally too.
                 existing.deletion_timestamp = node.deletion_timestamp
@@ -427,9 +427,13 @@ class ApiServerCluster(Cluster):
     def create_node(self, node: NodeSpec) -> NodeSpec:
         if not node.created_at:
             node.created_at = self.clock.now()
+        # The apiserver is the strictness authority here (duplicate names
+        # come back as ApiError 409 from the create); the local cache update
+        # is an upsert so a watch event racing our own write can't trip the
+        # in-memory duplicate check.
         created = self.api.create(NODES, convert.node_to_kube(node))
         self._record_rv("node", created)
-        return super().create_node(node)
+        return super().apply_node(node)
 
     def update_node(self, node: NodeSpec) -> None:
         # PATCH (merge) only the fields controllers own; a full PUT would

@@ -19,6 +19,7 @@ from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.metrics import MetricsController, POLL_SECONDS
 from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.instancegc import InstanceGcController
 from karpenter_tpu.controllers.podgc import PodGcController
 from karpenter_tpu.controllers.provisioning import (
     BATCH_IDLE_SECONDS,
@@ -417,6 +418,7 @@ class Manager:
         self.counter = CounterController(cluster)
         self.metrics = MetricsController(cluster)
         self.podgc = PodGcController(cluster)
+        self.instancegc = InstanceGcController(cluster, cloud)
         self.ready = threading.Event()
         # Set once the solver's compile debt is paid (immediately for host
         # solvers). Gates /readyz AND the batch loop: a batch window that
@@ -471,6 +473,12 @@ class Manager:
             # a periodic self-requeuing sweep, like the metrics poll.
             "podgc": ReconcileLoop(
                 "podgc", self.podgc.reconcile, concurrency=1
+            ),
+            # Leaked-capacity reaper: periodic self-requeuing sweep
+            # reconciling provider instances (by ownership tag) against
+            # Nodes — the money-side analogue of podgc.
+            "instancegc": ReconcileLoop(
+                "instancegc", self.instancegc.reconcile, concurrency=1
             ),
         }
 
@@ -552,6 +560,7 @@ class Manager:
         for node in self.cluster.list_nodes():
             self.loops["node"].enqueue(node.name)
         self.loops["podgc"].enqueue("sweep")
+        self.loops["instancegc"].enqueue("sweep")
         if getattr(self.solver, "needs_device_warmup", False):
             from karpenter_tpu.utils import backend_health
 

@@ -52,6 +52,15 @@ _RPC_HISTOGRAM = REGISTRY.histogram(
     labels=("outcome",),
 )
 
+# Every blackout arming is a sidecar outage window the fleet should see
+# climbing BEFORE operators notice solves running host-side (the ICE-cache
+# observability gap, closed): labeled by which failure shape armed it.
+BLACKOUT_TOTAL = REGISTRY.counter(
+    "remote_solver_blackout_total",
+    "Sidecar endpoint blackouts armed, by failure shape",
+    ["reason"],
+)
+
 
 class RemoteSolver(Solver):
     def __init__(
@@ -177,6 +186,7 @@ class RemoteSolver(Solver):
         if responses is None or len(responses) != len(items):
             _RPC_HISTOGRAM.observe(self.clock() - start, "error")
             self._blackout_until = self.clock() + self.blackout_s
+            BLACKOUT_TOTAL.inc("stream")
             log.warning(
                 "sidecar %s stream failed (%s); host fallback for %.0fs",
                 self.endpoint,
@@ -193,6 +203,7 @@ class RemoteSolver(Solver):
         # an RPC failure so the next passes don't repeat the doomed trip.
         if responses and all(r.solver == "error" for r in responses):
             self._blackout_until = self.clock() + self.blackout_s
+            BLACKOUT_TOTAL.inc("stream_poisoned")
             log.warning(
                 "sidecar %s errored every stream item; host fallback for %.0fs",
                 self.endpoint,
@@ -237,6 +248,7 @@ class RemoteSolver(Solver):
         if response is None:
             _RPC_HISTOGRAM.observe(self.clock() - start, "error")
             self._blackout_until = self.clock() + self.blackout_s
+            BLACKOUT_TOTAL.inc("unary")
             log.warning(
                 "sidecar %s unavailable (%s); host greedy for %.0fs",
                 self.endpoint,

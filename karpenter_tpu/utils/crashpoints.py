@@ -1,0 +1,136 @@
+"""Named crash-injection sites for crash-consistency testing.
+
+The launch→register→bind pipeline buys capacity at one boundary and records
+it at another; a controller that dies between the two must converge after a
+restart without leaking instances or double-binding pods. That property is
+only trustworthy if it is *executed*, so the pipeline threads named
+`crashpoint(...)` sites through its commit points and the crash battletest
+(tests/test_crash_consistency.py, `make crash-smoke`) arms each one in turn,
+"kills" the controller there, restarts it, and asserts convergence.
+
+Design notes:
+
+- `SimulatedCrash` subclasses BaseException, NOT Exception. The pipeline is
+  full of deliberate `except Exception` recovery (launch errors become
+  per-node error lists, reconcile loops log-and-requeue); a *crash* must
+  punch through all of it exactly like `os._exit` would, and be caught only
+  by the test harness playing the role of the supervisor.
+- Sites are zero-cost when disarmed: one dict read, no lock on the hot path
+  (the armed map is only mutated from tests).
+- `action="exit"` hard-kills the process (for subprocess-based harnesses);
+  the default `action="raise"` stays in-process so a test can catch the
+  crash and "restart" by building fresh controllers over the surviving
+  store — the same state a real restart would observe.
+- `at=N` fires on the Nth passage through the site (1-based), so e.g.
+  `mid-bind` can let the first pod bind and kill the controller before the
+  second.
+
+Site inventory (see docs/design/crash-consistency.md):
+
+- ``provision.before-launch``    batch drained, nothing bought yet
+- ``cloud.after-create-fleet``   capacity bought, no callback/node yet
+- ``provision.before-register``  node object about to be created
+- ``provision.mid-bind``         fires per pod bind (arm with at=N)
+- ``provision.after-bind``       node registered + pods bound, stats pending
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+# The canonical site names, asserted by the lint in the crash battletest so
+# the matrix can't silently drift from the instrumented code.
+SITES = (
+    "provision.before-launch",
+    "cloud.after-create-fleet",
+    "provision.before-register",
+    "provision.mid-bind",
+    "provision.after-bind",
+)
+
+
+class SimulatedCrash(BaseException):
+    """The controller process 'died' at a named site. BaseException so no
+    recovery path in the pipeline can swallow it (see module docstring)."""
+
+    def __init__(self, site: str):
+        super().__init__(f"simulated crash at {site}")
+        self.site = site
+
+
+@dataclass
+class _Arm:
+    action: str = "raise"  # "raise" | "exit"
+    at: int = 1  # fire on the Nth passage (1-based)
+    hits: int = 0  # passages so far while armed
+
+
+_lock = threading.Lock()
+_armed: Dict[str, _Arm] = {}
+_passages: Dict[str, int] = {}  # every passage ever, armed or not
+
+
+def crashpoint(name: str) -> None:
+    """A named injection site. No-op unless a test armed `name`."""
+    # Lock-free fast path: dict reads are GIL-atomic and the armed map is
+    # only written from tests, so production passes cost one lookup.
+    if not _armed:
+        if _passages:
+            _count_passage(name)
+        return
+    _count_passage(name)
+    with _lock:
+        arm = _armed.get(name)
+        if arm is None:
+            return
+        arm.hits += 1
+        if arm.hits < arm.at:
+            return
+        del _armed[name]  # one-shot: the process only dies once
+    if arm.action == "exit":
+        os._exit(86)
+    raise SimulatedCrash(name)
+
+
+def _count_passage(name: str) -> None:
+    with _lock:
+        _passages[name] = _passages.get(name, 0) + 1
+
+
+def arm(name: str, action: str = "raise", at: int = 1) -> None:
+    """Arm `name` to fire on its `at`-th passage. One-shot."""
+    if action not in ("raise", "exit"):
+        raise ValueError(f"unknown crash action {action!r}")
+    with _lock:
+        _armed[name] = _Arm(action=action, at=at)
+        _passages.setdefault(name, 0)
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
+        _passages.clear()
+
+
+def passages(name: str) -> int:
+    """How many times `name` was crossed since passage counting started
+    (counting starts at the first arm() and stops at disarm_all())."""
+    with _lock:
+        return _passages.get(name, 0)
+
+
+def armed() -> List[str]:
+    with _lock:
+        return sorted(_armed)
+
+
+def any_armed() -> bool:
+    """Lock-free (same GIL-atomicity argument as the crashpoint fast path):
+    lets instrumented code pick a deterministic serial path while a crash
+    test is armed — e.g. bind fan-out, where a kill mid-fan-out would leave
+    whichever sibling binds the pool happened to finish, not a reproducible
+    minimal state."""
+    return bool(_armed)

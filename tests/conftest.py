@@ -10,9 +10,24 @@ selecting cpu via env alone then hangs in backend init. So: update the already
 
 import os
 
+import pytest
+
 from karpenter_tpu.utils.backend_health import force_cpu_backend
 
 force_cpu_backend(host_devices=8)
+
+
+@pytest.fixture(autouse=True)
+def _crashpoints_disarmed():
+    """No crashpoint survives a test (tests/test_crash_consistency.py and
+    the parity suite's apiserver re-run arm them): an armed site leaking
+    across tests would kill an unrelated provision pass, and a non-empty
+    passage counter keeps the fast path on the lock."""
+    from karpenter_tpu.utils import crashpoints
+
+    crashpoints.disarm_all()
+    yield
+    crashpoints.disarm_all()
 
 
 def pytest_collection_modifyitems(config, items):

@@ -14,6 +14,7 @@ from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.instancegc import InstanceGcController
 from karpenter_tpu.controllers.metrics import MetricsController
 from karpenter_tpu.controllers.node import NodeController
 from karpenter_tpu.controllers.provisioning import ProvisioningController
@@ -77,6 +78,7 @@ class Harness:
         self.node = NodeController(self.cluster)
         self.counter = CounterController(self.cluster)
         self.metrics = MetricsController(self.cluster)
+        self.instancegc = InstanceGcController(self.cluster, self.cloud)
 
     def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:
         self.cluster.apply_provisioner(provisioner)

@@ -640,3 +640,134 @@ class TestUrllibTransportOverRealSockets:
         with pytest.raises(ApiError) as err:
             api.describe_launch_template("missing-template")
         assert is_not_found(err.value)
+
+
+class TestRestartIdempotency:
+    """Crash-consistent launches (ISSUE 2): ClientTokens derive from the
+    logical call's content, so a RESTARTED controller re-issuing the same
+    call is a server-side no-op — strictly stronger than the per-call retry
+    reuse TestRetry covers."""
+
+    _OK_LAUNCH_TEMPLATE = HttpResponse(
+        200,
+        b'<CreateLaunchTemplateResponse xmlns="http://ec2.amazonaws.com/doc/'
+        b'2016-11-15/"><launchTemplate>'
+        b"<launchTemplateName>karpenter-lt</launchTemplateName>"
+        b"<launchTemplateId>lt-0abc</launchTemplateId>"
+        b"</launchTemplate></CreateLaunchTemplateResponse>",
+    )
+
+    def _template(self):
+        from karpenter_tpu.cloudprovider.ec2.api import LaunchTemplate
+
+        return LaunchTemplate(name="karpenter-lt", image_id="ami-1")
+
+    def test_create_launch_template_retry_reuses_one_client_token(self):
+        """Regression for the satellite: a retried CreateLaunchTemplate must
+        re-send the IDENTICAL token (one body per logical call), matching
+        the CreateFleet contract."""
+        api = recorded_api(
+            HttpResponse(500, b""),
+            self._OK_LAUNCH_TEMPLATE,
+            retry_policy=RetryPolicy(sleep=lambda _s: None),
+        )
+        api.create_launch_template(self._template())
+        tokens = [
+            _params(body).get("ClientToken")
+            for _m, _u, _h, body in api.transport.sent
+        ]
+        assert len(tokens) == 2 and tokens[0] == tokens[1] and tokens[0]
+
+    def test_create_launch_template_token_survives_process_restart(self):
+        """Two independent api instances (a controller before and after a
+        crash) ensuring the same template derive the SAME token, so the
+        second create is a server-side no-op instead of AlreadyExists."""
+        tokens = []
+        for _ in range(2):
+            api = recorded_api(self._OK_LAUNCH_TEMPLATE)
+            api.create_launch_template(self._template())
+            tokens.append(_params(api.transport.sent[0][3])["ClientToken"])
+        assert tokens[0] == tokens[1]
+        # ...and a DIFFERENT template content derives a different token.
+        from karpenter_tpu.cloudprovider.ec2.api import LaunchTemplate
+
+        api = recorded_api(self._OK_LAUNCH_TEMPLATE)
+        other = LaunchTemplate(name="karpenter-lt", image_id="ami-2")
+        api.create_launch_template(other)
+        assert _params(api.transport.sent[0][3])["ClientToken"] != tokens[0]
+
+    def test_create_fleet_forwards_caller_token_verbatim(self):
+        ok_fleet = HttpResponse(
+            200,
+            b'<CreateFleetResponse xmlns="http://ec2.amazonaws.com/doc/'
+            b'2016-11-15/"><fleetInstanceSet/><errorSet/>'
+            b"</CreateFleetResponse>",
+        )
+        api = recorded_api(ok_fleet)
+        api.create_fleet(
+            FleetRequest(
+                launch_template_name="lt",
+                capacity_type="on-demand",
+                quantity=1,
+                overrides=[],
+                client_token="ktpu-deadbeef",
+            )
+        )
+        assert (
+            _params(api.transport.sent[0][3])["ClientToken"] == "ktpu-deadbeef"
+        )
+
+    def test_derive_client_token_is_stable_and_bounded(self):
+        from karpenter_tpu.cloudprovider.ec2.aws_http import derive_client_token
+
+        token = derive_client_token("CreateFleet", "cluster", "batch", "0")
+        assert token == derive_client_token("CreateFleet", "cluster", "batch", "0")
+        assert token != derive_client_token("CreateFleet", "cluster", "batch", "1")
+        assert len(token) <= 64  # the EC2 ClientToken budget
+
+    def test_describe_instances_by_tag_encodes_filters_and_parses_instance(self):
+        api = recorded_api(
+            HttpResponse(
+                200,
+                b'<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/'
+                b'doc/2016-11-15/"><reservationSet><item><instancesSet><item>'
+                b"<instanceId>i-leak</instanceId>"
+                b"<instanceType>m5.large</instanceType>"
+                b"<placement><availabilityZone>us-test-1a</availabilityZone>"
+                b"</placement>"
+                b"<launchTime>2026-08-02T10:00:00Z</launchTime>"
+                b"<tagSet><item><key>karpenter.tpu/cluster/c</key>"
+                b"<value>owned</value></item></tagSet>"
+                b"</item></instancesSet></item></reservationSet>"
+                b"</DescribeInstancesResponse>",
+            )
+        )
+        instances = api.describe_instances_by_tag(
+            {"karpenter.tpu/cluster/c": "owned"}
+        )
+        params = _params(api.transport.sent[0][3])
+        assert params["Filter.1.Name"] == "tag:karpenter.tpu/cluster/c"
+        assert params["Filter.1.Value.1"] == "owned"
+        (instance,) = instances
+        assert instance.instance_id == "i-leak"
+        assert instance.tags == {"karpenter.tpu/cluster/c": "owned"}
+        assert instance.launched_at > 0
+
+    def test_retries_are_counted_by_action_and_code(self):
+        from karpenter_tpu.cloudprovider.ec2.aws_http import AWS_RETRY_TOTAL
+
+        before = AWS_RETRY_TOTAL.get("DescribeInstances", "HTTP500")
+        api = recorded_api(
+            HttpResponse(500, b"<html>internal"),
+            _OK_DESCRIBE,
+            retry_policy=RetryPolicy(sleep=lambda _s: None),
+        )
+        api.describe_instances(["i-1"])
+        assert AWS_RETRY_TOTAL.get("DescribeInstances", "HTTP500") - before == 1
+
+
+class TestCrashConsistentLaunchOverWire(_suite.TestCrashConsistentLaunch):
+    """The restart-idempotency + GC-listing scenarios through SigV4-signed
+    Query-API bytes: deterministic ClientTokens survive the wire, the fleet
+    replay honors them server-side, and the by-tag DescribeInstances sweep
+    round-trips tags for the ownership join."""

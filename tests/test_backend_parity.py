@@ -13,6 +13,7 @@ duration and closes the watch pumps the apiserver harnesses start.
 import pytest
 
 from tests import harness as harness_mod
+from tests import test_crash_consistency as crash
 from tests import test_node_lifecycle as lifecycle
 from tests import test_provisioning as provisioning
 from tests import test_scheduling as scheduling
@@ -96,4 +97,15 @@ class TestPreferentialFallbackOnApiserver(scheduling.TestPreferentialFallback):
 
 
 class TestWellKnownLabelsOnApiserver(scheduling.TestWellKnownLabels):
+    pass
+
+
+class TestCrashpointMatrixOnApiserver(crash.TestCrashpointMatrix):
+    """The crash battletest's 'fake apiserver' half: every kill→restart
+    convergence property must hold when the surviving state lives behind
+    the apiserver write-through (409-on-duplicate-create is the adoption
+    path's real-world shape)."""
+
+
+class TestInstanceGcOnApiserver(crash.TestInstanceGc):
     pass

@@ -1,0 +1,299 @@
+"""Crash-consistency battletest: the launch→register→bind pipeline must
+converge through a controller death at ANY commit point.
+
+For every named injection site (utils/crashpoints.py), a provision pass runs
+against the fake cluster + fake cloud provider, the "controller process" is
+killed at the site (SimulatedCrash is a BaseException, so no recovery path in
+the pipeline can swallow it), fresh controllers are built over the surviving
+state — exactly what a restarted process observes via the apiserver and
+DescribeInstances — and convergence is asserted:
+
+- every pending pod is bound exactly once, to a node that exists;
+- no duplicate nodes or provider ids;
+- zero instances left unmatched by a Node once the leaked-capacity GC's
+  grace window has elapsed (two sweeps: sighting + confirmation);
+- the pre- and post-crash launch attempts carry the SAME deterministic
+  launch identity (the EC2 ClientToken analogue), observed in the
+  FakeCloudProvider call log — a restarted controller ADOPTS the capacity
+  its predecessor bought instead of buying it twice.
+
+`make crash-smoke` runs this module under a hard timeout.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import pytest
+
+import karpenter_tpu
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.cloudprovider import CloudInstance
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.instancegc import (
+    INSTANCEGC_TERMINATED_TOTAL,
+    LAUNCH_GRACE_SECONDS,
+    InstanceGcController,
+)
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.utils import crashpoints
+from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+# Crashpoint isolation (disarm before/after every test) lives in
+# tests/conftest.py so the parity suite's apiserver-backed re-run of these
+# classes gets it too.
+
+
+def make_harness() -> Harness:
+    h = Harness()
+    h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    return h
+
+
+def crash_provision(h: Harness, *pods) -> str:
+    """Apply + select pods, run the workers, and return the site where the
+    armed crashpoint killed the pass."""
+    for pod in pods:
+        h.cluster.apply_pod(pod)
+        h.selection.reconcile(pod.namespace, pod.name)
+    with pytest.raises(SimulatedCrash) as crash:
+        for worker in h.provisioning.workers.values():
+            worker.provision()
+    return crash.value.site
+
+
+def restart(h: Harness) -> None:
+    """A controller-process restart: fresh controller objects over the
+    surviving cluster + cloud state, then the boot re-list routing every
+    still-pending pod back through selection, then one provision pass."""
+    h.provisioning = ProvisioningController(h.cluster, h.cloud, None)
+    h.selection = SelectionController(h.cluster, h.provisioning)
+    h.instancegc = InstanceGcController(h.cluster, h.cloud)
+    for provisioner in h.cluster.list_provisioners():
+        h.provisioning.reconcile(provisioner.name)
+    for pod in h.cluster.list_pods():
+        if pod.is_provisionable():
+            h.selection.reconcile(pod.namespace, pod.name)
+    for worker in h.provisioning.workers.values():
+        worker.provision()
+
+
+def run_gc_past_grace(h: Harness) -> None:
+    """Age every instance past the launch grace, then the two consecutive
+    sightings the GC requires before it terminates."""
+    h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+    h.instancegc.reconcile()
+    h.instancegc.reconcile()
+
+
+def assert_converged(h: Harness, pods) -> None:
+    for pod in pods:
+        live = h.cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} never bound"
+        assert h.cluster.try_get_node(live.node_name) is not None, (
+            f"{pod.name} bound to vanished node {live.node_name}"
+        )
+    nodes = h.cluster.list_nodes()
+    names = [node.name for node in nodes]
+    assert len(names) == len(set(names)), f"duplicate nodes: {sorted(names)}"
+    provider_ids = [node.provider_id for node in nodes]
+    assert len(provider_ids) == len(set(provider_ids)), (
+        f"two nodes share an instance: {sorted(provider_ids)}"
+    )
+    run_gc_past_grace(h)
+    leaked = set(h.cloud.instances) - {node.provider_id for node in nodes}
+    assert not leaked, f"instances with no Node after GC grace: {sorted(leaked)}"
+
+
+# Every named site, plus mid-bind at its second passage (first pod bound,
+# controller dies before the rest).
+MATRIX = [(site, 1) for site in crashpoints.SITES] + [("provision.mid-bind", 2)]
+
+
+class TestCrashpointMatrix:
+    @pytest.mark.parametrize(
+        "site,at", MATRIX, ids=[f"{s}@{a}" for s, a in MATRIX]
+    )
+    def test_kill_restart_converges(self, site, at):
+        h = make_harness()
+        pods = fixtures.pods(3)
+        crashpoints.arm(site, at=at)
+        assert crash_provision(h, *pods) == site
+        restart(h)
+        assert_converged(h, pods)
+
+    def test_restart_reuses_launch_identity_and_adopts(self):
+        """The acceptance assertion: the pre- and post-crash launch attempts
+        carry the SAME deterministic launch identity, and the second attempt
+        adopts what the first bought (server-side no-op, not a re-buy)."""
+        h = make_harness()
+        pods = fixtures.pods(2)
+        crashpoints.arm("cloud.after-create-fleet")
+        crash_provision(h, *pods)
+        assert len(h.cloud.instances) == 1  # bought...
+        assert h.cluster.list_nodes() == []  # ...but never registered
+        restart(h)
+        first, second = h.cloud.launch_log
+        assert first["launch_id"] == second["launch_id"] is not None
+        assert second["adopted"] == first["launched"]
+        assert second["launched"] == []  # adoption covered the shortfall
+        assert len(h.cloud.instances) == 1  # no double purchase
+        assert_converged(h, pods)
+
+    def test_bound_pods_change_the_launch_identity(self):
+        """Pods bound before the crash drop out of the re-batch: the re-issued
+        launch must NOT alias the partially-applied one — it gets a fresh
+        identity and fresh capacity for only the still-unbound pods."""
+        h = make_harness()
+        pods = fixtures.pods(2)
+        crashpoints.arm("provision.mid-bind", at=2)
+        crash_provision(h, *pods)
+        bound_before = [
+            p.name
+            for p in (h.cluster.get_pod(q.namespace, q.name) for q in pods)
+            if p.node_name is not None
+        ]
+        assert len(bound_before) == 1
+        restart(h)
+        identities = [entry["launch_id"] for entry in h.cloud.launch_log]
+        assert len(identities) == 2 and identities[0] != identities[1]
+        assert_converged(h, pods)
+
+    def test_crash_then_abandoned_pods_leak_is_reaped(self):
+        """The GC tentpole scenario: capacity bought, controller dies, and
+        the demand then vanishes (pods deleted) — nothing will ever adopt or
+        register the instance, so the GC must terminate it and count it."""
+        h = make_harness()
+        pod = fixtures.pod()
+        crashpoints.arm("cloud.after-create-fleet")
+        crash_provision(h, pod)
+        h.cluster.delete_pod(pod.namespace, pod.name)
+        assert len(h.cloud.instances) == 1
+        before = INSTANCEGC_TERMINATED_TOTAL.get()
+        # Within grace: untouched (a slow bootstrap must not be shot down).
+        h.instancegc.reconcile()
+        assert h.cloud.terminated_instances == []
+        h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+        h.instancegc.reconcile()  # first sighting: suspect only
+        assert h.cloud.terminated_instances == []
+        h.instancegc.reconcile()  # second consecutive sighting: reaped
+        assert len(h.cloud.terminated_instances) == 1
+        assert h.cloud.instances == {}
+        assert INSTANCEGC_TERMINATED_TOTAL.get() - before == 1
+
+
+class TestInstanceGc:
+    def test_instance_with_node_is_never_a_candidate(self):
+        h = make_harness()
+        pod = fixtures.pod()
+        h.provision(pod)
+        assert len(h.cloud.instances) == 1
+        run_gc_past_grace(h)
+        assert h.cloud.terminated_instances == []
+
+    def test_node_appearing_between_sightings_clears_the_suspect(self):
+        """A transient ordering window (instance listed before the Node
+        event landed) must not cost a live node its instance."""
+        h = make_harness()
+        pod = fixtures.pod()
+        crashpoints.arm("provision.before-register")
+        crash_provision(h, pod)
+        crashpoints.disarm_all()
+        h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+        h.instancegc.reconcile()  # first sighting
+        restart(h)  # the node registers between sweeps
+        h.instancegc.reconcile()
+        h.instancegc.reconcile()
+        assert h.cloud.terminated_instances == []
+
+    def test_unknown_launch_time_graces_from_first_sighting(self):
+        h = make_harness()
+        h.cloud.instances["fake:///z/fi-unknown"] = CloudInstance(
+            instance_id="fi-unknown",
+            provider_id="fake:///z/fi-unknown",
+            launched_at=0.0,  # provider couldn't report launchTime
+        )
+        h.instancegc.reconcile()  # first sighting anchors the grace clock
+        h.instancegc.reconcile()
+        assert h.cloud.terminated_instances == []  # grace not yet elapsed
+        h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+        h.instancegc.reconcile()
+        assert h.cloud.terminated_instances == ["fi-unknown"]
+
+    def test_terminate_failure_stays_suspect_and_retries(self):
+        class FlakyTerminate(FakeCloudProvider):
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.failures_left = 1
+
+            def terminate_instance(self, instance):
+                if self.failures_left:
+                    self.failures_left -= 1
+                    raise RuntimeError("api outage")
+                super().terminate_instance(instance)
+
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        h = Harness(clock=clock, cloud=FlakyTerminate(clock=clock))
+        h.apply_provisioner(
+            Provisioner(name="default", spec=ProvisionerSpec())
+        )
+        pod = fixtures.pod()
+        crashpoints.arm("cloud.after-create-fleet")
+        crash_provision(h, pod)
+        h.cluster.delete_pod(pod.namespace, pod.name)
+        h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+        h.instancegc.reconcile()  # sighting
+        h.instancegc.reconcile()  # terminate attempt -> fails, stays suspect
+        assert h.cloud.terminated_instances == []
+        h.instancegc.reconcile()  # very next sweep retries
+        assert len(h.cloud.terminated_instances) == 1
+
+
+class TestCrashpointFacility:
+    def test_disarmed_site_is_a_no_op(self):
+        crashpoints.crashpoint("provision.before-launch")  # must not raise
+
+    def test_armed_site_fires_once_then_disarms(self):
+        crashpoints.arm("provision.before-launch")
+        with pytest.raises(SimulatedCrash):
+            crashpoints.crashpoint("provision.before-launch")
+        crashpoints.crashpoint("provision.before-launch")  # already disarmed
+
+    def test_at_n_fires_on_nth_passage(self):
+        crashpoints.arm("provision.mid-bind", at=3)
+        crashpoints.crashpoint("provision.mid-bind")
+        crashpoints.crashpoint("provision.mid-bind")
+        with pytest.raises(SimulatedCrash):
+            crashpoints.crashpoint("provision.mid-bind")
+        assert crashpoints.passages("provision.mid-bind") == 3
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            crashpoints.arm("provision.mid-bind", action="segfault")
+
+    def test_simulated_crash_punches_through_except_exception(self):
+        """The pipeline's deliberate `except Exception` recovery must not be
+        able to swallow a crash — that is the whole point of the facility."""
+        assert not issubclass(SimulatedCrash, Exception)
+
+    def test_site_inventory_matches_instrumentation(self):
+        """The canonical SITES tuple and the literals actually threaded
+        through the pipeline may not drift apart — a site in the matrix that
+        no code crosses tests nothing."""
+        root = Path(karpenter_tpu.__file__).parent
+        found = set()
+        for path in root.rglob("*.py"):
+            if path.name == "crashpoints.py":
+                continue
+            found |= set(
+                re.findall(r'crashpoint\(\s*"([^"]+)"\s*\)', path.read_text())
+            )
+        assert found == set(crashpoints.SITES)

@@ -495,3 +495,195 @@ class TestPoolPinnedLaunch:
         assert [(o.instance_type, o.zone) for o in request.overrides] == [
             ("c5.large", "test-zone-1a")
         ]
+
+
+class TestCrashConsistentLaunch:
+    """Restart-safe launches (ISSUE 2): a `launch_id` flows down to
+    deterministic CreateFleet ClientTokens, a repeated token is a server-side
+    replay (adoption, not a second purchase), and the by-tag instance listing
+    gives the leaked-capacity GC its ground truth."""
+
+    def _small_types(self, provider, constraints):
+        return [
+            t
+            for t in provider.get_instance_types(constraints)
+            if t.name == "m5.large"
+        ]
+
+    def test_launch_id_produces_deterministic_client_token(self):
+        """The same logical launch re-issued (crashed controller restarting)
+        derives the SAME token — across provider instances, i.e. across
+        process restarts."""
+        provider_a, api_a, _ = make_provider()
+        provider_b, api_b, _ = make_provider()
+        constraints = constraints_with_blob()
+        for provider, api in ((provider_a, api_a), (provider_b, api_b)):
+            types = self._small_types(provider, constraints)
+            provider.create(
+                constraints, types, 1, lambda node: None, launch_id="batch-1"
+            )
+        token_a = api_a.calls["create_fleet"][-1].client_token
+        token_b = api_b.calls["create_fleet"][-1].client_token
+        assert token_a and token_a == token_b
+
+    def test_reissued_launch_adopts_instead_of_rebuying(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = self._small_types(provider, constraints)
+        first, second = [], []
+        provider.create(constraints, types, 1, first.append, launch_id="b")
+        provider.create(constraints, types, 1, second.append, launch_id="b")
+        assert len(api.instances) == 1  # one purchase, not two
+        assert [n.provider_id for n in first] == [
+            n.provider_id for n in second
+        ]
+        tokens = {r.client_token for r in api.calls["create_fleet"]}
+        assert len(tokens) == 1
+
+    def test_no_launch_id_stays_fresh_purchase(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = self._small_types(provider, constraints)
+        provider.create(constraints, types, 1, lambda node: None)
+        provider.create(constraints, types, 1, lambda node: None)
+        assert len(api.instances) == 2
+        # No launch_id -> no replayable identity: either no token at all
+        # (the in-memory fake) or a random one per call (the wire binding),
+        # never the SAME token twice.
+        tokens = [r.client_token for r in api.calls["create_fleet"]]
+        assert not tokens[0] or tokens[0] != tokens[1]
+
+    def test_terminated_replay_falls_through_to_fresh_launch(self):
+        """A stale token whose instances are gone (GC reaped them while the
+        controller was down) must not wedge the retry loop. EC2 keeps the
+        corpses describable and REPLAYS their ids under the original token,
+        so the recovery is client-side: filter dead states, then walk to
+        the next deterministic token generation and buy fresh."""
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = self._small_types(provider, constraints)
+        nodes = []
+        provider.create(constraints, types, 1, nodes.append, launch_id="b")
+        first_token = api.calls["create_fleet"][-1].client_token
+        api.terminate_instances(list(api.instances))
+        fresh = []
+        provider.create(constraints, types, 1, fresh.append, launch_id="b")
+        assert len(fresh) == 1
+        assert fresh[0].provider_id != nodes[0].provider_id
+        # The re-issue first replayed the original token (getting only the
+        # corpse back), then walked to generation 1 for the fresh purchase.
+        replay, fresh_buy = api.calls["create_fleet"][-2:]
+        assert replay.client_token == first_token
+        assert fresh_buy.client_token and fresh_buy.client_token != first_token
+        # Crashing and re-issuing AGAIN reproduces the same walk: the
+        # generation sequence is part of the deterministic identity.
+        again = []
+        provider.create(constraints, types, 1, again.append, launch_id="b")
+        assert [n.provider_id for n in again] == [n.provider_id for n in fresh]
+
+    def test_replay_adopts_only_live_instances(self):
+        """A mixed replay (some capacity since terminated) adopts the live
+        subset — partial fulfillment, never a Node backed by a corpse."""
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = self._small_types(provider, constraints)
+        nodes = []
+        provider.create(constraints, types, 2, nodes.append, launch_id="b")
+        assert len(nodes) == 2
+        from karpenter_tpu.cloudprovider.ec2.instances import parse_instance_id
+
+        dead_id = parse_instance_id(nodes[0].provider_id)
+        api.terminate_instances([dead_id])
+        adopted = []
+        provider.create(constraints, types, 2, adopted.append, launch_id="b")
+        assert [n.provider_id for n in adopted] == [nodes[1].provider_id]
+
+    def test_parameter_drift_mints_fresh_token_instead_of_mismatch(self):
+        """The token is bound to the full request content: a restart that
+        rebuilds different parameters for the same logical launch (blackout
+        cache emptied, catalogs drifted) must buy fresh under a NEW token —
+        reusing the old one would be rejected by EC2 as
+        IdempotentParameterMismatch and wedge the launch loop."""
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = self._small_types(provider, constraints)
+        provider.create(constraints, types, 1, lambda n: None, launch_id="b")
+        token_one = api.calls["create_fleet"][-1].client_token
+        # Same logical launch, drifted content (quantity here; override rows
+        # drift the same way): no ApiError, a distinct token, a fresh buy.
+        provider.create(constraints, types, 2, lambda n: None, launch_id="b")
+        token_two = api.calls["create_fleet"][-1].client_token
+        assert token_two and token_two != token_one
+        assert len(api.instances) == 3
+
+    def test_fake_rejects_reused_token_with_drifted_parameters(self):
+        """FakeEc2 faithfulness: EC2 rejects a reused ClientToken whose
+        request parameters changed — the guard that makes any future
+        token-derivation regression loud in tests."""
+        from karpenter_tpu.cloudprovider.ec2.api import (
+            FleetOverride,
+            FleetRequest,
+            LaunchTemplate,
+        )
+
+        api = make_api()
+        api.create_launch_template(LaunchTemplate(name="lt"))
+        override = FleetOverride(
+            instance_type="m5.large", subnet_id="subnet-test1",
+            zone="test-zone-1a",
+        )
+        request = FleetRequest(
+            launch_template_name="lt", overrides=[override],
+            capacity_type="on-demand", quantity=1, client_token="tok",
+        )
+        api.create_fleet(request)
+        drifted = FleetRequest(
+            launch_template_name="lt", overrides=[override],
+            capacity_type="on-demand", quantity=2, client_token="tok",
+        )
+        with pytest.raises(ApiError) as error:
+            api.create_fleet(drifted)
+        assert error.value.code == "IdempotentParameterMismatch"
+
+    def test_list_instances_reports_owned_capacity(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = self._small_types(provider, constraints)
+        nodes = []
+        provider.create(constraints, types, 1, nodes.append)
+        listed = provider.list_instances()
+        assert [i.provider_id for i in listed] == [nodes[0].provider_id]
+        assert listed[0].instance_type == "m5.large"
+        assert listed[0].capacity_type == "on-demand"
+
+    def test_list_instances_excludes_other_clusters(self):
+        """The by-tag sweep must only see instances THIS cluster owns —
+        terminating another cluster's capacity is the one failure mode worse
+        than leaking ours."""
+        from karpenter_tpu.cloudprovider.ec2.api import Instance
+
+        provider, api, _ = make_provider()
+        api.instances["i-foreign"] = Instance(
+            instance_id="i-foreign",
+            instance_type="m5.large",
+            zone="test-zone-1a",
+            tags={"karpenter.tpu/cluster/other-cluster": "owned"},
+        )
+        assert provider.list_instances() == []
+
+    def test_terminate_instance_tolerates_not_found(self):
+        from karpenter_tpu.cloudprovider import CloudInstance
+
+        provider, _, _ = make_provider()
+        provider.terminate_instance(
+            CloudInstance(instance_id="i-gone", provider_id="aws:///z/i-gone")
+        )  # raced normal termination: must not raise
+
+    def test_terminate_instance_removes_owned_capacity(self):
+        provider, api, _ = make_provider()
+        constraints = constraints_with_blob()
+        types = self._small_types(provider, constraints)
+        provider.create(constraints, types, 1, lambda node: None)
+        (listed,) = provider.list_instances()
+        provider.terminate_instance(listed)
+        assert provider.list_instances() == []

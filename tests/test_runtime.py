@@ -265,6 +265,9 @@ def _self_signed_cert(tmp_path):
     import datetime
     import ipaddress
 
+    pytest.importorskip(
+        "cryptography", reason="cryptography not installed (environmental)"
+    )
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

@@ -161,10 +161,14 @@ class TestFallback:
             clock=clock,
             fallback=CountingFallback(),
         )
+        from karpenter_tpu.solver_service.client import BLACKOUT_TOTAL
+
         pods = make_pods(10)
         types = make_instance_types(3)
+        armed_before = BLACKOUT_TOTAL.get("unary")
         client.solve(pods, types, constraints)  # RPC fails -> blackout set
         assert client._blackout_until == pytest.approx(clock() + 30.0)
+        assert BLACKOUT_TOTAL.get("unary") - armed_before == 1
         before = clock()
         client.solve(pods, types, constraints)  # inside blackout: no RPC wait
         assert clock() == before  # fake clock: a timed-out RPC would not tick it,

@@ -1,6 +1,8 @@
 """Utility-layer tests (ref: pkg/utils — functional/suite_test.go is the
 reference's analogue of plain unit coverage for the helper packages)."""
 
+import pytest
+
 from karpenter_tpu.utils.cache import TtlCache
 from karpenter_tpu.utils.clock import FakeClock
 
@@ -35,3 +37,87 @@ class TestTtlCache:
         for i in range(TtlCache.SWEEP_INTERVAL):
             cache.set(f"new-{i}", i)
         assert len(cache._entries) <= TtlCache.SWEEP_INTERVAL + 1
+
+
+class TestBackoffQueue:
+    """The eviction-queue retry semantics (utils/workqueue.BackoffQueue),
+    driven by the FakeClock: set-dedup holds across in-flight processing and
+    requeues, and per-item backoff grows exponentially to the 10s cap."""
+
+    def _queue(self):
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils.workqueue import BackoffQueue
+
+        clock = FakeClock()
+        return BackoffQueue(base_delay=0.1, max_delay=10.0, clock=clock), clock
+
+    def test_add_while_in_flight_is_deduped(self):
+        """An item being processed is still 'in the queue' for dedup: a
+        watch event re-adding it mid-process must not create a second entry
+        (it would be processed twice per drain forever)."""
+        q, _ = self._queue()
+        assert q.add("node-1")
+        re_adds = []
+
+        def fail_and_readd(item):
+            re_adds.append(q.add(item))  # in-flight re-add
+            return False
+
+        q.process(fail_and_readd)
+        assert re_adds == [False]
+        assert len(q) == 1  # requeued once by the failure, not twice
+        assert "node-1" in q
+
+    def test_backoff_doubles_then_caps_at_max_delay(self):
+        q, clock = self._queue()
+        q.add("node-1")
+        attempts = []
+
+        def failing(item):
+            attempts.append(clock.now())
+            return False
+
+        # Drive enough failures to saturate the cap: 0.1 * 2^(n-1) >= 10
+        # from the 8th failure on.
+        for _ in range(10):
+            q.process(failing)
+            clock.advance(10.0)  # always enough to come due again
+        delays = [b - a for a, b in zip(attempts, attempts[1:])]
+        assert delays[0] == pytest.approx(10.0)  # advance dominated 0.1
+        # Saturated: a sweep 9.99s after the 10th failure is NOT due...
+        q.process(failing)
+        count = len(attempts)
+        clock.advance(9.99)
+        q.process(failing)
+        assert len(attempts) == count  # skipped, still backing off
+        # ...and 10.0s after it, it is (the cap, not 0.1 * 2^10 = 102s).
+        clock.advance(0.02)
+        q.process(failing)
+        assert len(attempts) == count + 1
+
+    def test_dedup_holds_across_requeues_and_clears_on_success(self):
+        q, clock = self._queue()
+        assert q.add("node-1")
+        q.process(lambda item: False)  # fail -> requeued with backoff
+        assert not q.add("node-1")  # still deduped while backing off
+        assert len(q) == 1
+        clock.advance(1.0)
+        assert q.process(lambda item: True) == 1  # succeeds, leaves the set
+        assert len(q) == 0
+        assert q.add("node-1")  # a fresh add is accepted again
+
+    def test_success_resets_backoff_history(self):
+        q, clock = self._queue()
+        q.add("node-1")
+        for _ in range(5):  # build up failure history
+            q.process(lambda item: False)
+            clock.advance(10.0)
+        q.process(lambda item: True)
+        # Re-added after success: first failure backs off at BASE delay
+        # again, not where the old streak left off.
+        q.add("node-1")
+        q.process(lambda item: False)
+        calls = []
+        clock.advance(0.11)
+        q.process(lambda item: calls.append(item) or True)
+        assert calls == ["node-1"]

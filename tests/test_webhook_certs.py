@@ -10,6 +10,14 @@ import urllib.request
 
 import pytest
 
+# Cert generation (utils/certs.py) and these assertions both need the
+# cryptography package, which the minimal image may not carry — an
+# environmental gap, not a regression, so skip with a reason instead of
+# failing the suite.
+pytest.importorskip(
+    "cryptography", reason="cryptography not installed (environmental)"
+)
+
 from karpenter_tpu.utils.certs import (
     MUTATING_WEBHOOK_NAME,
     VALIDATING_WEBHOOK_NAME,

@@ -321,6 +321,7 @@ class WireFakeTransport(HttpTransport):
                     params["TargetCapacitySpecification.TotalTargetCapacity"]
                 ),
                 tags=tags,
+                client_token=params.get("ClientToken", ""),
             )
         )
         ids = "".join(f"<item>{i}</item>" for i in result.instance_ids)
@@ -344,12 +345,22 @@ class WireFakeTransport(HttpTransport):
         return self._ok("CreateFleet", inner)
 
     def _do_describe_instances(self, params) -> HttpResponse:
-        ids = []
+        filters = {}
         index = 1
-        while f"InstanceId.{index}" in params:
-            ids.append(params[f"InstanceId.{index}"])
+        while f"Filter.{index}.Name" in params:
+            name = params[f"Filter.{index}.Name"]
+            assert name.startswith("tag:")
+            filters[name[len("tag:"):]] = params[f"Filter.{index}.Value.1"]
             index += 1
-        instances = self.fake.describe_instances(ids)
+        if filters:
+            instances = self.fake.describe_instances_by_tag(filters)
+        else:
+            ids = []
+            index = 1
+            while f"InstanceId.{index}" in params:
+                ids.append(params[f"InstanceId.{index}"])
+                index += 1
+            instances = self.fake.describe_instances(ids)
         items = []
         for inst in instances:
             lifecycle = (
@@ -367,6 +378,13 @@ class WireFakeTransport(HttpTransport):
                 f"{lifecycle}"
                 f"<instanceState><code>16</code><name>{inst.state}</name>"
                 "</instanceState>"
+                "<tagSet>"
+                + "".join(
+                    f"<item><key>{escape(k)}</key><value>{escape(v)}</value>"
+                    "</item>"
+                    for k, v in sorted(inst.tags.items())
+                )
+                + "</tagSet>"
                 "</item></instancesSet></item>"
             )
         return self._paginate("DescribeInstances", params, "reservationSet", items)

@@ -1,0 +1,41 @@
+"""Crash-recovery smoke: the crashpoint battletest matrix under a hard cap.
+
+Runs tests/test_crash_consistency.py — every named injection site killed and
+restarted, convergence + leaked-capacity GC + launch-identity determinism
+asserted — in a subprocess, printing a per-site verdict line. `make
+crash-smoke` wraps this in a hard timeout (wired like degraded-smoke): if a
+crash path ever re-grows a wait on state that a restart cannot reconstruct,
+the target fails fast instead of wedging a driver run.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    start = time.time()
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_crash_consistency.py",
+            "-q",
+            "--tb=short",
+            "-p",
+            "no:cacheprovider",
+        ],
+        cwd=REPO,
+    )
+    elapsed = time.time() - start
+    verdict = "OK" if result.returncode == 0 else "FAIL"
+    print(f"crash-smoke: {verdict} (rc={result.returncode}) in {elapsed:.1f}s")
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
