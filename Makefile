@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test battletest degraded-smoke crash-smoke proto native bench clean
+.PHONY: test battletest degraded-smoke crash-smoke interruption-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -38,6 +38,24 @@ degraded-smoke:
 # re-grows a wait on unreconstructable state fails fast, not forever.
 crash-smoke:
 	timeout -k 10 120 python tools/crash_smoke.py
+
+# The preemption-storm chaos harness (tools/interruption_smoke.py): staggered
+# spot reclaims on loaded nodes, PDB-forced deadline escalation, controllers
+# killed at rotating interruption crashpoints and restarted mid-storm, then
+# full convergence (pods rebound, events acked, zero leaked instances)
+# asserted. Hard 120s timeout: a drain that re-grows an unbounded wait fails
+# fast instead of wedging a driver run.
+interruption-smoke:
+	timeout -k 10 120 python tools/interruption_smoke.py
+
+# Every fault-injection smoke in one verdict, fail-late (a crash-smoke
+# failure must not mask an interruption regression in the same run).
+smoke:
+	rc=0; \
+	$(MAKE) crash-smoke || rc=1; \
+	$(MAKE) degraded-smoke || rc=1; \
+	$(MAKE) interruption-smoke || rc=1; \
+	exit $$rc
 
 proto:
 	protoc -I protos --python_out=karpenter_tpu/solver_service protos/solver.proto

@@ -151,6 +151,19 @@ class PodSpec:
     def failed_to_schedule(self) -> bool:
         return self.unschedulable
 
+    def survives_node_drain(self) -> bool:
+        """Worth disrupting when its node drains: not already dying, not
+        bound to the node by ownership (daemon/static pods die with the
+        node, they don't migrate). THE drain-eligibility predicate — the
+        terminator's eviction set and the interruption drain's displacement
+        set both read it, so they cannot disagree about which pods remain."""
+        return not (
+            self.is_terminating()
+            or self.is_terminal()
+            or self.is_owned_by_node()
+            or self.is_owned_by_daemonset()
+        )
+
     def is_provisionable(self) -> bool:
         """Candidate for provisioning: unschedulable, unbound, not daemon/static
         (ref: selection/controller.go isProvisionable:104)."""

@@ -54,6 +54,17 @@ NOT_READY_TAINT_KEY = "karpenter.sh/not-ready"
 TERMINATION_FINALIZER = "karpenter.sh/termination"
 DO_NOT_EVICT_ANNOTATION = "karpenter.sh/do-not-evict"
 EMPTINESS_TIMESTAMP_ANNOTATION = "karpenter.sh/emptiness-timestamp"
+# Interruption intent, stamped onto the victim Node BEFORE the provider event
+# is acked — the durable record a restarted controller resumes the drain from
+# (controllers/interruption.py).
+INTERRUPTION_KIND_ANNOTATION = "karpenter.sh/interruption-kind"
+INTERRUPTION_DEADLINE_ANNOTATION = "karpenter.sh/interruption-deadline"
+# Bumped every time a pod is displaced back to pending (interruption drain).
+# Part of the launch identity: a displaced pod's replacement launch must be a
+# DIFFERENT logical launch than the purchase that backed its old node, or a
+# restart-idempotent provider would "adopt" the dying instance and rebind the
+# pod onto the node being reclaimed.
+RESCHEDULE_EPOCH_ANNOTATION = "karpenter.sh/reschedule-epoch"
 
 # --- Resource names --------------------------------------------------------
 RESOURCE_CPU = "cpu"

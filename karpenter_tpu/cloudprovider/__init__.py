@@ -73,6 +73,50 @@ class InstanceType:
         return min(prices) if prices else float("inf")
 
 
+# --- Interruption events ----------------------------------------------------
+#
+# Ref: the reference ecosystem's AWS interruption controller consumes the
+# EventBridge streams for EC2 spot-interruption-warning, rebalance-
+# recommendation, and instance-state-change through an SQS queue. We surface
+# the same three kinds through a provider-neutral poll/ack pair so the
+# interruption controller can react inside the reclaim window.
+
+INTERRUPTION_SPOT = "spot-interruption"  # hard: capacity dies at the deadline
+INTERRUPTION_REBALANCE = "rebalance-recommendation"  # soft: elevated risk only
+INTERRUPTION_STOPPING = "instance-stopping"  # hard: provider is stopping it
+
+# Kinds that carry (or imply) a reclaim deadline; the drain escalates as it
+# approaches. Soft kinds drain politely and never override PDBs.
+HARD_INTERRUPTION_KINDS = frozenset({INTERRUPTION_SPOT, INTERRUPTION_STOPPING})
+
+# EC2 gives two minutes of warning before a spot reclaim; events that name no
+# explicit deadline get this window from their observation time.
+DEFAULT_INTERRUPTION_DEADLINE_SECONDS = 120.0
+
+
+@dataclass(frozen=True)
+class InterruptionEvent:
+    """One provider notice that an instance is about to lose its capacity.
+
+    `instance_id` is the provider-side join key (events rarely carry the
+    zone, so `provider_id` is best-effort — the controller matches either).
+    `deadline` is epoch seconds in the provider's clock domain; None = soft
+    (no hard reclaim time). `event_id` is the at-least-once ack token
+    (`ack_interruption`): the SQS receipt handle for EC2, the fake's queue
+    key for tests — an event stays re-deliverable until acked, so a
+    controller that dies between observing and recording it sees it again."""
+
+    kind: str
+    instance_id: str
+    provider_id: str = ""
+    deadline: Optional[float] = None
+    event_id: str = ""
+    detail: str = ""
+
+    def is_hard(self) -> bool:
+        return self.kind in HARD_INTERRUPTION_KINDS
+
+
 @dataclass(frozen=True)
 class CloudInstance:
     """A provider-side instance carrying this cluster's ownership tag, as
@@ -181,6 +225,27 @@ class CloudProvider(abc.ABC):
         raise NotImplementedError(
             f"{type(self).__name__} cannot terminate untracked instances"
         )
+
+    def poll_interruptions(self) -> List[InterruptionEvent]:
+        """Pending interruption notices for this cluster's capacity,
+        at-least-once: an event stays re-deliverable until `ack_interruption`
+        confirms it was durably recorded (the SQS visibility model). Providers
+        without an interruption feed return [] (the controller is then inert
+        for them)."""
+        return []
+
+    def ack_interruption(self, event: InterruptionEvent) -> None:
+        """Confirm an event was recorded (annotated onto its Node); the
+        provider stops re-delivering it. Unknown/already-acked events are
+        success — acks race re-deliveries."""
+
+    def blackout_offering(
+        self, instance_type: str, zone: str, capacity_type: str
+    ) -> None:
+        """Temporarily exclude one (type, zone, capacity-type) pool from
+        `get_instance_types` — the interruption controller calls this for a
+        reclaimed pool so replacement capacity re-solves AWAY from it (the
+        same cache the ICE blackout feeds). Default: no-op."""
 
     @abc.abstractmethod
     def get_instance_types(self, constraints: Optional[Constraints] = None) -> List[InstanceType]:

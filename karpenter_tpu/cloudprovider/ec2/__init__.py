@@ -16,11 +16,19 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence
 
+import datetime
+import json
+
 from karpenter_tpu.api.provisioner import Constraints, Provisioner
 from karpenter_tpu.cloudprovider import (
+    DEFAULT_INTERRUPTION_DEADLINE_SECONDS,
+    INTERRUPTION_REBALANCE,
+    INTERRUPTION_SPOT,
+    INTERRUPTION_STOPPING,
     CloudInstance,
     CloudProvider,
     InstanceType,
+    InterruptionEvent,
     NodeSpec,
 )
 from karpenter_tpu.cloudprovider.ec2.api import Ec2Api
@@ -45,6 +53,29 @@ from karpenter_tpu.utils.workqueue import RateLimiter
 # Fleet-call throttle (ref: aws/cloudprovider.go:41-46).
 FLEET_QPS = 2.0
 FLEET_BURST = 100
+
+# EventBridge detail-type -> interruption kind (ref: the reference ecosystem's
+# interruption controller consumes exactly these rule streams via SQS).
+_DETAIL_TYPE_KINDS = {
+    "EC2 Spot Instance Interruption Warning": INTERRUPTION_SPOT,
+    "EC2 Instance Rebalance Recommendation": INTERRUPTION_REBALANCE,
+    "EC2 Instance State-change Notification": INTERRUPTION_STOPPING,
+}
+# State-change notifications that actually mean "capacity going away".
+_STOPPING_STATES = frozenset({"stopping", "shutting-down"})
+
+
+def _parse_event_time(value: str) -> float:
+    """EventBridge ISO-8601 `time` -> epoch seconds; 0.0 when unparseable
+    (the caller falls back to its own observation time)."""
+    if not value:
+        return 0.0
+    try:
+        return datetime.datetime.fromisoformat(
+            value.replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return 0.0
 
 
 class Ec2CloudProvider(CloudProvider):
@@ -155,6 +186,65 @@ class Ec2CloudProvider(CloudProvider):
 
     def terminate_instance(self, instance: CloudInstance) -> None:
         self.instances.terminate_by_id(instance.instance_id)
+
+    def poll_interruptions(self) -> List[InterruptionEvent]:
+        """Drain one poll of the EventBridge-fed queue into typed events.
+        Messages that map to an event are left on the queue (at-least-once —
+        the controller acks after durably recording the interruption);
+        messages that map to nothing (state changes we don't act on, foreign
+        sources) are deleted here so noise can't clog the queue."""
+        events: List[InterruptionEvent] = []
+        for message in self.api.receive_queue_messages():
+            event = self._to_interruption(message)
+            if event is None:
+                self.api.delete_queue_message(message.receipt_handle)
+                continue
+            events.append(event)
+        return events
+
+    def _to_interruption(self, message) -> Optional[InterruptionEvent]:
+        # Anything can land on an SQS queue. EVERY malformed shape — invalid
+        # JSON, a non-object body, a non-dict detail, a numeric time — must
+        # map to None (and therefore deletion) rather than raise: an
+        # exception here would abort the whole poll before the message is
+        # deleted, and the poison re-delivery would starve every real
+        # reclaim warning behind it forever.
+        try:
+            body = json.loads(message.body)
+            kind = _DETAIL_TYPE_KINDS.get(body.get("detail-type", ""))
+            detail = body.get("detail") or {}
+            instance_id = detail.get("instance-id")
+            state = detail.get("state")
+            observed = _parse_event_time(body.get("time", ""))
+        except (ValueError, AttributeError, TypeError):
+            return None
+        if kind is None or not instance_id or not isinstance(instance_id, str):
+            return None
+        if kind == INTERRUPTION_STOPPING and state not in _STOPPING_STATES:
+            return None
+        deadline = None
+        if kind != INTERRUPTION_REBALANCE:
+            deadline = (
+                observed or self.clock.now()
+            ) + DEFAULT_INTERRUPTION_DEADLINE_SECONDS
+        return InterruptionEvent(
+            kind=kind,
+            instance_id=instance_id,
+            deadline=deadline,
+            event_id=message.receipt_handle,
+            detail=body.get("detail-type", ""),
+        )
+
+    def ack_interruption(self, event: InterruptionEvent) -> None:
+        self.api.delete_queue_message(event.event_id)
+
+    def blackout_offering(
+        self, instance_type: str, zone: str, capacity_type: str
+    ) -> None:
+        """Interruption-driven exclusion rides the ICE blackout cache, so a
+        reclaimed pool vanishes from get_instance_types for the TTL and the
+        replacement re-solve picks other pools."""
+        self.instance_types.cache_unavailable(instance_type, zone, capacity_type)
 
     def get_instance_types(
         self, constraints: Optional[Constraints] = None

@@ -201,6 +201,21 @@ class FleetResult:
 
 
 @dataclass(frozen=True)
+class QueueMessage:
+    """One message from the cluster's interruption queue (ref: the reference
+    ecosystem's interruption controller consumes an SQS queue fed by
+    EventBridge rules for spot-interruption-warning, rebalance-recommendation
+    and instance-state-change). `body` is the raw EventBridge JSON envelope;
+    `receipt_handle` is the delete token — a message stays re-deliverable
+    (visibility timeout) until deleted, which is what makes the interruption
+    pipeline crash-consistent: record first, delete after."""
+
+    message_id: str
+    receipt_handle: str
+    body: str
+
+
+@dataclass(frozen=True)
 class Instance:
     """Ref: ec2.Instance fields read by instanceToNode (instance.go:232-268).
     `tags` and `launched_at` (epoch seconds, 0.0 = unknown) feed the
@@ -274,6 +289,18 @@ class Ec2Api(abc.ABC):
     def get_ami_parameter(self, path: str) -> str:
         """SSM GetParameter for AMI discovery (ref: aws/ami.go:62-72).
         Raises ApiError(ParameterNotFound) when absent."""
+
+    def receive_queue_messages(self) -> List[QueueMessage]:
+        """Poll the cluster's interruption queue (SQS ReceiveMessage).
+        Messages remain re-deliverable until delete_queue_message — the
+        at-least-once contract the interruption controller's record-then-ack
+        discipline depends on. Default: no queue configured, nothing to
+        receive."""
+        return []
+
+    def delete_queue_message(self, receipt_handle: str) -> None:
+        """Ack one received message (SQS DeleteMessage). Deleting an unknown
+        or already-deleted handle is success."""
 
 
 def match_tags(tags: Mapping[str, str], filters: Mapping[str, str]) -> bool:

@@ -46,6 +46,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
     InstanceTypeInfo,
     InstanceTypeOffering,
     LaunchTemplate,
+    QueueMessage,
     SecurityGroup,
     Subnet,
 )
@@ -57,6 +58,10 @@ log = klog.named("aws")
 
 EC2_API_VERSION = "2016-11-15"
 _SSM_TARGET_PREFIX = "AmazonSSM"
+_SQS_TARGET_PREFIX = "AmazonSQS"
+# One poll's message budget (the SQS per-call maximum). The controller sweeps
+# every couple of seconds, so a reclaim storm drains across a few polls.
+SQS_MAX_MESSAGES = 10
 
 # Retries by action and error code: a rising rate is the first visible sign
 # of throttling or a flaky NAT path, well before calls start exhausting
@@ -335,6 +340,8 @@ class AwsHttpEc2Api(Ec2Api):
         transport: Optional[HttpTransport] = None,
         ec2_endpoint: str = "",
         ssm_endpoint: str = "",
+        sqs_endpoint: str = "",
+        interruption_queue_url: str = "",
         price_catalog: Optional[Mapping[str, float]] = None,
         spot_price_ratio: float = 0.6,
         spot_prices: Optional[Mapping[Tuple[str, str], float]] = None,
@@ -349,6 +356,14 @@ class AwsHttpEc2Api(Ec2Api):
         self.transport = transport or UrllibTransport()
         self.ec2_endpoint = ec2_endpoint or f"https://ec2.{self.region}.amazonaws.com/"
         self.ssm_endpoint = ssm_endpoint or f"https://ssm.{self.region}.amazonaws.com/"
+        self.sqs_endpoint = sqs_endpoint or f"https://sqs.{self.region}.amazonaws.com/"
+        # EventBridge-fed interruption queue (spot-interruption-warning /
+        # rebalance-recommendation / instance-state-change rules). Empty =
+        # no interruption feed; receive_queue_messages returns [] without a
+        # wire call.
+        self.interruption_queue_url = interruption_queue_url or os.environ.get(
+            "KARPENTER_INTERRUPTION_QUEUE_URL", ""
+        )
         self.price_catalog = dict(price_catalog or {})
         self.spot_price_ratio = spot_price_ratio
         self.spot_prices = dict(spot_prices or {})
@@ -449,19 +464,39 @@ class AwsHttpEc2Api(Ec2Api):
     def _ssm_call(self, target: str, payload: Mapping) -> Dict:
         body = json.dumps(payload).encode()
         return self._with_retries(
-            lambda: self._ssm_attempt(target, body), what=target
+            lambda: self._json_attempt(
+                self.ssm_endpoint, "ssm", f"{_SSM_TARGET_PREFIX}.{target}",
+                "application/x-amz-json-1.1", body,
+            ),
+            what=target,
         )
 
-    def _ssm_attempt(self, target: str, body: bytes) -> Dict:
+    def _sqs_call(self, target: str, payload: Mapping) -> Dict:
+        """SQS speaks the same signed JSON-RPC shape as SSM (json 1.0 rather
+        than 1.1); retries ride the shared budget and count aws_retry_total
+        by action like every other call."""
+        body = json.dumps(payload).encode()
+        return self._with_retries(
+            lambda: self._json_attempt(
+                self.sqs_endpoint, "sqs", f"{_SQS_TARGET_PREFIX}.{target}",
+                "application/x-amz-json-1.0", body,
+            ),
+            what=target,
+        )
+
+    def _json_attempt(
+        self, endpoint: str, service: str, target: str, content_type: str,
+        body: bytes,
+    ) -> Dict:
         headers = {
-            "Content-Type": "application/x-amz-json-1.1",
-            "X-Amz-Target": f"{_SSM_TARGET_PREFIX}.{target}",
+            "Content-Type": content_type,
+            "X-Amz-Target": target,
         }
         headers = sign_request(
-            "POST", self.ssm_endpoint, headers, body, self.region, "ssm",
+            "POST", endpoint, headers, body, self.region, service,
             self.credentials, now=self._clock() if self._clock else None,
         )
-        response = self.transport.send("POST", self.ssm_endpoint, headers, body)
+        response = self.transport.send("POST", endpoint, headers, body)
         try:
             data = json.loads(response.body or b"{}")
         except ValueError:
@@ -798,3 +833,48 @@ class AwsHttpEc2Api(Ec2Api):
         if not value:
             raise ApiError("ParameterNotFound", path)
         return value
+
+    # --- interruption queue (sqs) -------------------------------------------
+
+    def receive_queue_messages(self) -> List[QueueMessage]:
+        """One short poll of the EventBridge-fed interruption queue. Messages
+        are NOT deleted here — they stay invisible for the queue's visibility
+        timeout and re-deliver unless delete_queue_message confirms them, so
+        a controller that dies after receiving loses nothing."""
+        if not self.interruption_queue_url:
+            return []
+        data = self._sqs_call(
+            "ReceiveMessage",
+            {
+                "QueueUrl": self.interruption_queue_url,
+                "MaxNumberOfMessages": SQS_MAX_MESSAGES,
+                "WaitTimeSeconds": 0,
+            },
+        )
+        return [
+            QueueMessage(
+                message_id=str(item.get("MessageId", "")),
+                receipt_handle=str(item.get("ReceiptHandle", "")),
+                body=str(item.get("Body", "")),
+            )
+            for item in data.get("Messages", []) or []
+        ]
+
+    def delete_queue_message(self, receipt_handle: str) -> None:
+        if not self.interruption_queue_url or not receipt_handle:
+            return
+        try:
+            self._sqs_call(
+                "DeleteMessage",
+                {
+                    "QueueUrl": self.interruption_queue_url,
+                    "ReceiptHandle": receipt_handle,
+                },
+            )
+        except ApiError as error:
+            # An expired/unknown handle means the message already re-surfaced
+            # or was deleted — ack semantics make that success.
+            if error.code not in (
+                "ReceiptHandleIsInvalid", "InvalidParameterValue",
+            ):
+                raise

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import json
 import threading
 from dataclasses import replace
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
@@ -27,6 +28,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
     InstanceTypeInfo,
     InstanceTypeOffering,
     LaunchTemplate,
+    QueueMessage,
     SecurityGroup,
     Subnet,
     match_tags,
@@ -155,10 +157,15 @@ class FakeEc2(Ec2Api):
         # token with DIFFERENT request parameters is rejected, also like
         # EC2 (IdempotentParameterMismatch).
         self._fleet_tokens: Dict[str, Tuple[str, List[str]]] = {}
+        # Injectable interruption queue: receipt_handle -> message, delivered
+        # until deleted (the SQS visibility model, so record-then-ack crash
+        # consistency is testable against this fake too).
+        self.interruption_messages: Dict[str, QueueMessage] = {}
         self.calls: Dict[str, List] = {
             "create_fleet": [],
             "create_launch_template": [],
             "terminate_instances": [],
+            "delete_queue_message": [],
         }
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
@@ -324,6 +331,40 @@ class FakeEc2(Ec2Api):
                     raise ApiError("InvalidInstanceID.NotFound", instance_id)
                 live = self.instances.pop(instance_id)
                 self.corpses[instance_id] = replace(live, state="terminated")
+
+    # --- interruption queue --------------------------------------------------
+
+    def inject_interruption_message(
+        self, detail_type: str, instance_id: str, time_iso: str = "",
+        detail: Optional[Dict] = None,
+    ) -> QueueMessage:
+        """Enqueue an EventBridge-shaped notice (the exact envelope the real
+        queue carries) for the interruption poll to consume."""
+        body = {
+            "version": "0",
+            "detail-type": detail_type,
+            "source": "aws.ec2",
+            "time": time_iso,
+            "detail": {"instance-id": instance_id, **(detail or {})},
+        }
+        with self._lock:
+            handle = f"rh-{next(self._ids):08d}"
+            message = QueueMessage(
+                message_id=f"mid-{handle}",
+                receipt_handle=handle,
+                body=json.dumps(body),
+            )
+            self.interruption_messages[handle] = message
+            return message
+
+    def receive_queue_messages(self) -> List[QueueMessage]:
+        with self._lock:
+            return list(self.interruption_messages.values())
+
+    def delete_queue_message(self, receipt_handle: str) -> None:
+        with self._lock:
+            self.calls["delete_queue_message"].append(receipt_handle)
+            self.interruption_messages.pop(receipt_handle, None)
 
     # --- ssm ---------------------------------------------------------------
 

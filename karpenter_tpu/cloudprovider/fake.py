@@ -18,10 +18,13 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.provisioner import Constraints, Provisioner
 from karpenter_tpu.cloudprovider import (
+    DEFAULT_INTERRUPTION_DEADLINE_SECONDS,
+    INTERRUPTION_SPOT,
     CloudInstance,
     CloudProvider,
     InstanceType,
     InsufficientCapacityError,
+    InterruptionEvent,
     NodeSpec,
     Offering,
 )
@@ -148,6 +151,13 @@ class FakeCloudProvider(CloudProvider):
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
         # Offering blackout cache (ref: aws/instancetypes.go:174-183).
         self._unavailable: Dict[Tuple[str, str, str], float] = {}
+        # Injectable interruption feed: event_id -> event, delivered by
+        # poll_interruptions until acked (the SQS at-least-once model), so
+        # crash tests can kill the controller between observing and
+        # recording an event and still see it re-delivered.
+        self._interruptions: Dict[str, InterruptionEvent] = {}
+        self._event_ids = itertools.count(1)
+        self.acked_interruptions: List[str] = []
         self._lock = threading.Lock()
 
     # --- helpers ------------------------------------------------------------
@@ -163,6 +173,47 @@ class FakeCloudProvider(CloudProvider):
             self._unavailable[(instance_type, zone, capacity_type)] = (
                 self._now() + UNAVAILABLE_OFFERING_TTL
             )
+
+    def blackout_offering(
+        self, instance_type: str, zone: str, capacity_type: str
+    ) -> None:
+        """Interruption-driven pool exclusion rides the same blackout cache
+        as ICE feedback: the pool vanishes from get_instance_types for the
+        TTL, so replacement capacity re-solves away from it."""
+        self.cache_unavailable(instance_type, zone, capacity_type)
+
+    # --- interruption feed --------------------------------------------------
+
+    def inject_interruption(
+        self,
+        node: NodeSpec,
+        kind: str = INTERRUPTION_SPOT,
+        deadline_in: Optional[float] = DEFAULT_INTERRUPTION_DEADLINE_SECONDS,
+    ) -> InterruptionEvent:
+        """Test hook: enqueue an interruption notice for `node`'s instance.
+        `deadline_in` is seconds from now (None = soft, no deadline)."""
+        with self._lock:
+            event_id = f"fake-event-{next(self._event_ids)}"
+            event = InterruptionEvent(
+                kind=kind,
+                instance_id=node.provider_id.rsplit("/", 1)[-1],
+                provider_id=node.provider_id,
+                deadline=(
+                    self._now() + deadline_in if deadline_in is not None else None
+                ),
+                event_id=event_id,
+            )
+            self._interruptions[event_id] = event
+            return event
+
+    def poll_interruptions(self) -> List[InterruptionEvent]:
+        with self._lock:
+            return list(self._interruptions.values())
+
+    def ack_interruption(self, event: InterruptionEvent) -> None:
+        with self._lock:
+            if self._interruptions.pop(event.event_id, None) is not None:
+                self.acked_interruptions.append(event.event_id)
 
     def _offering_available(self, name: str, offering: Offering) -> bool:
         key = (name, offering.zone, offering.capacity_type)

@@ -23,6 +23,16 @@ from karpenter_tpu.utils.clock import Clock
 PodKey = Tuple[str, str]  # (namespace, name)
 
 
+def reschedule_epoch(pod: PodSpec) -> int:
+    """How many times this pod has been displaced back to pending (0 = never;
+    see RESCHEDULE_EPOCH_ANNOTATION)."""
+    raw = pod.annotations.get(wellknown.RESCHEDULE_EPOCH_ANNOTATION, "0")
+    try:
+        return int(raw)
+    except ValueError:
+        return 0
+
+
 class NotFoundError(KeyError):
     pass
 
@@ -137,6 +147,47 @@ class Cluster:
             pod.deletion_timestamp = self.clock.now()
         self._notify("pod", pod)
 
+    def reschedule_pod(
+        self, namespace: str, name: str, override_pdb: bool = False
+    ) -> Optional[PodSpec]:
+        """Displace a bound pod back to pending (node_name cleared,
+        unschedulable set) so the provisioning path rebinds it onto fresh
+        capacity — the interruption drain's replacement for evict-to-death
+        (this store has no workload controller to re-create an evicted pod,
+        so displacement IS the re-creation; see docs/design/interruption.md).
+        The disruption is PDB-gated like eviction unless `override_pdb` (the
+        deadline-escalation path, which prefers a budget violation over
+        losing the pod uncleanly). Returns the displaced pod, or None when it
+        no longer exists; a pod already unbound is returned unchanged."""
+        pod = self.try_get_pod(namespace, name)
+        if pod is None or pod.node_name is None:
+            return pod
+        if not override_pdb and not self._pdb_allows(pod):
+            from karpenter_tpu.controllers.errors import PDBViolationError
+
+            raise PDBViolationError(f"pod {namespace}/{name} blocked by PDB")
+        return self._reschedule_local(namespace, name)
+
+    def _reschedule_local(self, namespace: str, name: str) -> Optional[PodSpec]:
+        """The store-side half of reschedule_pod (the apiserver backend
+        overrides this to write through first)."""
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                return None
+            pod.node_name = None
+            pod.unschedulable = True
+            # The epoch bump makes the replacement a DIFFERENT logical launch
+            # than the purchase backing the old node (the launch identity
+            # hashes uid@epoch) — without it, a restart-idempotent provider
+            # would adopt the dying instance and rebind the pod onto the very
+            # node being reclaimed.
+            pod.annotations[wellknown.RESCHEDULE_EPOCH_ANNOTATION] = str(
+                reschedule_epoch(pod) + 1
+            )
+        self._notify("pod", pod)
+        return pod
+
     # --- pod disruption budgets (simplified) --------------------------------
 
     def apply_pdb(self, name: str, match_labels: Dict[str, str], min_available: int):
@@ -144,17 +195,28 @@ class Cluster:
             self._pdbs[name] = (dict(match_labels), min_available)
 
     def _pdb_allows(self, pod: PodSpec) -> bool:
+        """Healthy = bound and not terminating: a pod displaced back to
+        pending (reschedule_pod) is down for the whole relaunch+rebind
+        latency, so it must not count toward the budget — otherwise one
+        polite drain sweep could displace every replica behind a PDB, each
+        step still seeing the previous victims as 'healthy'."""
         for match_labels, min_available in self._pdbs.values():
-            if all(pod.labels.get(k) == v for k, v in match_labels.items()):
-                with self._lock:
-                    healthy = [
-                        p
-                        for p in self._pods.values()
-                        if p.deletion_timestamp is None
-                        and all(p.labels.get(k) == v for k, v in match_labels.items())
-                    ]
-                if len(healthy) - 1 < min_available:
-                    return False
+            if not all(pod.labels.get(k) == v for k, v in match_labels.items()):
+                continue
+            with self._lock:
+                healthy = [
+                    p
+                    for p in self._pods.values()
+                    if p.deletion_timestamp is None
+                    and p.node_name is not None
+                    and all(p.labels.get(k) == v for k, v in match_labels.items())
+                ]
+            # Disrupting an already-unhealthy pod costs the budget nothing.
+            victim_counts = (
+                pod.deletion_timestamp is None and pod.node_name is not None
+            )
+            if len(healthy) - (1 if victim_counts else 0) < min_available:
+                return False
         return True
 
     # --- nodes -------------------------------------------------------------

@@ -324,9 +324,19 @@ class ProvisionerWorker:
         token and buys fresh capacity rather than replaying a token against
         mismatched parameters (EC2 would reject the call with
         IdempotentParameterMismatch); the first attempt's orphan is the
-        leaked-capacity GC's job."""
+        leaked-capacity GC's job.
+
+        Each uid carries its reschedule epoch (bumped when the interruption
+        drain displaces the pod back to pending): a replacement launch for
+        displaced pods must NOT alias the purchase that backed their dying
+        node — with a bare uid it would, and the provider's idempotent
+        replay would adopt the reclaimed instance and rebind the pods onto
+        the very node being drained."""
+        from karpenter_tpu.controllers.cluster import reschedule_epoch
+
         pod_uids = sorted(
-            pod.uid or f"{pod.namespace}/{pod.name}" for pod in packing.pods
+            f"{pod.uid or f'{pod.namespace}/{pod.name}'}@{reschedule_epoch(pod)}"
+            for pod in packing.pods
         )
         type_names = sorted(t.name for t in packing.instance_type_options)
         pools = [

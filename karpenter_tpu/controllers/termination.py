@@ -9,7 +9,7 @@ deleted at the cloud provider before the finalizer is removed.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import PodSpec
@@ -17,9 +17,32 @@ from karpenter_tpu.cloudprovider import CloudProvider, NodeSpec
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.errors import PDBViolationError
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.workqueue import BackoffQueue
 
 CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
+
+# Eviction outcomes by result: "evicted" is progress, "pdb-blocked" retries
+# with backoff, "gone" means the pod vanished before the queue reached it.
+EVICTIONS_TOTAL = REGISTRY.counter(
+    "evictions_total", "Evictions processed by the eviction queue", ["result"]
+)
+# Cordon-to-cloud-delete wall time per drained node. Buckets stretch past the
+# reconcile-duration ramp: a drain legitimately lasts minutes when PDBs
+# meter it.
+NODE_DRAIN_DURATION = REGISTRY.histogram(
+    "node_drain_duration_seconds",
+    "Node drain duration (first drain attempt to cloud delete)",
+    buckets=(1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0),
+)
+# A drain that spins without progress was previously invisible (the
+# reconcile requeued forever in silence); this fires once per stall episode.
+DRAIN_STALLED_TOTAL = REGISTRY.counter(
+    "drain_stalled_total",
+    "Drains that made no progress for STALL_RECONCILES consecutive "
+    "reconciles, by blocking reason",
+    ["reason"],
+)
 
 
 class EvictionQueue:
@@ -52,11 +75,14 @@ class EvictionQueue:
             namespace, name = key
             pod = self.cluster.try_get_pod(namespace, name)
             if pod is None:
+                EVICTIONS_TOTAL.inc("gone")
                 return True
             try:
                 self.cluster.evict_pod(namespace, name)
+                EVICTIONS_TOTAL.inc("evicted")
                 return True
             except PDBViolationError:
+                EVICTIONS_TOTAL.inc("pdb-blocked")
                 return False  # 429-equivalent: retry with backoff
 
         return self.queue.process(evict)
@@ -95,6 +121,9 @@ class Terminator:
         self.cluster = cluster
         self.cloud = cloud
         self.evictions = evictions
+        # node name -> clock time of the FIRST drain attempt; closed (and
+        # observed into NODE_DRAIN_DURATION) at terminate.
+        self._drain_started: Dict[str, float] = {}
 
     def cordon(self, node: NodeSpec) -> None:
         """ref: terminate.go:42-55."""
@@ -104,6 +133,7 @@ class Terminator:
 
     def drain(self, node: NodeSpec) -> bool:
         """Returns True when fully drained (ref: terminate.go:58-82)."""
+        self._drain_started.setdefault(node.name, self.cluster.clock.now())
         pods = self.cluster.list_pods(node_name=node.name)
         # Refuse to drain while any pod carries do-not-evict
         # (ref: terminate.go:67-72).
@@ -125,19 +155,20 @@ class Terminator:
     def _evictable(self, pods: List[PodSpec]) -> List[PodSpec]:
         """Skip terminating ("stuck") and node-owned/daemon pods that tolerate
         the unschedulable state (ref: terminate.go:111-125)."""
-        out = []
-        for pod in pods:
-            if pod.is_terminating() or pod.is_terminal():
-                continue
-            if pod.is_owned_by_node() or pod.is_owned_by_daemonset():
-                continue
-            out.append(pod)
-        return out
+        return [pod for pod in pods if pod.survives_node_drain()]
 
     def terminate(self, node: NodeSpec) -> None:
         """Cloud delete then strip the finalizer (ref: terminate.go:84-100)."""
         self.cloud.delete(node)
         self.cluster.remove_finalizer(node, wellknown.TERMINATION_FINALIZER)
+        started = self._drain_started.pop(node.name, None)
+        if started is not None:
+            NODE_DRAIN_DURATION.observe(self.cluster.clock.now() - started)
+
+    def forget(self, name: str) -> None:
+        """Drop drain bookkeeping for a node that vanished without passing
+        through terminate (external delete raced us)."""
+        self._drain_started.pop(name, None)
 
 
 class TerminationController:
@@ -145,15 +176,25 @@ class TerminationController:
     while draining."""
 
     REQUEUE_SECONDS = 1.0
+    # Reconciles without drain progress before the stall is surfaced (at the
+    # 1s requeue that is ~30s of a node visibly going nowhere).
+    STALL_RECONCILES = 30
 
     def __init__(self, cluster: Cluster, cloud: CloudProvider):
         self.cluster = cluster
         self.evictions = EvictionQueue(cluster)
         self.terminator = Terminator(cluster, cloud, self.evictions)
+        self.log = klog.named("termination")
+        # node name -> (pod-state snapshot, consecutive no-change count).
+        # Progress = the snapshot changes (a pod vanished or started
+        # terminating); a long-flat snapshot is a stalled drain.
+        self._stalls: Dict[str, Tuple[FrozenSet, int]] = {}
 
     def reconcile(self, name: str) -> Optional[float]:
         node = self.cluster.try_get_node(name)
         if node is None:
+            self._stalls.pop(name, None)
+            self.terminator.forget(name)
             return None
         if node.deletion_timestamp is None:
             return None
@@ -164,6 +205,38 @@ class TerminationController:
             # Evictions drain from the EvictionQueue's own pump thread
             # (ref: eviction.go:45-57) — the reconcile only requeues to
             # observe progress.
+            self._observe_stall(node)
             return self.REQUEUE_SECONDS
         self.terminator.terminate(node)
+        self._stalls.pop(name, None)
         return None
+
+    def _observe_stall(self, node: NodeSpec) -> None:
+        """Count consecutive no-progress reconciles; at STALL_RECONCILES,
+        increment drain_stalled_total{reason} and log the blocking pods ONCE
+        per stall episode (progress resets the episode)."""
+        pods = self.cluster.list_pods(node_name=node.name)
+        snapshot = frozenset(
+            (p.namespace, p.name, p.is_terminating()) for p in pods
+        )
+        previous, count = self._stalls.get(node.name, (None, 0))
+        if snapshot != previous:
+            self._stalls[node.name] = (snapshot, 0)
+            return
+        count += 1
+        self._stalls[node.name] = (snapshot, count)
+        if count != self.STALL_RECONCILES:
+            return
+        blockers = [
+            p for p in pods if wellknown.DO_NOT_EVICT_ANNOTATION in p.annotations
+        ]
+        reason = "do-not-evict" if blockers else "pdb"
+        DRAIN_STALLED_TOTAL.inc(reason)
+        stuck = blockers or [p for p in pods if not p.is_terminating()]
+        self.log.warning(
+            "drain of %s stalled for %d reconciles (%s); blocking pods: %s",
+            node.name,
+            count,
+            reason,
+            ", ".join(sorted(f"{p.namespace}/{p.name}" for p in stuck)) or "none",
+        )

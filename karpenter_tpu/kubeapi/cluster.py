@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.cloudprovider import NodeSpec
@@ -407,6 +408,44 @@ class ApiServerCluster(Cluster):
         if pod is not None:
             pod.deletion_timestamp = self.clock.now()
             self._notify("pod", pod)
+
+    def _reschedule_local(self, namespace: str, name: str):
+        """Write-through displacement: clear spec.nodeName (merge-patch null
+        removes the key), restore the Unschedulable condition so a re-list
+        sees the pod as provisionable again, and persist the bumped
+        reschedule epoch (launch-identity input); then update the cache. The
+        PDB gate already ran in reschedule_pod against the cache (PDBs write
+        through both sides)."""
+        from karpenter_tpu.controllers.cluster import reschedule_epoch
+
+        pod = self.try_get_pod(namespace, name)
+        epoch = reschedule_epoch(pod) + 1 if pod is not None else 1
+        try:
+            updated = self.api.patch(
+                _pod_path(namespace, name),
+                {
+                    "metadata": {
+                        "annotations": {
+                            wellknown.RESCHEDULE_EPOCH_ANNOTATION: str(epoch)
+                        }
+                    },
+                    "spec": {"nodeName": None},
+                    "status": {
+                        "conditions": [
+                            {
+                                "type": "PodScheduled",
+                                "status": "False",
+                                "reason": "Unschedulable",
+                            }
+                        ]
+                    },
+                },
+            )
+            self._record_rv("pod", updated)
+        except ApiError as error:
+            if error.status != 404:
+                raise
+        return super()._reschedule_local(namespace, name)
 
     def apply_pdb(self, name: str, match_labels, min_available: int):
         path = "/apis/policy/v1/namespaces/default/poddisruptionbudgets"

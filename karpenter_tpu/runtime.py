@@ -20,6 +20,7 @@ from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.metrics import MetricsController, POLL_SECONDS
 from karpenter_tpu.controllers.node import NodeController
 from karpenter_tpu.controllers.instancegc import InstanceGcController
+from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.podgc import PodGcController
 from karpenter_tpu.controllers.provisioning import (
     BATCH_IDLE_SECONDS,
@@ -419,6 +420,13 @@ class Manager:
         self.metrics = MetricsController(cluster)
         self.podgc = PodGcController(cluster)
         self.instancegc = InstanceGcController(cluster, cloud)
+        self.interruption = InterruptionController(
+            cluster,
+            cloud,
+            self.provisioning,
+            self.termination,
+            escalate_fraction=options.interruption_escalate_fraction,
+        )
         self.ready = threading.Event()
         # Set once the solver's compile debt is paid (immediately for host
         # solvers). Gates /readyz AND the batch loop: a batch window that
@@ -479,6 +487,11 @@ class Manager:
             # Nodes — the money-side analogue of podgc.
             "instancegc": ReconcileLoop(
                 "instancegc", self.instancegc.reconcile, concurrency=1
+            ),
+            # Interruption sweep: poll provider reclaim notices, drain
+            # ahead of the deadline, replace before the pods land.
+            "interruption": ReconcileLoop(
+                "interruption", self.interruption.reconcile, concurrency=1
             ),
         }
 
@@ -561,6 +574,7 @@ class Manager:
             self.loops["node"].enqueue(node.name)
         self.loops["podgc"].enqueue("sweep")
         self.loops["instancegc"].enqueue("sweep")
+        self.loops["interruption"].enqueue("sweep")
         if getattr(self.solver, "needs_device_warmup", False):
             from karpenter_tpu.utils import backend_health
 

@@ -42,13 +42,27 @@ from dataclasses import dataclass, field
 from typing import Dict, List
 
 # The canonical site names, asserted by the lint in the crash battletest so
-# the matrix can't silently drift from the instrumented code.
+# the matrix can't silently drift from the instrumented code. SITES is the
+# provisioning pipeline's matrix (tests/test_crash_consistency.py drives a
+# provision pass into each); INTERRUPTION_SITES is the interruption
+# pipeline's (tests/test_interruption.py drives a reclaim into each). The
+# inventory lint asserts over the union.
 SITES = (
     "provision.before-launch",
     "cloud.after-create-fleet",
     "provision.before-register",
     "provision.mid-bind",
     "provision.after-bind",
+)
+
+# Interruption pipeline commit points (docs/design/interruption.md):
+# - ``interruption.after-annotate``  intent on the Node, event not yet acked
+# - ``interruption.mid-drain``       fires per displaced pod (arm with at=N)
+# - ``interruption.before-delete``   drain done, node deletion not yet issued
+INTERRUPTION_SITES = (
+    "interruption.after-annotate",
+    "interruption.mid-drain",
+    "interruption.before-delete",
 )
 
 

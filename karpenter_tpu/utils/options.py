@@ -53,6 +53,10 @@ class Options:
     # lives in the worker) — so the envelope is the cheapest setting that
     # keeps up: 8.
     selection_concurrency: int = 8
+    # Fraction of an interruption's reclaim window spent draining politely
+    # (PDB-respecting, do-not-evict honored) before the drain overrides both
+    # rather than losing pods to the reclaim (controllers/interruption.py).
+    interruption_escalate_fraction: float = 0.5
 
     def validate(self) -> None:
         errors: List[str] = []
@@ -67,6 +71,11 @@ class Options:
         if self.selection_concurrency < 1:
             errors.append(
                 f"selection-concurrency must be >= 1, got {self.selection_concurrency}"
+            )
+        if not 0.0 < self.interruption_escalate_fraction <= 1.0:
+            errors.append(
+                "interruption-escalate-fraction must be in (0, 1], got "
+                f"{self.interruption_escalate_fraction}"
             )
         if self.cluster_store != "memory" and self.cluster_store != "incluster" and not self.cluster_store.startswith(
             ("http://", "https://")
@@ -109,6 +118,10 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         "--selection-concurrency", type=int,
         default=int(_env("SELECTION_CONCURRENCY", "8")),
     )
+    parser.add_argument(
+        "--interruption-escalate-fraction", type=float,
+        default=float(_env("INTERRUPTION_ESCALATE_FRACTION", "0.5")),
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -124,6 +137,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         log_level=args.log_level,
         cluster_store=args.cluster_store,
         selection_concurrency=args.selection_concurrency,
+        interruption_escalate_fraction=args.interruption_escalate_fraction,
     )
     options.validate()
     return options

@@ -343,6 +343,15 @@ class FakeApiServer:
         return 201, {"kind": "Status", "code": 201}
 
     def _pdb_allows(self, pod: dict) -> bool:
+        """Healthy = bound and not terminating (mirrors the in-memory
+        store's gate): a pod displaced back to pending must not count
+        toward the budget while its replacement launches."""
+
+        def _healthy(p: dict) -> bool:
+            return not p.get("metadata", {}).get("deletionTimestamp") and bool(
+                p.get("spec", {}).get("nodeName")
+            )
+
         labels = pod.get("metadata", {}).get("labels") or {}
         for pdb in self._collection("pdbs").values():
             spec = pdb.get("spec", {})
@@ -352,13 +361,14 @@ class FakeApiServer:
             healthy = [
                 p
                 for p in self._collection("pods").values()
-                if not p.get("metadata", {}).get("deletionTimestamp")
+                if _healthy(p)
                 and all(
                     (p.get("metadata", {}).get("labels") or {}).get(k) == v
                     for k, v in selector.items()
                 )
             ]
-            if len(healthy) - 1 < int(spec.get("minAvailable", 0)):
+            cost = 1 if _healthy(pod) else 0
+            if len(healthy) - cost < int(spec.get("minAvailable", 0)):
                 return False
         return True
 

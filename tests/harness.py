@@ -15,6 +15,7 @@ from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.instancegc import InstanceGcController
+from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.metrics import MetricsController
 from karpenter_tpu.controllers.node import NodeController
 from karpenter_tpu.controllers.provisioning import ProvisioningController
@@ -79,6 +80,9 @@ class Harness:
         self.counter = CounterController(self.cluster)
         self.metrics = MetricsController(self.cluster)
         self.instancegc = InstanceGcController(self.cluster, self.cloud)
+        self.interruption = InterruptionController(
+            self.cluster, self.cloud, self.provisioning, self.termination
+        )
 
     def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:
         self.cluster.apply_provisioner(provisioner)

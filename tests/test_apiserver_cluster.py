@@ -109,12 +109,18 @@ class TestFinalizerProtocol:
 
 class TestEviction:
     def test_eviction_respects_pdb_server_side(self, backend):
+        # Bound replicas: only bound, non-terminating pods count toward the
+        # budget (a pending pod is not available capacity).
         server, cluster = backend
         cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=1)
+        node = NodeSpec(name="db-node")
+        cluster.create_node(node)
         cluster.apply_pod(PodSpec(name="db-0", labels={"app": "db"}))
+        cluster.bind_pod(cluster.get_pod("default", "db-0"), node)
         with pytest.raises(PDBViolationError):
             cluster.evict_pod("default", "db-0")
         cluster.apply_pod(PodSpec(name="db-1", labels={"app": "db"}))
+        cluster.bind_pod(cluster.get_pod("default", "db-1"), node)
         cluster.evict_pod("default", "db-0")  # now min_available holds
         stored = server.get_object("pods", "default", "db-0")
         assert stored["metadata"]["deletionTimestamp"]

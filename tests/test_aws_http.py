@@ -20,6 +20,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
     FleetOverride,
     FleetRequest,
     LaunchTemplate,
+    QueueMessage,
     is_not_found,
 )
 from karpenter_tpu.cloudprovider.ec2.aws_http import (
@@ -301,6 +302,75 @@ _OK_DESCRIBE = HttpResponse(
     b"</item></instancesSet></item></reservationSet>"
     b"</DescribeInstancesResponse>",
 )
+
+
+class TestSqsInterruptionQueue:
+    """The interruption-queue poll action: signed SQS JSON-RPC with the
+    shared retry budget and aws_retry_total accounting."""
+
+    QUEUE = "https://sqs.us-test-1.amazonaws.com/000000000000/interruptions"
+
+    def test_receive_and_delete_encode_and_sign_for_sqs(self):
+        api = recorded_api(
+            HttpResponse(
+                200,
+                json.dumps(
+                    {
+                        "Messages": [
+                            {
+                                "MessageId": "m1",
+                                "ReceiptHandle": "rh1",
+                                "Body": "{}",
+                            }
+                        ]
+                    }
+                ).encode(),
+            ),
+            HttpResponse(200, b"{}"),
+        )
+        api.interruption_queue_url = self.QUEUE
+        assert api.receive_queue_messages() == [QueueMessage("m1", "rh1", "{}")]
+        api.delete_queue_message("rh1")
+        receive, delete = api.transport.sent
+        assert receive[2]["X-Amz-Target"] == "AmazonSQS.ReceiveMessage"
+        assert "/sqs/aws4_request" in receive[2]["Authorization"]
+        assert json.loads(receive[3])["QueueUrl"] == self.QUEUE
+        assert delete[2]["X-Amz-Target"] == "AmazonSQS.DeleteMessage"
+        assert json.loads(delete[3])["ReceiptHandle"] == "rh1"
+
+    def test_no_queue_configured_makes_no_wire_calls(self):
+        api = recorded_api()
+        assert api.receive_queue_messages() == []
+        api.delete_queue_message("rh")
+        assert api.transport.sent == []
+
+    def test_throttled_receive_retries_and_counts(self):
+        from karpenter_tpu.cloudprovider.ec2.aws_http import AWS_RETRY_TOTAL
+
+        before = AWS_RETRY_TOTAL.get("ReceiveMessage", "ThrottlingException")
+        api = recorded_api(
+            HttpResponse(
+                400, json.dumps({"__type": "ThrottlingException"}).encode()
+            ),
+            HttpResponse(200, json.dumps({"Messages": []}).encode()),
+            retry_policy=RetryPolicy(max_retries=2, sleep=lambda _s: None),
+        )
+        api.interruption_queue_url = self.QUEUE
+        assert api.receive_queue_messages() == []
+        assert (
+            AWS_RETRY_TOTAL.get("ReceiveMessage", "ThrottlingException")
+            - before
+            == 1
+        )
+
+    def test_expired_receipt_handle_is_ack_success(self):
+        api = recorded_api(
+            HttpResponse(
+                400, json.dumps({"__type": "ReceiptHandleIsInvalid"}).encode()
+            )
+        )
+        api.interruption_queue_url = self.QUEUE
+        api.delete_queue_message("stale")  # must not raise
 
 
 class TestRetry:
@@ -764,6 +834,11 @@ class TestRestartIdempotency:
         )
         api.describe_instances(["i-1"])
         assert AWS_RETRY_TOTAL.get("DescribeInstances", "HTTP500") - before == 1
+
+
+class TestInterruptionFeedOverWire(_suite.TestInterruptionFeed):
+    """The interruption feed through real SQS JSON-RPC bytes: signed
+    ReceiveMessage/DeleteMessage requests against the wire fake's queue."""
 
 
 class TestCrashConsistentLaunchOverWire(_suite.TestCrashConsistentLaunch):

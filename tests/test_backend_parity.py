@@ -14,6 +14,7 @@ import pytest
 
 from tests import harness as harness_mod
 from tests import test_crash_consistency as crash
+from tests import test_interruption as interruption
 from tests import test_node_lifecycle as lifecycle
 from tests import test_provisioning as provisioning
 from tests import test_scheduling as scheduling
@@ -108,4 +109,21 @@ class TestCrashpointMatrixOnApiserver(crash.TestCrashpointMatrix):
 
 
 class TestInstanceGcOnApiserver(crash.TestInstanceGc):
+    pass
+
+
+class TestDeletionDrainPathOnApiserver(lifecycle.TestDeletionDrainPath):
+    """Satellite regression: Liveness/Expiration deletions traverse
+    cordon→drain→finalizer on the write-through backend too (the apiserver's
+    finalizer protocol is the real-world shape of the held deletion)."""
+
+
+class TestInterruptionOnApiserver(interruption.TestInterruption):
+    """The interruption battletest against the fake apiserver: displacement
+    is a real merge-patch (nodeName removed, Unschedulable condition and
+    reschedule epoch written through), annotation intent survives as patched
+    Node metadata, and the rebind is a fresh Binding POST."""
+
+
+class TestInterruptionCrashMatrixOnApiserver(interruption.TestInterruptionCrashMatrix):
     pass

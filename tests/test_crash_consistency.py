@@ -285,8 +285,8 @@ class TestCrashpointFacility:
         assert not issubclass(SimulatedCrash, Exception)
 
     def test_site_inventory_matches_instrumentation(self):
-        """The canonical SITES tuple and the literals actually threaded
-        through the pipeline may not drift apart — a site in the matrix that
+        """The canonical site tuples and the literals actually threaded
+        through the pipelines may not drift apart — a site in a matrix that
         no code crosses tests nothing."""
         root = Path(karpenter_tpu.__file__).parent
         found = set()
@@ -296,4 +296,6 @@ class TestCrashpointFacility:
             found |= set(
                 re.findall(r'crashpoint\(\s*"([^"]+)"\s*\)', path.read_text())
             )
-        assert found == set(crashpoints.SITES)
+        assert found == set(crashpoints.SITES) | set(
+            crashpoints.INTERRUPTION_SITES
+        )

@@ -687,3 +687,113 @@ class TestCrashConsistentLaunch:
         (listed,) = provider.list_instances()
         provider.terminate_instance(listed)
         assert provider.list_instances() == []
+
+
+class TestInterruptionFeed:
+    """EventBridge envelope -> typed InterruptionEvent, at-least-once ack,
+    noise filtering, and interruption-driven pool blackout — the EC2 half of
+    the interruption subsystem (controllers/interruption.py drives it)."""
+
+    def test_spot_warning_maps_to_hard_event_with_deadline(self):
+        import datetime
+
+        from karpenter_tpu.cloudprovider import INTERRUPTION_SPOT
+
+        cloud, api, clock = make_provider()
+        api.inject_interruption_message(
+            "EC2 Spot Instance Interruption Warning",
+            "i-0123",
+            time_iso="2026-08-02T12:00:00Z",
+        )
+        events = cloud.poll_interruptions()
+        assert len(events) == 1
+        event = events[0]
+        assert event.kind == INTERRUPTION_SPOT and event.is_hard()
+        assert event.instance_id == "i-0123"
+        warned_at = datetime.datetime(
+            2026, 8, 2, 12, 0, tzinfo=datetime.timezone.utc
+        ).timestamp()
+        assert event.deadline == pytest.approx(warned_at + 120.0)
+
+    def test_unacked_event_redelivers_then_ack_removes(self):
+        cloud, api, clock = make_provider()
+        api.inject_interruption_message(
+            "EC2 Spot Instance Interruption Warning", "i-0123"
+        )
+        (event,) = cloud.poll_interruptions()
+        assert len(cloud.poll_interruptions()) == 1  # visibility model
+        cloud.ack_interruption(event)
+        assert cloud.poll_interruptions() == []
+        assert api.calls["delete_queue_message"]
+
+    def test_rebalance_recommendation_is_soft(self):
+        from karpenter_tpu.cloudprovider import INTERRUPTION_REBALANCE
+
+        cloud, api, clock = make_provider()
+        api.inject_interruption_message(
+            "EC2 Instance Rebalance Recommendation", "i-0456"
+        )
+        (event,) = cloud.poll_interruptions()
+        assert event.kind == INTERRUPTION_REBALANCE
+        assert not event.is_hard() and event.deadline is None
+
+    def test_stopping_state_change_is_hard(self):
+        from karpenter_tpu.cloudprovider import INTERRUPTION_STOPPING
+
+        cloud, api, clock = make_provider()
+        api.inject_interruption_message(
+            "EC2 Instance State-change Notification",
+            "i-0789",
+            detail={"state": "stopping"},
+        )
+        (event,) = cloud.poll_interruptions()
+        assert event.kind == INTERRUPTION_STOPPING and event.is_hard()
+        assert event.deadline is not None
+
+    def test_noise_is_deleted_not_delivered(self):
+        """Running-state changes and unparseable bodies must not clog the
+        queue: poll filters AND deletes them."""
+        cloud, api, clock = make_provider()
+        api.inject_interruption_message(
+            "EC2 Instance State-change Notification",
+            "i-0aaa",
+            detail={"state": "running"},
+        )
+        assert cloud.poll_interruptions() == []
+        assert cloud.poll_interruptions() == []  # deleted, not redelivered
+        assert len(api.calls["delete_queue_message"]) == 1
+
+    def test_poison_messages_cannot_wedge_the_feed(self):
+        """Valid-JSON-but-wrong-shape bodies (anything can land on an SQS
+        queue) must be deleted as noise, not raise out of the poll — a
+        poison message re-delivering forever would starve every real
+        reclaim warning behind it."""
+        from karpenter_tpu.cloudprovider.ec2.api import QueueMessage
+
+        cloud, api, clock = make_provider()
+        for poison in ("123", "[1, 2]", '"text"', '{"detail": 7, "detail-type": 5}',
+                       '{"detail-type": "EC2 Spot Instance Interruption Warning", '
+                       '"detail": {"instance-id": 9}, "time": 4}'):
+            handle = f"rh-poison-{len(api.interruption_messages)}"
+            api.interruption_messages[handle] = QueueMessage(
+                message_id=handle, receipt_handle=handle, body=poison
+            )
+        api.inject_interruption_message(
+            "EC2 Spot Instance Interruption Warning", "i-real"
+        )
+        events = cloud.poll_interruptions()
+        assert [e.instance_id for e in events] == ["i-real"]
+        # The poison is gone; only the real (unacked) event remains queued.
+        assert len(api.interruption_messages) == 1
+
+    def test_interruption_blackout_excludes_pool_from_catalog(self):
+        cloud, api, clock = make_provider()
+        zone = api.zones[0]
+        cloud.blackout_offering("m5.large", zone, "spot")
+        for it in cloud.get_instance_types():
+            if it.name != "m5.large":
+                continue
+            assert not any(
+                o.zone == zone and o.capacity_type == "spot"
+                for o in it.offerings
+            ), "blacked-out pool still offered"

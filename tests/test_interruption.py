@@ -1,0 +1,369 @@
+"""Interruption battletest: a reclaim notice on a loaded node must produce
+cordon → deadline-driven drain (escalation only past the configured fraction,
+override metric emitted) → replacement launched with the interrupted pool
+excluded → every displaced pod rebound exactly once → node deleted through
+the finalizer path → zero leaked instances after GC — and the same properties
+must survive a controller killed at any interruption crashpoint.
+
+`make interruption-smoke` wraps the preemption-storm chaos harness
+(tools/interruption_smoke.py) around the same subsystem; this module is the
+deterministic matrix. test_backend_parity re-runs the classes against the
+fake apiserver.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.cloudprovider import (
+    INTERRUPTION_REBALANCE,
+    INTERRUPTION_SPOT,
+)
+from karpenter_tpu.controllers.instancegc import (
+    LAUNCH_GRACE_SECONDS,
+    InstanceGcController,
+)
+from karpenter_tpu.controllers.interruption import (
+    INTERRUPTION_DISPLACED_TOTAL,
+    INTERRUPTION_EVENTS_TOTAL,
+    INTERRUPTION_OVERRIDE_TOTAL,
+    INTERRUPTION_UNMATCHED_TOTAL,
+    InterruptionController,
+)
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.utils import crashpoints
+from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+class BindRecorder:
+    """Watch-driven record of every node a pod was ever bound to (consecutive
+    duplicates collapsed) — the 'rebinds exactly once' oracle."""
+
+    def __init__(self, cluster):
+        self.bound = {}
+        cluster.watch(self._on)
+
+    def _on(self, kind, obj) -> None:
+        if kind != "pod" or getattr(obj, "node_name", None) is None:
+            return
+        seq = self.bound.setdefault(obj.uid, [])
+        if not seq or seq[-1] != obj.node_name:
+            seq.append(obj.node_name)
+
+
+def loaded_harness(n_pods=3, pods=None):
+    """Harness + provisioner + n pods packed onto one node; returns
+    (harness, recorder, pods, node)."""
+    h = Harness()
+    recorder = BindRecorder(h.cluster)
+    h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    pods = pods if pods is not None else fixtures.pods(n_pods)
+    h.provision(*pods)
+    node = h.expect_scheduled(pods[0])
+    for pod in pods[1:]:
+        assert h.expect_scheduled(pod).name == node.name
+    return h, recorder, pods, node
+
+
+def restart(h: Harness) -> None:
+    """A controller-process restart over the surviving cluster + cloud state,
+    including the interruption controller, plus the boot re-list routing
+    still-pending pods back through selection."""
+    h.provisioning = ProvisioningController(h.cluster, h.cloud, None)
+    h.selection = SelectionController(h.cluster, h.provisioning)
+    h.termination = TerminationController(h.cluster, h.cloud)
+    h.instancegc = InstanceGcController(h.cluster, h.cloud)
+    h.interruption = InterruptionController(
+        h.cluster, h.cloud, h.provisioning, h.termination
+    )
+    for provisioner in h.cluster.list_provisioners():
+        h.provisioning.reconcile(provisioner.name)
+    for pod in h.cluster.list_pods():
+        if pod.is_provisionable():
+            h.selection.reconcile(pod.namespace, pod.name)
+
+
+def converge(h: Harness, rounds: int = 5) -> None:
+    """Drive interruption sweeps + provisioning + terminations to a fixpoint."""
+    for _ in range(rounds):
+        h.interruption.reconcile()
+        for worker in list(h.provisioning.workers.values()):
+            worker.provision()
+        h.reconcile_terminations(rounds=3)
+
+
+def assert_rebound_exactly_once(h, recorder, pods, old_node) -> None:
+    for pod in pods:
+        live = h.cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} never rebound"
+        assert live.node_name != old_node.name
+        assert h.cluster.try_get_node(live.node_name) is not None
+        assert recorder.bound[pod.uid] == [old_node.name, live.node_name], (
+            f"{pod.name} bind history {recorder.bound[pod.uid]}"
+        )
+
+
+def assert_no_leaks(h: Harness) -> None:
+    h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+    h.instancegc.reconcile()
+    h.instancegc.reconcile()
+    node_ids = {n.provider_id for n in h.cluster.list_nodes()}
+    leaked = set(h.cloud.instances) - node_ids
+    assert not leaked, f"instances with no Node after GC grace: {sorted(leaked)}"
+
+
+class TestInterruption:
+    def test_spot_interruption_drain_replace_rebind(self):
+        """The acceptance scenario: injected spot-interruption on a loaded
+        node → cordon, drain, replacement excluding the interrupted pool,
+        every pod rebound exactly once, node gone, zero leaks, event acked —
+        all inside the reclaim deadline."""
+        h, recorder, pods, node = loaded_harness()
+        pool = (node.instance_type, node.zone, node.capacity_type)
+        event = h.cloud.inject_interruption(node, deadline_in=120.0)
+
+        h.interruption.reconcile()
+        live = h.cluster.get_node(node.name)
+        assert live.unschedulable, "victim was not cordoned"
+        assert (
+            live.annotations[wellknown.INTERRUPTION_KIND_ANNOTATION]
+            == INTERRUPTION_SPOT
+        )
+        # Replaceable pods were displaced in the first sweep and the node
+        # handed to the finalizer path.
+        assert live.deletion_timestamp is not None
+        assert h.cloud.poll_interruptions() == []  # acked after recording
+
+        # The interrupted pool is blacked out of the catalog the re-solve sees.
+        for it in h.cloud.get_instance_types():
+            if it.name != node.instance_type:
+                continue
+            assert not any(
+                o.zone == node.zone and o.capacity_type == node.capacity_type
+                for o in it.offerings
+            ), "interrupted pool still offered"
+
+        converge(h)
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        for pod in pods:
+            replacement = h.cluster.get_node(
+                h.cluster.get_pod(pod.namespace, pod.name).node_name
+            )
+            assert (
+                replacement.instance_type,
+                replacement.zone,
+                replacement.capacity_type,
+            ) != pool, "replacement landed on the reclaimed pool"
+        assert h.cluster.try_get_node(node.name) is None
+        assert node.name in h.cloud.deleted_nodes
+        # Bounded interruption-to-rebind window: everything above happened
+        # before the reclaim deadline expired.
+        assert h.clock.now() < event.deadline
+        assert_no_leaks(h)
+
+    def test_polite_phase_respects_pdb_and_do_not_evict(self):
+        protected = fixtures.pod(
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"}
+        )
+        guarded = [fixtures.pod(labels={"app": "db"}) for _ in range(2)]
+        h, recorder, pods, node = loaded_harness(pods=[protected] + guarded)
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=2)
+        before = INTERRUPTION_OVERRIDE_TOTAL.get("pdb")
+        h.cloud.inject_interruption(node, deadline_in=120.0)
+
+        h.interruption.reconcile()  # t=0: polite phase — nothing moves
+        for pod in pods:
+            assert h.cluster.get_pod(pod.namespace, pod.name).node_name == node.name
+        live = h.cluster.get_node(node.name)
+        assert live.unschedulable and live.deletion_timestamp is None
+        assert INTERRUPTION_OVERRIDE_TOTAL.get("pdb") == before
+
+    def test_escalation_overrides_pdb_and_do_not_evict_loudly(self):
+        protected = fixtures.pod(
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"}
+        )
+        guarded = [fixtures.pod(labels={"app": "db"}) for _ in range(2)]
+        h, recorder, pods, node = loaded_harness(pods=[protected] + guarded)
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=2)
+        pdb_before = INTERRUPTION_OVERRIDE_TOTAL.get("pdb")
+        dne_before = INTERRUPTION_OVERRIDE_TOTAL.get("do-not-evict")
+        h.cloud.inject_interruption(node, deadline_in=120.0)
+
+        h.interruption.reconcile()  # anchors the escalation window at t=0
+        h.clock.advance(61.0)  # past escalate_fraction (0.5) of the window
+        h.interruption.reconcile()
+        assert h.cluster.get_node(node.name).deletion_timestamp is not None
+        assert INTERRUPTION_OVERRIDE_TOTAL.get("pdb") - pdb_before == 2
+        assert INTERRUPTION_OVERRIDE_TOTAL.get("do-not-evict") - dne_before == 1
+
+        converge(h)
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert_no_leaks(h)
+
+    def test_polite_drain_spends_at_most_the_pdb_budget_per_sweep(self):
+        """A displaced pod is down until it rebinds, so it must stop counting
+        as healthy: with minAvailable=1 over two replicas, one polite sweep
+        may displace exactly ONE — the drain rolls, one budget-worth per
+        rebind, instead of taking the whole deployment down at once."""
+        guarded = [fixtures.pod(labels={"app": "web"}) for _ in range(2)]
+        h, recorder, pods, node = loaded_harness(pods=guarded)
+        h.cluster.apply_pdb("web-pdb", {"app": "web"}, min_available=1)
+        h.cloud.inject_interruption(node, deadline_in=120.0)
+        h.interruption.reconcile()
+        pending = [
+            p
+            for p in pods
+            if h.cluster.get_pod(p.namespace, p.name).node_name is None
+        ]
+        assert len(pending) == 1, "polite sweep overspent the PDB budget"
+        assert h.cluster.get_node(node.name).deletion_timestamp is None
+        # The displaced replica rebinds; the next sweep takes the other.
+        for worker in h.provisioning.workers.values():
+            worker.provision()
+        h.interruption.reconcile()
+        converge(h)
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert_no_leaks(h)
+
+    def test_soft_event_with_a_deadline_still_never_escalates(self):
+        """Escalation requires a HARD kind, not merely a deadline: a
+        rebalance notice that happens to carry one must not buy the right
+        to override protections."""
+        protected = fixtures.pod(
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"}
+        )
+        h, recorder, pods, node = loaded_harness(pods=[protected])
+        before = INTERRUPTION_OVERRIDE_TOTAL.get("do-not-evict")
+        h.cloud.inject_interruption(
+            node, kind=INTERRUPTION_REBALANCE, deadline_in=120.0
+        )
+        h.interruption.reconcile()
+        h.clock.advance(3600.0)
+        h.interruption.reconcile()
+        assert (
+            h.cluster.get_pod(protected.namespace, protected.name).node_name
+            == node.name
+        )
+        assert INTERRUPTION_OVERRIDE_TOTAL.get("do-not-evict") == before
+
+    def test_rebalance_recommendation_drains_politely_without_escalation(self):
+        """A soft event still cordons and replaces, but a protected pod is
+        never overridden — there is no deadline to escalate against."""
+        protected = fixtures.pod(
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"}
+        )
+        plain = fixtures.pod()
+        h, recorder, pods, node = loaded_harness(pods=[plain, protected])
+        h.cloud.inject_interruption(
+            node, kind=INTERRUPTION_REBALANCE, deadline_in=None
+        )
+        h.interruption.reconcile()
+        h.clock.advance(3600.0)
+        h.interruption.reconcile()
+        live = h.cluster.get_node(node.name)
+        assert live.unschedulable
+        assert live.deletion_timestamp is None  # protected pod blocks forever
+        assert (
+            h.cluster.get_pod(protected.namespace, protected.name).node_name
+            == node.name
+        )
+        # The unprotected pod was still displaced for replacement.
+        assert h.cluster.get_pod(plain.namespace, plain.name).node_name is None
+
+    def test_hard_event_upgrades_a_soft_stamp(self):
+        h, recorder, pods, node = loaded_harness(n_pods=1)
+        h.cloud.inject_interruption(
+            node, kind=INTERRUPTION_REBALANCE, deadline_in=None
+        )
+        h.interruption.reconcile()
+        assert (
+            h.cluster.get_node(node.name).annotations[
+                wellknown.INTERRUPTION_KIND_ANNOTATION
+            ]
+            == INTERRUPTION_REBALANCE
+        )
+        h.cloud.inject_interruption(node, kind=INTERRUPTION_SPOT, deadline_in=90.0)
+        h.interruption.reconcile()
+        live = h.cluster.get_node(node.name)
+        assert (
+            live.annotations[wellknown.INTERRUPTION_KIND_ANNOTATION]
+            == INTERRUPTION_SPOT
+        )
+        assert wellknown.INTERRUPTION_DEADLINE_ANNOTATION in live.annotations
+
+    def test_unmatched_event_is_counted_and_acked(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        ghost = NodeSpec(name="ghost", provider_id="fake:///z/fi-ghost")
+        before = INTERRUPTION_UNMATCHED_TOTAL.get()
+        h.cloud.inject_interruption(ghost)
+        h.interruption.reconcile()
+        assert INTERRUPTION_UNMATCHED_TOTAL.get() - before == 1
+        assert h.cloud.poll_interruptions() == []
+
+    def test_event_metrics_by_kind(self):
+        h, recorder, pods, node = loaded_harness(n_pods=1)
+        before = INTERRUPTION_EVENTS_TOTAL.get(INTERRUPTION_SPOT)
+        displaced_before = INTERRUPTION_DISPLACED_TOTAL.get()
+        h.cloud.inject_interruption(node)
+        h.interruption.reconcile()
+        assert INTERRUPTION_EVENTS_TOTAL.get(INTERRUPTION_SPOT) - before == 1
+        assert INTERRUPTION_DISPLACED_TOTAL.get() - displaced_before == 1
+
+
+# Every interruption site, plus mid-drain at its second passage (first pod
+# displaced and fed, controller dies before the rest).
+INTERRUPTION_MATRIX = [
+    (site, 1) for site in crashpoints.INTERRUPTION_SITES
+] + [("interruption.mid-drain", 2)]
+
+
+class TestInterruptionCrashMatrix:
+    """The crash half of the acceptance criteria: the controller killed at
+    every interruption commit point, restarted over the surviving state,
+    and the reclaim still converges — pods rebound exactly once, victim
+    gone, zero leaked instances."""
+
+    @pytest.mark.parametrize(
+        "site,at", INTERRUPTION_MATRIX,
+        ids=[f"{s}@{a}" for s, a in INTERRUPTION_MATRIX],
+    )
+    def test_kill_restart_converges(self, site, at):
+        h, recorder, pods, node = loaded_harness()
+        h.cloud.inject_interruption(node, deadline_in=120.0)
+        crashpoints.arm(site, at=at)
+        with pytest.raises(SimulatedCrash) as crash:
+            h.interruption.reconcile()
+        assert crash.value.site == site
+        restart(h)
+        converge(h)
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert h.cluster.try_get_node(node.name) is None
+        assert_no_leaks(h)
+
+    def test_crash_before_ack_redelivers_the_event(self):
+        """Record-then-ack: a controller that dies after annotating but
+        before acking sees the event again; the re-ingest is idempotent and
+        the second attempt acks it."""
+        h, recorder, pods, node = loaded_harness(n_pods=1)
+        h.cloud.inject_interruption(node)
+        crashpoints.arm("interruption.after-annotate")
+        with pytest.raises(SimulatedCrash):
+            h.interruption.reconcile()
+        assert len(h.cloud.poll_interruptions()) == 1  # still queued
+        assert (
+            wellknown.INTERRUPTION_KIND_ANNOTATION
+            in h.cluster.get_node(node.name).annotations
+        )
+        restart(h)
+        h.interruption.reconcile()
+        assert h.cloud.poll_interruptions() == []

@@ -339,3 +339,44 @@ class TestPodGc:
         assert h.cluster.try_get_pod(
             terminating.namespace, terminating.name
         ) is not None
+
+
+class TestDeletionDrainPath:
+    """Nodes deleted by the lifecycle reconcilers (Liveness/Expiration) must
+    traverse cordon→drain→finalizer — the deletion only MARKS the node (the
+    termination finalizer holds it) and the termination controller drains
+    its pods before the cloud delete; instant removal would strand running
+    pods without eviction."""
+
+    def _assert_traverses_drain(self, h, node, pod):
+        # Deletion marked, object held by the finalizer — NOT instant removal.
+        live = h.cluster.get_node(node.name)
+        assert live is not None and live.deletion_timestamp is not None
+        assert wellknown.TERMINATION_FINALIZER in live.finalizers
+        assert node.name not in h.cloud.deleted_nodes  # cloud delete not yet
+        # First termination reconcile cordons, then drains (evicts the pod).
+        h.termination.reconcile(node.name)
+        assert h.cluster.get_node(node.name).unschedulable
+        h.reconcile_terminations(rounds=3)
+        assert h.cluster.get_pod(pod.namespace, pod.name).is_terminating()
+        # Kubelet finishes the eviction; only then does the node terminate.
+        h.cluster.delete_pod(pod.namespace, pod.name)
+        h.reconcile_terminations()
+        assert h.cluster.try_get_node(node.name) is None
+        assert node.name in h.cloud.deleted_nodes
+
+    def test_liveness_deletion_traverses_drain(self):
+        h = Harness()
+        node, pod = provision_node(h)
+        h.clock.advance(LIVENESS_TIMEOUT_SECONDS + 1)
+        h.node.reconcile(node.name)
+        self._assert_traverses_drain(h, node, pod)
+
+    def test_expiration_deletion_traverses_drain(self):
+        h = Harness()
+        node, pod = provision_node(h, ttl_seconds_until_expired=300)
+        node.ready = True
+        node.status_reported_at = h.clock.now()  # joined: liveness is happy
+        h.clock.advance(301)
+        h.node.reconcile(node.name)
+        self._assert_traverses_drain(h, node, pod)

@@ -201,6 +201,36 @@ class TestManager:
         ), "pods were not provisioned by the threaded runtime"
         assert cluster.list_nodes()
 
+    def test_end_to_end_interruption_replacement(self, manager):
+        """The wired interruption loop, through real threads: a spot reclaim
+        on a loaded node ends with the pod rebound onto replacement capacity
+        and the victim gone — no manual reconcile calls anywhere."""
+        cluster = manager.cluster
+        cluster.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        assert wait_until(lambda: manager.provisioning.worker("default") is not None)
+        pod = PodSpec(name="rt-interrupted", requests={"cpu": "1"}, unschedulable=True)
+        cluster.apply_pod(pod)
+        assert wait_until(
+            lambda: cluster.get_pod(pod.namespace, pod.name).node_name is not None,
+            timeout=15.0,
+        )
+        victim = cluster.get_pod(pod.namespace, pod.name).node_name
+        manager.cloud.inject_interruption(
+            cluster.get_node(victim), deadline_in=120.0
+        )
+
+        def replaced():
+            live = cluster.get_pod(pod.namespace, pod.name)
+            return (
+                live.node_name is not None
+                and live.node_name != victim
+                and cluster.try_get_node(victim) is None
+            )
+
+        assert wait_until(replaced, timeout=20.0), (
+            "interruption did not drain and replace through the runtime"
+        )
+
     def test_reconcile_loop_metrics_published(self, manager):
         """The controllers dashboard reads these series (ref: the reference's
         karpenter-controllers.json graphs workqueue depth, reconcile rate,

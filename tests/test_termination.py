@@ -193,3 +193,128 @@ class TestEvictionPump:
             assert cluster.get_pod(pod.namespace, pod.name).is_terminating()
         finally:
             queue.stop()
+
+
+class TestTerminationObservability:
+    def test_evictions_total_by_result(self):
+        from karpenter_tpu.controllers.termination import EVICTIONS_TOTAL
+
+        h = Harness()
+        pods = [fixtures.pod(labels={"app": "db"}) for _ in range(2)]
+        schedule_pods(h, *pods)
+        node = h.expect_scheduled(pods[0])
+        evicted_before = EVICTIONS_TOTAL.get("evicted")
+        blocked_before = EVICTIONS_TOTAL.get("pdb-blocked")
+        gone_before = EVICTIONS_TOTAL.get("gone")
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=2)
+        h.cluster.delete_node(node.name)
+        h.termination.reconcile(node.name)
+        h.termination.evictions.drain_once()  # both refused by the PDB
+        assert EVICTIONS_TOTAL.get("pdb-blocked") - blocked_before == 2
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=0)
+        h.clock.advance(60)  # clear eviction backoff
+        h.termination.evictions.drain_once()
+        assert EVICTIONS_TOTAL.get("evicted") - evicted_before >= 1
+        # A pod deleted before its eviction pops counts as gone.
+        h.termination.evictions.add(
+            [fixtures.pod(name="already-deleted", namespace="nowhere")]
+        )
+        h.clock.advance(60)
+        h.termination.evictions.drain_once()
+        assert EVICTIONS_TOTAL.get("gone") - gone_before == 1
+
+    def test_drain_duration_observed_on_terminate(self):
+        from karpenter_tpu.controllers.termination import NODE_DRAIN_DURATION
+
+        h = Harness()
+        (node,) = schedule_pods(h, fixtures.pod())
+        before = NODE_DRAIN_DURATION.count()
+        h.cluster.delete_node(node.name)
+        h.termination.reconcile(node.name)  # drain starts the clock
+        h.clock.advance(7)
+        for pod in h.cluster.list_pods(node_name=node.name):
+            h.cluster.delete_pod(pod.namespace, pod.name)
+        h.reconcile_terminations()
+        assert h.cluster.try_get_node(node.name) is None
+        assert NODE_DRAIN_DURATION.count() - before == 1
+
+
+class TestStuckDrainVisibility:
+    def test_stalled_drain_counts_and_logs_once(self):
+        import logging
+
+        from karpenter_tpu.controllers.termination import (
+            DRAIN_STALLED_TOTAL,
+            TerminationController,
+        )
+
+        h = Harness()
+        protected = fixtures.pod(
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"}
+        )
+        (node,) = schedule_pods(h, protected)
+        before = DRAIN_STALLED_TOTAL.get("do-not-evict")
+        # Capture at the controller's own logger (klog handler config varies
+        # across the suite, so caplog's root-propagation capture is not
+        # reliable here).
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        h.termination.log.addHandler(handler)
+        try:
+            h.cluster.delete_node(node.name)
+            rounds = TerminationController.STALL_RECONCILES + 5
+            for _ in range(rounds):
+                assert h.termination.reconcile(node.name) is not None
+        finally:
+            h.termination.log.removeHandler(handler)
+        assert DRAIN_STALLED_TOTAL.get("do-not-evict") - before == 1
+        stall_logs = [r for r in records if "stalled" in r.getMessage()]
+        assert len(stall_logs) == 1  # logged once per episode
+        assert protected.name in stall_logs[0].getMessage()
+
+    def test_pdb_blocked_stall_counts_pdb_reason(self):
+        from karpenter_tpu.controllers.termination import (
+            DRAIN_STALLED_TOTAL,
+            TerminationController,
+        )
+
+        h = Harness()
+        pods = [fixtures.pod(labels={"app": "db"}) for _ in range(2)]
+        schedule_pods(h, *pods)
+        node = h.expect_scheduled(pods[0])
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=2)
+        before = DRAIN_STALLED_TOTAL.get("pdb")
+        h.cluster.delete_node(node.name)
+        for _ in range(TerminationController.STALL_RECONCILES + 2):
+            h.termination.reconcile(node.name)
+            h.termination.evictions.drain_once()
+        assert DRAIN_STALLED_TOTAL.get("pdb") - before == 1
+
+    def test_progress_resets_the_stall_episode(self):
+        from karpenter_tpu.controllers.termination import (
+            DRAIN_STALLED_TOTAL,
+            TerminationController,
+        )
+
+        h = Harness()
+        pods = fixtures.pods(2)
+        schedule_pods(h, *pods)
+        node = h.expect_scheduled(pods[0])
+        before = (
+            DRAIN_STALLED_TOTAL.get("pdb")
+            + DRAIN_STALLED_TOTAL.get("do-not-evict")
+        )
+        h.cluster.delete_node(node.name)
+        half = TerminationController.STALL_RECONCILES // 2
+        for _ in range(half):
+            h.termination.reconcile(node.name)
+        # Eviction lands (progress: pods flip to terminating) — episode resets.
+        h.termination.evictions.drain_once()
+        for _ in range(TerminationController.STALL_RECONCILES - 1):
+            h.termination.reconcile(node.name)
+        assert (
+            DRAIN_STALLED_TOTAL.get("pdb")
+            + DRAIN_STALLED_TOTAL.get("do-not-evict")
+            == before
+        )

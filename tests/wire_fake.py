@@ -61,6 +61,8 @@ class WireFakeTransport(HttpTransport):
         assert "X-Amz-Date" in headers
         if headers.get("X-Amz-Target", "").startswith("AmazonSSM."):
             return self._handle_ssm(headers["X-Amz-Target"], body)
+        if headers.get("X-Amz-Target", "").startswith("AmazonSQS."):
+            return self._handle_sqs(headers["X-Amz-Target"], body)
         params = dict(urllib.parse.parse_qsl(body.decode(), keep_blank_values=True))
         action = params.pop("Action", "")
         params.pop("Version", None)
@@ -424,6 +426,30 @@ class WireFakeTransport(HttpTransport):
         )
 
 
+    def _handle_sqs(self, target: str, body: bytes) -> HttpResponse:
+        """The interruption queue over the wire: ReceiveMessage leaves
+        messages re-deliverable (visibility model), DeleteMessage acks."""
+        payload = json.loads(body)
+        if target == "AmazonSQS.ReceiveMessage":
+            messages = [
+                {
+                    "MessageId": m.message_id,
+                    "ReceiptHandle": m.receipt_handle,
+                    "Body": m.body,
+                }
+                for m in self.fake.receive_queue_messages()
+            ]
+            return HttpResponse(
+                status=200, body=json.dumps({"Messages": messages}).encode()
+            )
+        if target == "AmazonSQS.DeleteMessage":
+            self.fake.delete_queue_message(payload.get("ReceiptHandle", ""))
+            return HttpResponse(status=200, body=b"{}")
+        return HttpResponse(
+            status=400, body=json.dumps({"__type": "InvalidAction"}).encode()
+        )
+
+
 class FlakyTransport(HttpTransport):
     """Wraps a real transport with a deterministic fault schedule: every
     `period`-th request is answered with a throttle/5xx/socket failure
@@ -512,6 +538,11 @@ def wire_api(
         retry_policy=retry_policy,
         price_catalog=price_catalog,
         spot_price_ratio=0.6,
+        # Interruption feed: route receive/delete over the wire to the
+        # fake's injectable queue.
+        interruption_queue_url=(
+            "https://sqs.us-test-1.amazonaws.com/000000000000/interruptions"
+        ),
         # The wire carries no branch-interface counts; like the reference's
         # static vpc-resource-controller limits table, they ship as config.
         branch_interfaces={

@@ -1,0 +1,194 @@
+"""Preemption-storm chaos harness: N staggered interruptions, crashpoints
+armed mid-storm, convergence asserted.
+
+The deterministic interruption matrix lives in tests/test_interruption.py;
+this tool is the storm: a fleet of loaded nodes, spot reclaims landing one
+after another (some while the previous drain is still running), PDB-guarded
+pods forcing deadline escalation, and the controller process "killed" at a
+rotating interruption crashpoint every few events and rebuilt over the
+surviving state. At the end every pod must be bound to a live node, every
+interrupted node gone, every event acked, and the leaked-capacity GC must
+find nothing to reap. `make interruption-smoke` wraps this in a hard 120s
+timeout so a drain that re-grows an unbounded wait fails fast.
+
+Runs entirely on the fake provider + fake clock — no wall-clock sleeps.
+"""
+
+import sys
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+NODES = 6
+PODS_PER_NODE = 4
+
+
+def build():
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.cluster import Cluster
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    cluster = Cluster(clock=clock)
+    cloud = FakeCloudProvider(clock=clock)
+    state = {"clock": clock, "cluster": cluster, "cloud": cloud}
+    restart(state)
+    cluster.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    state["provisioning"].reconcile("default")
+    return state
+
+
+def restart(state) -> None:
+    """Fresh controllers over the surviving cluster + cloud — what a
+    supervisor restart observes."""
+    from karpenter_tpu.controllers.instancegc import InstanceGcController
+    from karpenter_tpu.controllers.interruption import InterruptionController
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.selection import SelectionController
+    from karpenter_tpu.controllers.termination import TerminationController
+
+    cluster, cloud = state["cluster"], state["cloud"]
+    state["provisioning"] = ProvisioningController(cluster, cloud, None)
+    state["selection"] = SelectionController(cluster, state["provisioning"])
+    state["termination"] = TerminationController(cluster, cloud)
+    state["instancegc"] = InstanceGcController(cluster, cloud)
+    state["interruption"] = InterruptionController(
+        cluster, cloud, state["provisioning"], state["termination"]
+    )
+    for provisioner in cluster.list_provisioners():
+        state["provisioning"].reconcile(provisioner.name)
+    for pod in cluster.list_pods():
+        if pod.is_provisionable():
+            state["selection"].reconcile(pod.namespace, pod.name)
+
+
+def step(state) -> None:
+    """One control-plane beat: interruption sweep, provision, terminations."""
+    state["interruption"].reconcile()
+    for worker in list(state["provisioning"].workers.values()):
+        worker.provision()
+    for node in list(state["cluster"].list_nodes()):
+        state["termination"].reconcile(node.name)
+    state["termination"].evictions.drain_once()
+
+
+def load(state):
+    from tests import fixtures
+
+    pods = fixtures.pods(NODES * PODS_PER_NODE, cpu="4")
+    # A PDB tight enough that polite displacement stalls and the deadline
+    # escalation has to fire for some victims.
+    for pod in pods[: PODS_PER_NODE]:
+        pod.labels["app"] = "guarded"
+    state["cluster"].apply_pdb(
+        "guarded", {"app": "guarded"}, min_available=PODS_PER_NODE
+    )
+    for pod in pods:
+        state["cluster"].apply_pod(pod)
+        state["selection"].reconcile(pod.namespace, pod.name)
+    for worker in state["provisioning"].workers.values():
+        worker.provision()
+    for pod in pods:
+        live = state["cluster"].get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} never scheduled"
+    return pods
+
+
+def storm(state):
+    """Stagger an interruption per loaded node; arm a rotating crashpoint on
+    every other event and restart over the wreckage. Returns (crash count,
+    names of every node interrupted)."""
+    from karpenter_tpu.utils import crashpoints
+    from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+    interrupted = set()
+    crashes = 0
+    for round_index in range(NODES):
+        victims = [
+            n
+            for n in state["cluster"].list_nodes()
+            if n.name not in interrupted
+            and n.deletion_timestamp is None
+            and state["cluster"].list_pods(node_name=n.name)
+        ]
+        if not victims:
+            break
+        victim = sorted(victims, key=lambda n: n.name)[0]
+        interrupted.add(victim.name)
+        state["cloud"].inject_interruption(victim, deadline_in=120.0)
+        if round_index % 2 == 1:
+            site = crashpoints.INTERRUPTION_SITES[
+                (round_index // 2) % len(crashpoints.INTERRUPTION_SITES)
+            ]
+            crashpoints.arm(site)
+            try:
+                step(state)
+            except SimulatedCrash as crash:
+                crashes += 1
+                print(f"  killed at {crash.site}; restarting")
+                restart(state)
+        step(state)
+        # Half a beat of clock per event: drains overlap, and the guarded
+        # pods cross the escalation fraction mid-storm.
+        state["clock"].advance(61.0)
+        step(state)
+    assert interrupted, "storm interrupted nothing"
+    return crashes, interrupted
+
+
+def settle_and_verify(state, pods, interrupted_names) -> None:
+    from karpenter_tpu.controllers.instancegc import LAUNCH_GRACE_SECONDS
+
+    for _ in range(8):
+        step(state)
+    cluster, cloud = state["cluster"], state["cloud"]
+    lingering = interrupted_names & {n.name for n in cluster.list_nodes()}
+    assert not lingering, f"interrupted nodes never deleted: {sorted(lingering)}"
+    for pod in pods:
+        live = cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} lost in the storm"
+        node = cluster.try_get_node(live.node_name)
+        assert node is not None, f"{pod.name} bound to vanished node"
+        assert node.deletion_timestamp is None, (
+            f"{pod.name} still bound to dying node {node.name}"
+        )
+    assert cloud.poll_interruptions() == [], "unacked interruption events"
+    nodes = cluster.list_nodes()
+    provider_ids = [n.provider_id for n in nodes]
+    assert len(provider_ids) == len(set(provider_ids)), "duplicate instances"
+    state["clock"].advance(LAUNCH_GRACE_SECONDS + 1)
+    state["instancegc"].reconcile()
+    state["instancegc"].reconcile()
+    leaked = set(cloud.instances) - {n.provider_id for n in cluster.list_nodes()}
+    assert not leaked, f"leaked instances after GC grace: {sorted(leaked)}"
+
+
+def main() -> int:
+    began = time.time()
+    try:
+        state = build()
+        pods = load(state)
+        node_names = {
+            state["cluster"].get_pod(p.namespace, p.name).node_name for p in pods
+        }
+        print(
+            f"interruption-smoke: {len(pods)} pods on {len(node_names)} nodes; "
+            "starting preemption storm"
+        )
+        crashes, interrupted = storm(state)
+        settle_and_verify(state, pods, interrupted)
+    except AssertionError as failure:
+        print(f"interruption-smoke: FAIL in {time.time() - began:.1f}s: {failure}")
+        return 1
+    print(
+        f"interruption-smoke: OK in {time.time() - began:.1f}s "
+        f"({NODES} staggered reclaims, {crashes} mid-storm crash+restarts, "
+        "0 leaked instances, all pods rebound)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
