@@ -1,9 +1,18 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test battletest degraded-smoke crash-smoke interruption-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
+
+# The unified AST vet suite (tools/vet/): lock-discipline, blocking-under-
+# lock, crash-safety, clock-discipline, metrics-consistency, plus the two
+# backend-ownership checks — the Python analogue of the `go vet` + race-
+# detector gate the reference's battletest fronts every change with
+# (ref Makefile:33-38). Findings print as `file:line checker message`.
+# Scan a scratch tree: python -m tools.vet path/to/file.py
+vet:
+	python -m tools.vet
 
 # The reference's battletest runs its suites under the race detector with
 # randomized parallel specs (ref Makefile:33-38). The analogue here:
@@ -18,6 +27,7 @@ test:
 battletest:
 	rc=0; \
 	python tools/complexity_gate.py || rc=1; \
+	python -m tools.vet || rc=1; \
 	KARPENTER_RANDOM_ORDER=auto python -m pytest tests/ -q --tb=long || rc=1; \
 	KARPENTER_BATTLETEST=1 python -m pytest tests/test_battletest.py tests/test_spmd.py -q --tb=long -s || rc=1; \
 	exit $$rc

@@ -47,7 +47,7 @@ from karpenter_tpu.cloudprovider.ec2.vendor import (
     Ec2Provider,
     default_provider_blob,
 )
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 from karpenter_tpu.utils.workqueue import RateLimiter
 
 # Fleet-call throttle (ref: aws/cloudprovider.go:41-46).
@@ -90,7 +90,7 @@ class Ec2CloudProvider(CloudProvider):
         ca_bundle: Optional[str] = None,
         clock: Optional[Clock] = None,
     ):
-        self.clock = clock or Clock()
+        self.clock = clock or SYSTEM_CLOCK
         self.cluster_name = cluster_name
         self.api: Ec2Api = api if api is not None else FakeEc2(cluster_name=cluster_name)
         self.subnets = SubnetProvider(self.api, self.clock)

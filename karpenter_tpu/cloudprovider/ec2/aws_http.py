@@ -26,7 +26,6 @@ import hmac
 import json
 import os
 import random
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -52,6 +51,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
 )
 
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.clock import SYSTEM_CLOCK
 from karpenter_tpu.utils.metrics import REGISTRY
 
 log = klog.named("aws")
@@ -165,7 +165,7 @@ class RetryPolicy:
     base_delay: float = 0.03
     throttle_base: float = 0.5
     max_delay: float = 20.0
-    sleep: Callable[[float], None] = time.sleep
+    sleep: Callable[[float], None] = SYSTEM_CLOCK.sleep
     rng: Callable[[], float] = random.random
 
     def is_retryable(self, code: str) -> bool:

@@ -33,7 +33,7 @@ from karpenter_tpu.cloudprovider.ec2.instancetypes import InstanceTypeProvider
 from karpenter_tpu.cloudprovider.ec2.launchtemplates import LaunchTemplateProvider
 from karpenter_tpu.cloudprovider.ec2.network import SubnetProvider
 from karpenter_tpu.cloudprovider.ec2.vendor import Ec2Provider, merge_tags
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 from karpenter_tpu.utils.crashpoints import crashpoint
 
 DESCRIBE_RETRY_ATTEMPTS = 3  # ref: instance.go:57-61
@@ -88,7 +88,7 @@ class InstanceProvider:
         self.subnet_provider = subnet_provider
         self.launch_template_provider = launch_template_provider
         self.cluster_name = cluster_name
-        self.clock = clock or Clock()
+        self.clock = clock or SYSTEM_CLOCK
 
     def create(
         self,

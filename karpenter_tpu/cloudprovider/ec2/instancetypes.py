@@ -18,7 +18,7 @@ from karpenter_tpu.cloudprovider.ec2.api import Ec2Api, InstanceTypeInfo
 from karpenter_tpu.cloudprovider.ec2.network import SubnetProvider
 from karpenter_tpu.cloudprovider.ec2.vendor import Ec2Provider
 from karpenter_tpu.utils.cache import TtlCache
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 
 # The VM consumes <7.5% of machine memory (ref: instancetype.go:31-32).
 VM_AVAILABLE_MEMORY_FACTOR = 0.925
@@ -119,7 +119,7 @@ class InstanceTypeProvider:
         subnet_provider: SubnetProvider,
         clock: Optional[Clock] = None,
     ):
-        clock = clock or Clock()
+        clock = clock or SYSTEM_CLOCK
         self.api = api
         self.subnet_provider = subnet_provider
         # Catalog cached *before* ICE filtering so blackouts apply instantly

@@ -30,7 +30,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
 from karpenter_tpu.cloudprovider.ec2.network import SecurityGroupProvider
 from karpenter_tpu.cloudprovider.ec2.vendor import Ec2Provider, merge_tags
 from karpenter_tpu.utils.cache import TtlCache
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 
 LAUNCH_TEMPLATE_NAME_FORMAT = "KarpenterTPU-{cluster}-{hash}"
 
@@ -48,7 +48,7 @@ class AmiProvider:
     ):
         self.api = api
         self.kube_version = kube_version
-        self._cache = TtlCache(SETUP_CACHE_TTL, clock or Clock())
+        self._cache = TtlCache(SETUP_CACHE_TTL, clock or SYSTEM_CLOCK)
         self._lock = threading.Lock()
 
     def get(
@@ -158,7 +158,7 @@ class LaunchTemplateProvider:
         self.cluster_name = cluster_name
         self.cluster_endpoint = cluster_endpoint
         self.ca_bundle = ca_bundle
-        self._cache = TtlCache(SETUP_CACHE_TTL, clock or Clock())
+        self._cache = TtlCache(SETUP_CACHE_TTL, clock or SYSTEM_CLOCK)
         self._lock = threading.Lock()
 
     def get(

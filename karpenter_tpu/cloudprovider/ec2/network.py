@@ -22,7 +22,7 @@ from karpenter_tpu.cloudprovider.ec2.vendor import (
     Ec2Provider,
 )
 from karpenter_tpu.utils.cache import TtlCache
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 
 class NoMatchError(Exception):
     """Selector matched nothing (ref: subnets.go:43-45, securitygroups.go:47)."""
@@ -37,7 +37,7 @@ class SubnetProvider:
 
     def __init__(self, api: Ec2Api, clock: Optional[Clock] = None):
         self.api = api
-        self._cache = TtlCache(SETUP_CACHE_TTL, clock or Clock())
+        self._cache = TtlCache(SETUP_CACHE_TTL, clock or SYSTEM_CLOCK)
         self._lock = threading.Lock()
 
     def get(self, provider: Ec2Provider) -> List[Subnet]:
@@ -62,7 +62,7 @@ class SecurityGroupProvider:
     ):
         self.api = api
         self.cluster_name = cluster_name
-        self._cache = TtlCache(SETUP_CACHE_TTL, clock or Clock())
+        self._cache = TtlCache(SETUP_CACHE_TTL, clock or SYSTEM_CLOCK)
         self._lock = threading.Lock()
 
     def get(self, provider: Ec2Provider) -> List[str]:

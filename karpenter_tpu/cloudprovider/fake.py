@@ -12,7 +12,6 @@ from __future__ import annotations
 import copy
 import itertools
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from karpenter_tpu.api import wellknown
@@ -28,6 +27,7 @@ from karpenter_tpu.cloudprovider import (
     NodeSpec,
     Offering,
 )
+from karpenter_tpu.utils.clock import SYSTEM_CLOCK
 from karpenter_tpu.utils.crashpoints import crashpoint
 
 ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
@@ -133,7 +133,7 @@ class FakeCloudProvider(CloudProvider):
         self._instance_types = (
             list(instance_types) if instance_types is not None else default_instance_types()
         )
-        self.clock = clock
+        self.clock = clock or SYSTEM_CLOCK
         self.create_calls: List[Tuple[Constraints, List[str], int]] = []
         self.deleted_nodes: List[str] = []
         # Crash-consistency surfaces: every live instance this cloud is
@@ -142,20 +142,20 @@ class FakeCloudProvider(CloudProvider):
         # controller ADOPTS instead of re-buying), and a per-call log of
         # (launch_id, quantity, adopted, launched) — the ClientToken
         # analogue the crash battletest asserts determinism on.
-        self.instances: Dict[str, CloudInstance] = {}
+        self.instances: Dict[str, CloudInstance] = {}  # vet: guarded-by(self._lock)
         self.terminated_instances: List[str] = []
-        self._launches: Dict[str, List[NodeSpec]] = {}
+        self._launches: Dict[str, List[NodeSpec]] = {}  # vet: guarded-by(self._lock)
         self.launch_log: List[Dict] = []
         # (instance_type, zone, capacity_type) triples that fail with ICE
         # (ref: aws/fake/ec2api.go InsufficientCapacityPools:54).
         self.insufficient_capacity_pools: Set[Tuple[str, str, str]] = set()
         # Offering blackout cache (ref: aws/instancetypes.go:174-183).
-        self._unavailable: Dict[Tuple[str, str, str], float] = {}
+        self._unavailable: Dict[Tuple[str, str, str], float] = {}  # vet: guarded-by(self._lock)
         # Injectable interruption feed: event_id -> event, delivered by
         # poll_interruptions until acked (the SQS at-least-once model), so
         # crash tests can kill the controller between observing and
         # recording an event and still see it re-delivered.
-        self._interruptions: Dict[str, InterruptionEvent] = {}
+        self._interruptions: Dict[str, InterruptionEvent] = {}  # vet: guarded-by(self._lock)
         self._event_ids = itertools.count(1)
         self.acked_interruptions: List[str] = []
         self._lock = threading.Lock()
@@ -163,7 +163,7 @@ class FakeCloudProvider(CloudProvider):
     # --- helpers ------------------------------------------------------------
 
     def _now(self) -> float:
-        return self.clock.now() if self.clock is not None else time.time()
+        return self.clock.now()
 
     def set_instance_types(self, instance_types: List[InstanceType]) -> None:
         self._instance_types = list(instance_types)

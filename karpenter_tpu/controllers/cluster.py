@@ -18,7 +18,7 @@ from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.cloudprovider import NodeSpec
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 
 PodKey = Tuple[str, str]  # (namespace, name)
 
@@ -47,14 +47,14 @@ class AlreadyExistsError(Exception):
 
 class Cluster:
     def __init__(self, clock: Optional[Clock] = None):
-        self.clock = clock or Clock()
+        self.clock = clock or SYSTEM_CLOCK
         self._lock = threading.RLock()
-        self._pods: Dict[PodKey, PodSpec] = {}
-        self._nodes: Dict[str, NodeSpec] = {}
-        self._provisioners: Dict[str, Provisioner] = {}
-        self._daemonsets: Dict[str, PodSpec] = {}  # name -> pod template
-        self._pdbs: Dict[str, Tuple[Dict[str, str], int]] = {}  # selector, minAvailable
-        self._leases: Dict[str, Tuple[str, float]] = {}  # name -> (holder, expiry)
+        self._pods: Dict[PodKey, PodSpec] = {}  # vet: guarded-by(self._lock)
+        self._nodes: Dict[str, NodeSpec] = {}  # vet: guarded-by(self._lock)
+        self._provisioners: Dict[str, Provisioner] = {}  # vet: guarded-by(self._lock)
+        self._daemonsets: Dict[str, PodSpec] = {}  # vet: guarded-by(self._lock) — name -> pod template
+        self._pdbs: Dict[str, Tuple[Dict[str, str], int]] = {}  # vet: guarded-by(self._lock) — selector, minAvailable
+        self._leases: Dict[str, Tuple[str, float]] = {}  # vet: guarded-by(self._lock) — name -> (holder, expiry)
         self._watchers: List[Callable[[str, object], None]] = []
 
     # --- watch plumbing ----------------------------------------------------
@@ -90,7 +90,7 @@ class Cluster:
         # object — the same guarantee the lock gave a point read. This is
         # THE hottest read in a pod storm (one per selection reconcile),
         # and 128 selection workers convoyed on the cluster lock here.
-        return self._pods.get((namespace, name))
+        return self._pods.get((namespace, name))  # vet: unguarded(GIL-atomic point read; rationale above)
 
     def list_pods(
         self,
@@ -200,7 +200,9 @@ class Cluster:
         latency, so it must not count toward the budget — otherwise one
         polite drain sweep could displace every replica behind a PDB, each
         step still seeing the previous victims as 'healthy'."""
-        for match_labels, min_available in self._pdbs.values():
+        with self._lock:
+            pdbs = list(self._pdbs.values())
+        for match_labels, min_available in pdbs:
             if not all(pod.labels.get(k) == v for k, v in match_labels.items()):
                 continue
             with self._lock:
@@ -254,7 +256,7 @@ class Cluster:
 
     def try_get_node(self, name: str) -> Optional[NodeSpec]:
         # Lock-free point read — same GIL-atomicity argument as try_get_pod.
-        return self._nodes.get(name)
+        return self._nodes.get(name)  # vet: unguarded(GIL-atomic point read; same argument as try_get_pod)
 
     def list_nodes(
         self, predicate: Optional[Callable[[NodeSpec], bool]] = None
@@ -299,7 +301,7 @@ class Cluster:
 
     def try_get_provisioner(self, name: str) -> Optional[Provisioner]:
         # Lock-free point read — same GIL-atomicity argument as try_get_pod.
-        return self._provisioners.get(name)
+        return self._provisioners.get(name)  # vet: unguarded(GIL-atomic point read; same argument as try_get_pod)
 
     def list_provisioners(self) -> List[Provisioner]:
         # Copy under the lock, sort OUTSIDE it (the list_pods/list_nodes

@@ -138,7 +138,7 @@ class ProvisionerWorker:
         self.cloud = cloud
         self.solver = solver or GreedySolver()
         self.scheduler = Scheduler(cluster)
-        self._pending: List[PodSpec] = []
+        self._pending: List[PodSpec] = []  # vet: guarded-by(self._lock)
         # Pods beyond the batch cap wait HERE, not in the selection queue: a
         # 50k-pod storm would otherwise need every overflowed pod
         # re-reconciled (1 Hz re-verify) to refill each 2000-pod batch —
@@ -146,11 +146,11 @@ class ProvisionerWorker:
         # reference survives that shape with 10k network-parked reconciles
         # (selection/controller.go:166); this runtime holds the backlog in
         # the worker and refills the window directly at each drain.
-        self._overflow: List[PodSpec] = []
-        self._pending_uids: set = set()
+        self._overflow: List[PodSpec] = []  # vet: guarded-by(self._lock)
+        self._pending_uids: set = set()  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
-        self._first_add: Optional[float] = None
-        self._last_add: Optional[float] = None
+        self._first_add: Optional[float] = None  # vet: guarded-by(self._lock)
+        self._last_add: Optional[float] = None  # vet: guarded-by(self._lock)
         self._node_seq = 0
 
     # --- batching (ref: provisioner.go:137-163) -----------------------------

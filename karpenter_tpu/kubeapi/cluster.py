@@ -21,7 +21,6 @@ both (tests/test_backend_parity.py).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, Optional, Tuple
 
 from karpenter_tpu.api import wellknown
@@ -76,13 +75,13 @@ class ApiServerCluster(Cluster):
     def __init__(self, client: KubeClient, clock: Optional[Clock] = None):
         super().__init__(clock)
         self.api = client
-        self._rv: Dict[Tuple[str, object], int] = {}
+        self._rv: Dict[Tuple[str, object], int] = {}  # vet: guarded-by(self._rv_lock)
         # Deletion tombstones: key -> (deletion rv, monotonic stamp). A
         # deleted key's rv entry can't just be popped — a stale MODIFIED
         # replayed after the DELETED event would pass _newer and resurrect
         # the object in the cache (the client-go informer solves this with
         # DeletedFinalStateUnknown tombstones).
-        self._tombstones: Dict[Tuple[str, object], Tuple[int, float]] = {}
+        self._tombstones: Dict[Tuple[str, object], Tuple[int, float]] = {}  # vet: guarded-by(self._rv_lock)
         self._rv_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
@@ -228,7 +227,7 @@ class ApiServerCluster(Cluster):
         Prune cost: insertion order IS stamp order (appended with a fresh
         monotonic stamp), so expiry pops from the front and stops at the
         first live entry — O(expired) per delete, never a full scan."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         self._rv.pop(key, None)
         cutoff = now - self.TOMBSTONE_TTL_S
         while self._tombstones:

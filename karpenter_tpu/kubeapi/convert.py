@@ -118,11 +118,9 @@ def pod_requests(spec: dict) -> ResourceList:
     return totals
 
 
-def pod_from_kube(obj: dict) -> PodSpec:
-    metadata = obj.get("metadata") or {}
-    spec = obj.get("spec") or {}
-    status = obj.get("status") or {}
-
+def _node_affinity_from_kube(spec: dict):
+    """(required_terms, match_fields_terms, preferred_terms) from the kube
+    nodeAffinity stanza."""
     affinity = (spec.get("affinity") or {}).get("nodeAffinity") or {}
     required = affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
     required_terms: List[List[Requirement]] = []
@@ -133,7 +131,11 @@ def pod_from_kube(obj: dict) -> PodSpec:
             required_terms.append([_expr_to_requirement(e) for e in exprs])
         for field_expr in term.get("matchFields") or []:
             match_fields_terms.append(dict(field_expr))
-    preferred_terms = [
+    return required_terms, match_fields_terms, _preferred_terms_from_kube(affinity)
+
+
+def _preferred_terms_from_kube(affinity: dict) -> List[PreferredTerm]:
+    return [
         PreferredTerm(
             weight=int(item.get("weight", 1)),
             requirements=[
@@ -145,30 +147,73 @@ def pod_from_kube(obj: dict) -> PodSpec:
         or []
     ]
 
+
+def _pod_affinity_from_kube(spec: dict):
+    """(pod_affinity_terms, pod_anti_affinity_terms) — raw kube term dicts,
+    the scheduler consumes them directly."""
     pod_aff = (spec.get("affinity") or {}).get("podAffinity") or {}
     pod_anti = (spec.get("affinity") or {}).get("podAntiAffinity") or {}
-    pod_affinity_terms = list(
-        pod_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []
-    )
-    pod_anti_affinity_terms = list(
-        pod_anti.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+    return (
+        list(pod_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []),
+        list(pod_anti.get("requiredDuringSchedulingIgnoredDuringExecution") or []),
     )
 
-    unschedulable = False
+
+def _tolerations_from_kube(spec: dict) -> List[Toleration]:
+    return [
+        Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in spec.get("tolerations", []) or []
+    ]
+
+
+def _topology_spread_from_kube(spec: dict) -> List[TopologySpreadConstraint]:
+    return [
+        TopologySpreadConstraint(
+            max_skew=int(c.get("maxSkew", 1)),
+            topology_key=c.get("topologyKey", ""),
+            when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
+            match_labels=dict(
+                (c.get("labelSelector") or {}).get("matchLabels") or {}
+            ),
+        )
+        for c in spec.get("topologySpreadConstraints", []) or []
+    ]
+
+
+def _unschedulable_from_kube(status: dict) -> bool:
+    """The PodScheduled=False/Unschedulable condition the reference keys
+    provisioning on."""
     for condition in status.get("conditions", []) or []:
         if (
             condition.get("type") == "PodScheduled"
             and condition.get("status") == "False"
             and condition.get("reason") == "Unschedulable"
         ):
-            unschedulable = True
+            return True
+    return False
 
+
+def _owner_kind_from_kube(metadata: dict) -> Optional[str]:
+    """The controlling owner's kind; first owner's kind as fallback."""
     owner_kind = None
     for owner in metadata.get("ownerReferences", []) or []:
         if owner.get("controller"):
-            owner_kind = owner.get("kind")
-            break
+            return owner.get("kind")
         owner_kind = owner_kind or owner.get("kind")
+    return owner_kind
+
+
+def pod_from_kube(obj: dict) -> PodSpec:
+    metadata = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    required_terms, match_fields_terms, preferred_terms = _node_affinity_from_kube(spec)
+    pod_affinity_terms, pod_anti_affinity_terms = _pod_affinity_from_kube(spec)
 
     pod = PodSpec(
         name=metadata.get("name", ""),
@@ -180,33 +225,15 @@ def pod_from_kube(obj: dict) -> PodSpec:
         required_terms=required_terms,
         match_fields_terms=match_fields_terms,
         preferred_terms=preferred_terms,
-        tolerations=[
-            Toleration(
-                key=t.get("key", ""),
-                operator=t.get("operator", "Equal"),
-                value=t.get("value", ""),
-                effect=t.get("effect", ""),
-            )
-            for t in spec.get("tolerations", []) or []
-        ],
-        topology_spread=[
-            TopologySpreadConstraint(
-                max_skew=int(c.get("maxSkew", 1)),
-                topology_key=c.get("topologyKey", ""),
-                when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
-                match_labels=dict(
-                    (c.get("labelSelector") or {}).get("matchLabels") or {}
-                ),
-            )
-            for c in spec.get("topologySpreadConstraints", []) or []
-        ],
+        tolerations=_tolerations_from_kube(spec),
+        topology_spread=_topology_spread_from_kube(spec),
         pod_affinity_terms=pod_affinity_terms,
         pod_anti_affinity_terms=pod_anti_affinity_terms,
-        owner_kind=owner_kind,
+        owner_kind=_owner_kind_from_kube(metadata),
         priority_class_name=spec.get("priorityClassName", ""),
         phase=status.get("phase", "Pending"),
         node_name=spec.get("nodeName") or None,
-        unschedulable=unschedulable,
+        unschedulable=_unschedulable_from_kube(status),
         deletion_timestamp=from_rfc3339(metadata.get("deletionTimestamp")),
     )
     if metadata.get("uid"):

@@ -85,11 +85,11 @@ class ReconcileLoop:
         # residual cost of the 128-thread pod storm). chunk=1 loops keep
         # per-key notifies: their reconciles block on RPCs, where per-key
         # parallelism is the point.
-        self._waiting = 0
-        self._pops = 0  # chunk pops ever (start()'s grabbed-work escape)
-        self._heap: list = []  # (due_time, seq, key)
-        self._queued: set = set()
-        self._due: dict = {}  # key -> earliest pending due time
+        self._waiting = 0  # vet: guarded-by(self._cv)
+        self._pops = 0  # vet: guarded-by(self._cv) — chunk pops ever (start()'s grabbed-work escape)
+        self._heap: list = []  # vet: guarded-by(self._cv) — (due_time, seq, key)
+        self._queued: set = set()  # vet: guarded-by(self._cv)
+        self._due: dict = {}  # vet: guarded-by(self._cv) — key -> earliest pending due time
         self._cv = threading.Condition()
         self._seq = 0
         self._stop = False
@@ -106,7 +106,7 @@ class ReconcileLoop:
             # concurrently still reconciles state at least as new as the
             # event's. Bind fan-out storms re-enqueue the same few node keys
             # tens of thousands of times; this keeps them off the lock.
-            due = self._due.get(key)
+            due = self._due.get(key)  # vet: unguarded(GIL-atomic dict read; rationale above)
             if due is not None and due <= _time.monotonic():
                 return
         with self._cv:

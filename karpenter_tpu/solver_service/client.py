@@ -11,7 +11,6 @@ ref: aws/instancetypes.go:37,174-183).
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Optional
 
 import grpc
@@ -29,6 +28,7 @@ from karpenter_tpu.ops.encode import InstanceFleet, PodGroups
 from karpenter_tpu.solver_service import solver_pb2 as pb
 from karpenter_tpu.solver_service import wire
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.clock import SYSTEM_CLOCK
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.tracing import TRACER
 
@@ -72,7 +72,7 @@ class RemoteSolver(Solver):
         fallback: Optional[Solver] = None,
         timeout_s: float = DEFAULT_TIMEOUT_SECONDS,
         blackout_s: float = BLACKOUT_SECONDS,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = SYSTEM_CLOCK.monotonic,
     ):
         self.endpoint = endpoint
         self.mode = mode

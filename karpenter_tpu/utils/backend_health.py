@@ -53,7 +53,7 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
 from karpenter_tpu.utils import logging as klog
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 from karpenter_tpu.utils.metrics import REGISTRY
 
 log = klog.named("backend-health")
@@ -183,15 +183,15 @@ class BackendHealth:
         ttl_s: float = VERDICT_TTL_SECONDS,
     ):
         self._probe = probe or run_subprocess_probe
-        self._clock = clock or Clock()
+        self._clock = clock or SYSTEM_CLOCK
         self.timeout_s = timeout_s
         self.ttl_s = ttl_s
         self._lock = threading.RLock()
-        self._state = UNKNOWN  # machine state, may be PROBING
-        self._settled = UNKNOWN  # last settled verdict — what routing reads
-        self._reason = ""
-        self._probed_at: Optional[float] = None
-        self._duration_s = 0.0
+        self._state = UNKNOWN  # vet: guarded-by(self._lock) — machine state, may be PROBING
+        self._settled = UNKNOWN  # vet: guarded-by(self._lock) — last settled verdict, what routing reads
+        self._reason = ""  # vet: guarded-by(self._lock)
+        self._probed_at: Optional[float] = None  # vet: guarded-by(self._lock)
+        self._duration_s = 0.0  # vet: guarded-by(self._lock)
         self._reprobe_thread: Optional[threading.Thread] = None
         # (from, to) log — the unit tests assert exact transition sequences.
         self.transitions: List[Tuple[str, str]] = []
@@ -261,10 +261,10 @@ class BackendHealth:
             self._duration_s = 0.0
             self.transitions = []
 
-    def _expired(self, now: float) -> bool:
+    def _expired(self, now: float) -> bool:  # vet: holds(self._lock)
         return self._probed_at is None or (now - self._probed_at) > self.ttl_s
 
-    def _transition(self, to: str, reason: str = "") -> None:
+    def _transition(self, to: str, reason: str = "") -> None:  # vet: holds(self._lock)
         """Record a state change (caller holds the lock). Settled states
         also update the routing verdict and its reason."""
         if to != self._state:

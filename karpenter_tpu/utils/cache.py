@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Hashable, Optional, Tuple
 
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 
 _MISSING = object()
 
@@ -25,10 +25,10 @@ class TtlCache:
 
     def __init__(self, ttl: float, clock: Optional[Clock] = None):
         self.ttl = ttl
-        self.clock = clock or Clock()
-        self._entries: Dict[Hashable, Tuple[float, Any]] = {}
+        self.clock = clock or SYSTEM_CLOCK
+        self._entries: Dict[Hashable, Tuple[float, Any]] = {}  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
-        self._sets_since_sweep = 0
+        self._sets_since_sweep = 0  # vet: guarded-by(self._lock)
 
     def get(self, key: Hashable, default: Any = None) -> Any:
         with self._lock:

@@ -51,7 +51,7 @@ class Gauge:
         self.name = name
         self.help = help_text
         self.label_names = tuple(labels)
-        self._values: Dict[LabelValues, float] = {}
+        self._values: Dict[LabelValues, float] = {}  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def set(self, value: float, *label_values: str) -> None:
@@ -119,9 +119,9 @@ class Histogram:
         self.help = help_text
         self.label_names = tuple(labels)
         self.buckets = tuple(buckets)
-        self._counts: Dict[LabelValues, List[int]] = {}
-        self._sums: Dict[LabelValues, float] = {}
-        self._totals: Dict[LabelValues, int] = {}
+        self._counts: Dict[LabelValues, List[int]] = {}  # vet: guarded-by(self._lock)
+        self._sums: Dict[LabelValues, float] = {}  # vet: guarded-by(self._lock)
+        self._totals: Dict[LabelValues, int] = {}  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def observe(self, value: float, *label_values: str) -> None:
@@ -189,7 +189,7 @@ class Histogram:
 
 class Registry:
     def __init__(self):
-        self._metrics: List = []
+        self._metrics: List = []  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def gauge(self, name: str, help_text: str, labels: Sequence[str] = ()) -> Gauge:

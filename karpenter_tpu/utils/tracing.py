@@ -53,7 +53,7 @@ class Tracer:
             else os.environ.get("KARPENTER_TRACE", "") not in ("", "0", "false")
         )
         self.profile_dir = os.environ.get("KARPENTER_JAX_PROFILE_DIR") or None
-        self._spans: deque = deque(maxlen=_MAX_SPANS)
+        self._spans: deque = deque(maxlen=_MAX_SPANS)  # vet: guarded-by(self._lock)
         self._local = threading.local()
         self._lock = threading.Lock()
 

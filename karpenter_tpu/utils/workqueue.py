@@ -11,7 +11,7 @@ import threading
 from collections import deque
 from typing import Callable, Dict, Hashable, Optional
 
-from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
 
 
 class RateLimiter:
@@ -21,9 +21,9 @@ class RateLimiter:
     def __init__(self, qps: float, burst: int, clock: Optional[Clock] = None):
         self.qps = qps
         self.burst = burst
-        self.clock = clock or Clock()
-        self._tokens = float(burst)
-        self._last = self.clock.now()
+        self.clock = clock or SYSTEM_CLOCK
+        self._tokens = float(burst)  # vet: guarded-by(self._lock)
+        self._last = self.clock.now()  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def try_acquire(self) -> bool:
@@ -57,11 +57,11 @@ class BackoffQueue:
     ):
         self.base_delay = base_delay
         self.max_delay = max_delay
-        self.clock = clock or Clock()
-        self._queue: deque = deque()
-        self._in_queue: set = set()
-        self._failures: Dict[Hashable, int] = {}
-        self._not_before: Dict[Hashable, float] = {}
+        self.clock = clock or SYSTEM_CLOCK
+        self._queue: deque = deque()  # vet: guarded-by(self._lock)
+        self._in_queue: set = set()  # vet: guarded-by(self._lock)
+        self._failures: Dict[Hashable, int] = {}  # vet: guarded-by(self._lock)
+        self._not_before: Dict[Hashable, float] = {}  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def add(self, item: Hashable) -> bool:
@@ -73,10 +73,10 @@ class BackoffQueue:
             return True
 
     def __len__(self):
-        return len(self._queue)
+        return len(self._queue)  # vet: unguarded(GIL-atomic len; monitoring read)
 
     def __contains__(self, item):
-        return item in self._in_queue
+        return item in self._in_queue  # vet: unguarded(GIL-atomic membership; monitoring read)
 
     def process(self, fn: Callable[[Hashable], bool]) -> int:
         """Run fn over every currently-due item once. Returns #successes.
@@ -84,10 +84,14 @@ class BackoffQueue:
         with self._lock:
             batch = list(self._queue)
             self._queue.clear()
+            # Snapshot the due times with the batch: reading them item-by-
+            # item outside the lock raced a concurrent process() call's
+            # backoff writes (found by the vet lock-discipline checker).
+            not_before = dict(self._not_before)
         done = 0
         now = self.clock.now()
         for item in batch:
-            if self._not_before.get(item, 0.0) > now:
+            if not_before.get(item, 0.0) > now:
                 with self._lock:
                     self._queue.append(item)
                 continue

@@ -1,73 +1,22 @@
-"""Grep-lint: backend_health owns every backend decision.
-
-Two invariants, enforced over the whole production tree (karpenter_tpu/
-plus the driver entry files) so the copy-drifted probe/pin sites this PR
-replaced can never grow back:
-
-1. No module outside utils/backend_health.py uses the JAX_PLATFORMS env
-   key (the env-trust bug behind r05's rc:124 lived in exactly such a
-   site). Matched as the AST string literal, so docstrings/comments that
-   merely mention the variable don't trip it — env reads/writes must spell
-   the key as a literal to work at all.
-2. No module calls jax.devices()/jax.device_count()/jax.local_devices()
-   at import time: an import must never be the first device touch (a
-   wedged tunnel would hang module import, before any probe can run).
+"""Shim: the two backend-ownership invariants migrated into tools/vet as
+proper checkers (jax-platforms-ownership, import-time-device-touch) when the
+unified vet suite landed — see tools/vet/checkers/backend.py for the rules
+and docs/design/vet.md for the catalog. This file keeps the historical test
+names alive (external invocations, bisects) as thin calls into the
+framework; tests/test_vet.py exercises the checkers' positive/negative
+fixtures.
 """
 
-import ast
-from pathlib import Path
+from tools.vet import checker_findings
 
-REPO = Path(__file__).resolve().parent.parent
-SCOPE = sorted(
-    list((REPO / "karpenter_tpu").rglob("*.py"))
-    + [REPO / "__graft_entry__.py", REPO / "bench.py"]
-)
-OWNER = REPO / "karpenter_tpu" / "utils" / "backend_health.py"
 
-DEVICE_TOUCHES = {"devices", "device_count", "local_devices"}
+def _render(findings):
+    return [finding.render() for finding in findings]
 
 
 def test_only_backend_health_spells_jax_platforms():
-    offenders = []
-    for path in SCOPE:
-        if path == OWNER:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Constant) and node.value == "JAX_PLATFORMS":
-                offenders.append(f"{path.relative_to(REPO)}:{node.lineno}")
-    assert not offenders, (
-        "JAX_PLATFORMS is owned by utils/backend_health (ensure_backend/"
-        f"pin_cpu); route these through it: {offenders}"
-    )
-
-
-def _import_time_nodes(tree):
-    """Every AST node reachable while the module body executes — module and
-    class bodies included, function/lambda bodies excluded."""
-    stack = list(tree.body)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
+    assert _render(checker_findings("jax-platforms-ownership")) == []
 
 
 def test_no_import_time_device_touch():
-    offenders = []
-    for path in SCOPE:
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in _import_time_nodes(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in DEVICE_TOUCHES
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "jax"
-            ):
-                offenders.append(f"{path.relative_to(REPO)}:{node.lineno}")
-    assert not offenders, (
-        "import-time device touch (hangs module import on a wedged tunnel); "
-        f"move inside a function behind the BackendHealth verdict: {offenders}"
-    )
+    assert _render(checker_findings("import-time-device-touch")) == []

@@ -1,0 +1,410 @@
+"""tests for tools/vet — the unified AST vet suite.
+
+Three layers:
+
+1. per-checker fixtures: for every checker, one snippet that MUST trip it
+   and one near-miss that must NOT (parametrized, the issue's acceptance
+   shape);
+2. framework mechanics: baseline suppression, stale-entry detection,
+   file:line rendering, CLI exit codes;
+3. the tree gate: the full production tree is vet-clean — which puts the
+   whole suite inside tier-1, the way the reference's battletest fronts
+   every change with `go vet`.
+"""
+
+import textwrap
+
+import pytest
+
+from tools.vet import run_vet
+from tools.vet.checkers import ALL_CHECKERS, CHECKERS_BY_NAME
+from tools.vet.framework import Finding, apply_baseline, load_modules, main
+
+# --- per-checker fixtures ----------------------------------------------------
+
+# (checker, source that must trip it, near-miss that must not)
+CASES = [
+    (
+        "lock-discipline",
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}  # vet: guarded-by(self._lock)
+
+            def poke(self):
+                self._state["x"] = 1
+        """,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}  # vet: guarded-by(self._lock)
+
+            def poke(self):
+                with self._lock:
+                    self._state["x"] = 1
+
+            def _drain_locked(self):
+                return list(self._state)
+
+            def peek(self):
+                return len(self._state)  # vet: unguarded(GIL-atomic len)
+        """,
+    ),
+    (
+        "blocking-under-lock",
+        """
+        import threading
+        import time
+
+        LOCK = threading.Lock()
+
+        def slow():
+            with LOCK:
+                time.sleep(1)
+        """,
+        """
+        import threading
+        import time
+
+        LOCK = threading.Lock()
+
+        def fine():
+            with LOCK:
+                x = 1
+            time.sleep(1)
+
+        def cv_wait(cv):
+            with cv:
+                cv.wait(timeout=1.0)
+        """,
+    ),
+    (
+        "crash-safety",
+        """
+        from karpenter_tpu.utils.crashpoints import crashpoint
+
+        def risky():
+            try:
+                crashpoint("scratch.site")
+            except BaseException:
+                pass
+        """,
+        """
+        from karpenter_tpu.utils.crashpoints import crashpoint
+
+        def risky():
+            try:
+                crashpoint("scratch.site")
+            except Exception:
+                pass
+        """,
+    ),
+    (
+        "clock-discipline",
+        """
+        import time as _time
+
+        def tick():
+            _time.sleep(0.1)
+            return _time.time()
+        """,
+        """
+        import time
+        from karpenter_tpu.utils.clock import SYSTEM_CLOCK
+
+        def tick():
+            '''Durations via time.perf_counter are observability, not
+            control flow; control flow goes through the Clock.'''
+            began = time.perf_counter()
+            SYSTEM_CLOCK.sleep(0.0)
+            return time.perf_counter() - began
+        """,
+    ),
+    (
+        "metrics-consistency",
+        """
+        from karpenter_tpu.utils.metrics import REGISTRY
+
+        SCRATCH_TOTAL = REGISTRY.counter("vet_test_scratch_total", "x", ["reason"])
+
+        def bump():
+            SCRATCH_TOTAL.inc()
+        """,
+        """
+        from karpenter_tpu.utils.metrics import REGISTRY
+
+        SCRATCH_TOTAL = REGISTRY.counter("vet_test_scratch_total", "x", ["reason"])
+
+        def bump(reason):
+            SCRATCH_TOTAL.inc(reason)
+            SCRATCH_TOTAL.inc(reason, amount=2.0)
+        """,
+    ),
+    (
+        "jax-platforms-ownership",
+        """
+        import os
+
+        def pin():
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        """,
+        """
+        def pin():
+            '''Mentions of JAX_PLATFORMS in prose do not trip the literal
+            match; only spelling the env key as a usable string does.'''
+            return None
+        """,
+    ),
+    (
+        "import-time-device-touch",
+        """
+        import jax
+
+        DEVICES = jax.devices()
+        """,
+        """
+        import jax
+
+        def devices():
+            return jax.devices()
+        """,
+    ),
+]
+
+
+def _run_checker(name, tmp_path, source):
+    path = tmp_path / "scratch.py"
+    path.write_text(textwrap.dedent(source))
+    return CHECKERS_BY_NAME[name].run(load_modules([path]))
+
+
+@pytest.mark.parametrize("checker,bad,good", CASES, ids=[c[0] for c in CASES])
+def test_checker_trips_and_near_miss(checker, bad, good, tmp_path):
+    findings = _run_checker(checker, tmp_path, bad)
+    assert findings, f"{checker} must flag the violation snippet"
+    assert all(f.checker == checker for f in findings)
+    # The acceptance shape: findings render as clickable file:line.
+    for finding in findings:
+        assert finding.render().startswith(f"{finding.file}:{finding.line} ")
+        assert finding.line > 0
+    assert not _run_checker(checker, tmp_path, good), (
+        f"{checker} must not flag the near-miss snippet"
+    )
+
+
+def test_metrics_duplicate_declaration(tmp_path):
+    (tmp_path / "a.py").write_text(
+        'from karpenter_tpu.utils.metrics import REGISTRY\n'
+        'A = REGISTRY.counter("vet_test_dup_total", "x")\n'
+    )
+    (tmp_path / "b.py").write_text(
+        'from karpenter_tpu.utils.metrics import REGISTRY\n'
+        'B = REGISTRY.gauge("vet_test_dup_total", "x")\n'
+    )
+    findings = CHECKERS_BY_NAME["metrics-consistency"].run(
+        load_modules([tmp_path / "a.py", tmp_path / "b.py"])
+    )
+    assert [f.key for f in findings] == ["duplicate:vet_test_dup_total"]
+
+
+def test_lock_discipline_holds_annotation(tmp_path):
+    source = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = {}  # vet: guarded-by(self._lock)
+
+        def _flush(self):  # vet: holds(self._lock)
+            self._state.clear()
+    """
+    assert not _run_checker("lock-discipline", tmp_path, source)
+
+
+def test_lock_discipline_foreign_lock_does_not_satisfy(tmp_path):
+    """Lock identity is the full dotted expression: holding ANOTHER
+    object's same-named lock must not silence the guard."""
+    source = """
+    import threading
+
+    class Worker:
+        def __init__(self, peer):
+            self.peer = peer
+            self._lock = threading.Lock()
+            self._pending = []  # vet: guarded-by(self._lock)
+
+        def bad(self):
+            with self.peer._lock:
+                self._pending.append(1)
+    """
+    findings = _run_checker("lock-discipline", tmp_path, source)
+    assert [f.key for f in findings] == ["Worker._pending@bad"]
+
+
+def test_lock_discipline_inherited_guard(tmp_path):
+    """A subclass touching a base class's annotated attr is held to the
+    base's lock (resolved by class name across the scanned tree)."""
+    source = """
+    import threading
+
+    class Base:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = {}  # vet: guarded-by(self._lock)
+
+    class Sub(Base):
+        def bad(self):
+            self._state.clear()
+
+        def good(self):
+            with self._lock:
+                self._state.clear()
+    """
+    findings = _run_checker("lock-discipline", tmp_path, source)
+    assert [f.key for f in findings] == ["Sub._state@bad"]
+
+
+def test_lock_discipline_flags_unconsumed_annotations(tmp_path):
+    """A vet annotation the checker cannot read must be a finding, never a
+    silent no-op: typo'd syntax, a guarded-by off its assignment line, a
+    holds() off the def line."""
+    source = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            # vet: guarded-by(self._state_lock)
+            self._state = {}
+            self._other = {}  # vet: guarded_by(self._lock)
+
+        def flush(self):
+            # vet: holds(self._lock)
+            self._state.clear()
+    """
+    findings = _run_checker("lock-discipline", tmp_path, source)
+    messages = " | ".join(f.message for f in findings)
+    assert "not consumed" in messages  # guarded-by on its own line
+    assert "unrecognized vet annotation" in messages  # guarded_by typo
+    assert "must sit on the `def` line" in messages  # holds() in the body
+
+
+def test_crash_safety_suppress_and_finally_shapes(tmp_path):
+    bad = """
+    import contextlib
+
+    def swallow():
+        with contextlib.suppress(BaseException):
+            risky()
+
+    def discard():
+        try:
+            risky()
+        finally:
+            return 0
+    """
+    keys = {f.key for f in _run_checker("crash-safety", tmp_path, bad)}
+    assert keys == {"swallow:suppress-baseexception", "discard:finally-return"}
+    near_miss = """
+    import contextlib
+
+    def fine():
+        with contextlib.suppress(ValueError):
+            risky()
+
+    def also_fine():
+        try:
+            risky()
+        finally:
+            for x in ():
+                break  # exits the inner loop, not the finally
+        return 0
+    """
+    assert not _run_checker("crash-safety", tmp_path, near_miss)
+
+
+def test_crash_safety_distinct_sites_key_separately(tmp_path):
+    """Two broad excepts in one function must not share a baseline
+    identity — one grandfathered entry must never cover a second,
+    later-added handler."""
+    source = """
+    def f():
+        try:
+            a()
+        except BaseException:
+            pass
+        try:
+            b()
+        except BaseException:
+            pass
+    """
+    keys = [f.key for f in _run_checker("crash-safety", tmp_path, source)]
+    assert sorted(keys) == ["f:broad-except#0", "f:broad-except#1"]
+
+
+# --- framework mechanics -----------------------------------------------------
+
+
+def _finding(checker="clock-discipline", file="x.py", key="f:time.sleep"):
+    return Finding(checker=checker, file=file, line=3, key=key, message="m")
+
+
+def test_baseline_suppresses_matched_findings():
+    baseline = {"clock-discipline": ["x.py f:time.sleep"]}
+    kept, stale = apply_baseline([_finding()], baseline)
+    assert kept == [] and stale == []
+
+
+def test_baseline_stale_entry_detected():
+    baseline = {"clock-discipline": ["gone.py f:time.sleep"]}
+    kept, stale = apply_baseline([], baseline)
+    assert kept == []
+    assert stale == [("clock-discipline", "gone.py f:time.sleep")]
+
+
+def test_baseline_not_applied_to_explicit_paths(tmp_path):
+    """A violation deliberately introduced in a scratch file fails even if
+    a baseline entry would cover it — explicit paths scan raw."""
+    path = tmp_path / "scratch.py"
+    path.write_text("import time\ntime.sleep(1)\n")
+    findings, stale = run_vet(paths=[path])
+    assert any(f.checker == "clock-discipline" for f in findings)
+    assert stale == []
+
+
+def test_cli_fails_on_violation_and_reports_file_line(tmp_path, capsys):
+    path = tmp_path / "scratch.py"
+    path.write_text("import time\ntime.sleep(1)\n")
+    assert main([str(path)]) == 1
+    out = capsys.readouterr().out
+    assert f"{path}:2 clock-discipline" in out
+
+
+def test_cli_rejects_missing_path(capsys):
+    assert main(["no/such/path.py"]) == 2
+
+
+# --- the tree gate -----------------------------------------------------------
+
+
+def test_production_tree_is_vet_clean():
+    """`make vet` as a tier-1 test: zero findings, zero stale baseline
+    entries over karpenter_tpu/ + the driver entry files. A regression in
+    any of the seven disciplines fails here with a file:line message."""
+    findings, stale = run_vet()
+    rendered = [f.render() for f in findings] + [
+        f"stale baseline entry ({checker}): {entry}" for checker, entry in stale
+    ]
+    assert rendered == []
+
+
+def test_checker_names_unique():
+    names = [checker.name for checker in ALL_CHECKERS]
+    assert len(names) == len(set(names)) == 7

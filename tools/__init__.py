@@ -1,0 +1,1 @@
+"""Repo tooling (complexity gate, vet suite, smoke harnesses)."""

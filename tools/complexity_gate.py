@@ -46,7 +46,6 @@ ALLOWED = {
     "karpenter_tpu/controllers/metrics.py::MetricsController.reconcile": 33,
     "karpenter_tpu/kubeapi/client.py::KubeClient.watch": 21,
     "karpenter_tpu/kubeapi/convert.py::node_from_kube": 17,
-    "karpenter_tpu/kubeapi/convert.py::pod_from_kube": 45,
     "karpenter_tpu/kubeapi/convert.py::pod_to_kube": 28,
     "karpenter_tpu/models/solver.py::cost_solve_finish": 16,
     "karpenter_tpu/ops/encode.py::build_fleet": 24,

@@ -1,0 +1,27 @@
+"""tools/vet — the unified AST vet suite (the Python analogue of
+``go vet`` + ``-race`` that gates the reference's battletest).
+
+Seven checkers over a shared AST walk, run by ``make vet`` /
+``python -m tools.vet`` and by tier-1 via tests/test_vet.py:
+
+- ``lock-discipline``       annotated attrs only touched under their lock
+- ``blocking-under-lock``   no sleep/subprocess/socket/JAX dispatch in a lock
+- ``crash-safety``          SimulatedCrash can never be swallowed
+- ``clock-discipline``      raw time.{time,sleep,monotonic} only in utils/clock
+- ``metrics-consistency``   metric names declared once, label arity consistent
+- ``jax-platforms-ownership``   JAX_PLATFORMS spelled only in backend_health
+- ``import-time-device-touch``  no jax.devices() at module import
+
+Catalog, annotation syntax, and baseline format: docs/design/vet.md.
+"""
+
+from tools.vet.framework import (  # noqa: F401 — the public surface
+    Checker,
+    Finding,
+    Module,
+    checker_findings,
+    load_modules,
+    main,
+    production_scope,
+    run_vet,
+)

@@ -1,0 +1,14 @@
+"""Checker registry. Each checker module exports CHECKERS (a tuple of
+framework.Checker); ALL_CHECKERS is the suite `python -m tools.vet` runs."""
+
+from tools.vet.checkers import backend, clocks, crash, locks, metricsuse
+
+ALL_CHECKERS = (
+    *locks.CHECKERS,
+    *crash.CHECKERS,
+    *clocks.CHECKERS,
+    *metricsuse.CHECKERS,
+    *backend.CHECKERS,
+)
+
+CHECKERS_BY_NAME = {checker.name: checker for checker in ALL_CHECKERS}

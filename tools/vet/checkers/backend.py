@@ -1,0 +1,94 @@
+"""Backend-ownership checkers, migrated from tests/test_backend_lint.py
+(which is now a thin shim over these):
+
+jax-platforms-ownership
+    No module outside utils/backend_health.py spells the JAX_PLATFORMS env
+    key as a string literal — the env-trust hang behind r05's rc:124 lived
+    in exactly such a copy-drifted site. AST-literal matching keeps
+    docstrings/comments free to mention the variable.
+
+import-time-device-touch
+    No jax.devices()/jax.device_count()/jax.local_devices() reachable while
+    a module body executes: an import must never be the first device touch
+    (a wedged tunnel would hang import, before any probe can run).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.vet.framework import Checker, Finding, Module
+
+PLATFORMS_NAME = "jax-platforms-ownership"
+DEVICE_NAME = "import-time-device-touch"
+
+OWNER = "karpenter_tpu/utils/backend_health.py"
+DEVICE_TOUCHES = {"devices", "device_count", "local_devices"}
+
+
+def _check_platforms(modules: List[Module]) -> List[Finding]:
+    findings = []
+    for module in modules:
+        if module.rel == OWNER:
+            continue
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Constant) and node.value == "JAX_PLATFORMS":
+                findings.append(
+                    Finding(
+                        checker=PLATFORMS_NAME,
+                        file=module.rel,
+                        line=node.lineno,
+                        key="jax-platforms-literal",
+                        message=(
+                            "JAX_PLATFORMS is owned by utils/backend_health "
+                            "(ensure_backend/pin_cpu); route through it"
+                        ),
+                    )
+                )
+    return findings
+
+
+def _import_time_nodes(tree: ast.AST):
+    """Every AST node reachable while the module body executes — module and
+    class bodies included, function/lambda bodies excluded."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_device_touch(modules: List[Module]) -> List[Finding]:
+    findings = []
+    for module in modules:
+        for node in _import_time_nodes(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in DEVICE_TOUCHES
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jax"
+            ):
+                findings.append(
+                    Finding(
+                        checker=DEVICE_NAME,
+                        file=module.rel,
+                        line=node.lineno,
+                        key=f"import-time:jax.{node.func.attr}",
+                        message=(
+                            f"import-time jax.{node.func.attr}() hangs module "
+                            f"import on a wedged tunnel; move inside a "
+                            f"function behind the BackendHealth verdict"
+                        ),
+                    )
+                )
+    return findings
+
+
+CHECKERS = (
+    Checker(PLATFORMS_NAME, _check_platforms),
+    Checker(DEVICE_NAME, _check_device_touch),
+)

@@ -1,0 +1,94 @@
+"""clock-discipline: raw ``time.{time,sleep,monotonic}`` lives only in
+``utils/clock.py``.
+
+Everything else injects a ``Clock`` (or its bound methods) so FakeClock
+tests control ALL timing — a single raw ``time.sleep`` in a reconcile path
+is a wall-clock stall no fake clock can skip, and a raw ``time.time()``
+read splits the timeline a TTL test thinks it owns. Matched through import
+aliases (``import time as _time`` included), so function-local imports
+can't hide a call site. ``time.perf_counter`` is deliberately NOT matched:
+measuring a duration for metrics is observability, not control flow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.vet.framework import (
+    Checker,
+    Finding,
+    Module,
+    scope_allows,
+    time_module_aliases,
+    walk_with_qualname,
+)
+
+NAME = "clock-discipline"
+
+RAW_ATTRS = {"time", "sleep", "monotonic"}
+
+# The one legitimate home of the raw functions.
+OWNER = "karpenter_tpu/utils/clock.py"
+
+# Documented narrow allowances (file, or file::qualname prefix). These are
+# NOT baseline entries — each is a place where wall time is the semantics,
+# not an accident; docs/design/vet.md carries the catalog.
+ALLOWED = {
+    # The mix solve races a *wall* deadline shared with the caller's RPC
+    # budget; a fake clock here would let tests "solve" past a budget no
+    # production run gets. The deadline is the boundary, jax dispatch the
+    # payload — injecting a Clock buys no test leverage.
+    "karpenter_tpu/ops/mix_pack.py": "solver wall-deadline",
+    # The reconcile workqueue schedules with Condition.wait(timeout=...),
+    # which only understands real time — its due-heap must share that
+    # domain. Tests drive controllers synchronously, bypassing the loop.
+    "karpenter_tpu/runtime.py::ReconcileLoop": "cv.wait scheduling domain",
+}
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    findings = []
+    for module in modules:
+        if module.rel == OWNER:
+            continue
+        aliases = time_module_aliases(module.tree)
+        for node, qual in walk_with_qualname(module.tree):
+            offense = _offense(node, aliases)
+            if offense is None:
+                continue
+            if scope_allows(ALLOWED, module.rel, qual):
+                continue
+            findings.append(
+                Finding(
+                    checker=NAME,
+                    file=module.rel,
+                    line=node.lineno,
+                    key=f"{qual or '<module>'}:{offense}",
+                    message=(
+                        f"raw {offense} (inject utils.clock.Clock — "
+                        f"SYSTEM_CLOCK is the production default — so "
+                        f"fake-clock tests control this timing)"
+                    ),
+                )
+            )
+    return findings
+
+
+def _offense(node: ast.AST, aliases: set):
+    """'time.sleep'-style spelling if this node is a raw-time touch."""
+    if (
+        isinstance(node, ast.Attribute)
+        and node.attr in RAW_ATTRS
+        and isinstance(node.value, ast.Name)
+        and node.value.id in aliases
+    ):
+        return f"time.{node.attr}"
+    if isinstance(node, ast.ImportFrom) and node.module == "time":
+        names = sorted(a.name for a in node.names if a.name in RAW_ATTRS)
+        if names:
+            return f"from time import {', '.join(names)}"
+    return None
+
+
+CHECKERS = (Checker(NAME, _check),)

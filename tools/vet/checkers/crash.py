@@ -1,0 +1,212 @@
+"""crash-safety: ``SimulatedCrash`` must always propagate.
+
+The crash battletest's whole warrant is that an armed ``crashpoint(...)``
+kills the controller *exactly like* a process death — SimulatedCrash
+subclasses BaseException so the pipeline's deliberate ``except Exception``
+recovery can't swallow it. That argument has two static holes, both closed
+here:
+
+1. a bare ``except:`` or ``except BaseException:`` anywhere in the
+   production tree catches BaseException and with it the crash — banned
+   outside an explicit allowlist (currently empty; earn an entry with a
+   written justification in docs/design/vet.md);
+2. a crashpoint call lexically inside such a ``try`` body would be eaten
+   before it ever left the function — banned with no allowlist;
+3. the two non-``except`` swallow shapes Python offers:
+   ``contextlib.suppress(BaseException)`` (suppresses exactly like a broad
+   handler), and ``return``/``break``/``continue`` inside a ``finally``
+   body — control flow leaving a finally DISCARDS any in-flight exception,
+   BaseException included, with no handler anywhere in sight.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.vet.framework import (
+    Checker,
+    Finding,
+    Module,
+    scope_allows,
+    walk_with_qualname,
+)
+
+NAME = "crash-safety"
+
+# file or file::qualname-prefix -> written justification. Keep this list
+# at zero swallow-sites: an entry is only legitimate when the handler
+# TRANSFERS the exception (stores and re-raises), never when it drops it.
+ALLOWED: dict = {
+    # Captures any error (SimulatedCrash included) in the overlap worker
+    # thread and re-raises it on join() — cross-thread propagation. A plain
+    # `except Exception` would strand a BaseException in the worker where
+    # no caller could ever see it.
+    "karpenter_tpu/models/solver.py::_HostOverlap._run": "re-raised on join()",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """Bare except, BaseException, or a tuple containing it."""
+    if handler.type is None:
+        return True
+    exprs = (
+        list(handler.type.elts)
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for expr in exprs:
+        name = expr.attr if isinstance(expr, ast.Attribute) else getattr(expr, "id", None)
+        if name == "BaseException":
+            return True
+    return False
+
+
+def _crashpoint_calls(body: List[ast.stmt]):
+    """crashpoint(...) calls lexically reachable in `body` — nested def/
+    lambda bodies excluded (they execute later, outside this try)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", None)
+            if name == "crashpoint":
+                yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _site_key(call: ast.Call) -> str:
+    """The crashpoint's site-name literal when spelled inline (the normal
+    shape), so distinct sites in one function key separately."""
+    if call.args and isinstance(call.args[0], ast.Constant):
+        if isinstance(call.args[0].value, str):
+            return call.args[0].value
+    return "<dynamic>"
+
+
+def _broad_findings(module: Module, qual: str, handlers, ordinal: int):
+    """One finding per broad handler, keyed by its source-order ordinal
+    within the function: two broad excepts in one function must NOT share
+    a baseline identity, or one grandfathered entry would silently cover
+    every future handler added there."""
+    for handler in handlers:
+        spelled = "bare except" if handler.type is None else "except BaseException"
+        yield ordinal + 1, Finding(
+            checker=NAME,
+            file=module.rel,
+            line=handler.lineno,
+            key=f"{qual or '<module>'}:broad-except#{ordinal}",
+            message=(
+                f"{spelled} swallows SimulatedCrash (and KeyboardInterrupt); "
+                f"catch Exception, or re-raise BaseException first"
+            ),
+        )
+        ordinal += 1
+
+
+def _broad_suppress(node: ast.AST) -> bool:
+    """`with contextlib.suppress(BaseException):` — a broad handler in
+    context-manager clothing."""
+    for item in node.items:
+        expr = item.context_expr
+        if not (isinstance(expr, ast.Call) and expr.func is not None):
+            continue
+        func = expr.func
+        name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", None)
+        if name != "suppress":
+            continue
+        for arg in expr.args:
+            arg_name = arg.attr if isinstance(arg, ast.Attribute) else getattr(arg, "id", None)
+            if arg_name == "BaseException":
+                return True
+    return False
+
+
+def _finally_discards(finalbody: List[ast.stmt]):
+    """return/break/continue that exit a finally body (discarding any
+    in-flight exception). break/continue INSIDE a loop that is itself in
+    the finally don't leave it; nested defs run elsewhere."""
+    stack = [(stmt, 0) for stmt in finalbody]
+    while stack:
+        node, loop_depth = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Return):
+            yield node, "return"
+            continue
+        if isinstance(node, (ast.Break, ast.Continue)) and loop_depth == 0:
+            yield node, "break" if isinstance(node, ast.Break) else "continue"
+            continue
+        inner = loop_depth + (1 if isinstance(node, (ast.For, ast.While)) else 0)
+        stack.extend((child, inner) for child in ast.iter_child_nodes(node))
+
+
+def _swallow_shape_findings(module: Module):
+    """Rule 3: suppress(BaseException) withs and finally-body discards."""
+    for node, qual in walk_with_qualname(module.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)) and _broad_suppress(node):
+            yield Finding(
+                checker=NAME, file=module.rel, line=node.lineno,
+                key=f"{qual or '<module>'}:suppress-baseexception",
+                message=(
+                    "contextlib.suppress(BaseException) swallows "
+                    "SimulatedCrash exactly like a broad except; suppress "
+                    "Exception (or narrower) instead"
+                ),
+            )
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt, spelled in _finally_discards(node.finalbody):
+                yield Finding(
+                    checker=NAME, file=module.rel, line=stmt.lineno,
+                    key=f"{qual or '<module>'}:finally-{spelled}",
+                    message=(
+                        f"{spelled} inside a finally body discards any "
+                        f"in-flight exception (SimulatedCrash included); "
+                        f"restructure so the finally falls through"
+                    ),
+                )
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    findings = []
+    for module in modules:
+        findings.extend(_swallow_shape_findings(module))
+        ordinals: dict = {}  # qual -> broad handlers seen, in source order
+        tries = sorted(
+            (
+                (node.lineno, node, qual)
+                for node, qual in walk_with_qualname(module.tree)
+                if isinstance(node, ast.Try)
+            ),
+        )
+        for _, node, qual in tries:
+            broad = [h for h in node.handlers if _is_broad(h)]
+            if not broad:
+                continue
+            if not scope_allows(ALLOWED, module.rel, qual):
+                for ordinal, finding in _broad_findings(
+                    module, qual, broad, ordinals.get(qual, 0)
+                ):
+                    ordinals[qual] = ordinal
+                    findings.append(finding)
+            for call in _crashpoint_calls(node.body):
+                findings.append(
+                    Finding(
+                        checker=NAME,
+                        file=module.rel,
+                        line=call.lineno,
+                        key=f"{qual or '<module>'}:crashpoint-in-broad-try:{_site_key(call)}",
+                        message=(
+                            "crashpoint() inside a try that catches "
+                            "BaseException — an armed crash here could "
+                            "never escape the function"
+                        ),
+                    )
+                )
+    return findings
+
+
+CHECKERS = (Checker(NAME, _check),)

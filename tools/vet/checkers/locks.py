@@ -1,0 +1,340 @@
+"""lock-discipline + blocking-under-lock: the static stand-in for
+``go test -race`` over the Manager/ProvisionerWorker/controller threads.
+
+lock-discipline
+    An attribute assigned in ``__init__`` with a trailing
+    ``# vet: guarded-by(self._lock)`` comment may only be read or written
+    (via ``self.``) inside a ``with self._lock:`` body. Helper methods that
+    run with the lock already held declare it: a ``_locked`` name suffix
+    (the repo's existing convention) or a ``# vet: holds(self._lock)``
+    comment on the ``def`` line. A deliberate lock-free access (GIL-atomic
+    fast paths) carries ``# vet: unguarded(<reason>)`` on its line — the
+    waiver is the documentation.
+
+blocking-under-lock
+    No ``with <lock>:`` body may call sleep, subprocess, socket/HTTP, or
+    JAX dispatch: a convoy on a hot-path lock is this runtime's analogue of
+    holding a mutex across cgo. Lock expressions are recognized by their
+    terminal name (``_lock``, ``_rv_lock``, ``_cv``, ...); ``cv.wait`` is
+    exempt — releasing the lock is what a condition variable is for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from tools.vet.framework import (
+    Checker,
+    Finding,
+    Module,
+    dotted_name,
+    scope_allows,
+    walk_with_qualname,
+)
+
+LOCK_NAME = "lock-discipline"
+BLOCK_NAME = "blocking-under-lock"
+
+GUARD_RE = re.compile(r"#\s*vet:\s*guarded-by\(self\.(\w+)\)")
+HOLDS_RE = re.compile(r"#\s*vet:\s*holds\(self\.(\w+)\)")
+WAIVER_RE = re.compile(r"#\s*vet:\s*unguarded\(([^)]+)\)")
+
+LOCK_TERMINAL_RE = re.compile(r"(^|_)(lock|cv|cond|mutex)$", re.IGNORECASE)
+
+BLOCKING_PREFIXES = (
+    "subprocess.",
+    "socket.",
+    "requests.",
+    "urllib.request.",
+    "jax.",
+    "jnp.",
+)
+BLOCKING_ATTRS = {"sleep", "urlopen", "block_until_ready", "check_output", "check_call"}
+BLOCKING_NAMES = {"sleep", "urlopen"}
+
+# file or file::qualname prefix -> justification (shared by both checkers).
+ALLOWED: dict = {
+    # Documented at the site: the multi-host lead MUST hold _LEAD_LOCK
+    # across jax.block_until_ready — a second dispatch racing ahead would
+    # desynchronize collective order across processes. Serializing solves
+    # is the accepted cost; the lock covering the blocking call is the
+    # mechanism, not an accident.
+    "karpenter_tpu/parallel/spmd.py::lead_dispatch": "collective order requires lock across device completion",
+}
+
+
+# --- shared lock recognition -------------------------------------------------
+
+
+def _locks_acquired(node: ast.AST) -> Set[str]:
+    """Lock-shaped context managers in a With, as their FULL dotted
+    spelling ('self._lock', 'peer._cv') — lock identity is the whole
+    expression, never just the attribute name: `with other._lock:` must
+    not satisfy a guarded-by(self._lock) access."""
+    acquired = set()
+    for item in node.items:
+        expr = item.context_expr
+        terminal = expr.attr if isinstance(expr, ast.Attribute) else getattr(expr, "id", None)
+        if terminal and LOCK_TERMINAL_RE.search(terminal):
+            dotted = dotted_name(expr)
+            if dotted:
+                acquired.add(dotted)
+    return acquired
+
+
+# --- lock-discipline ---------------------------------------------------------
+
+
+def _guarded_attrs(cls: ast.ClassDef, module: Module):
+    """(attr -> guarding lock, consumed comment linenos) from annotated
+    __init__ assignments. Consumed lines feed the annotation-placement
+    validation: a guarded-by comment the collector did NOT consume is a
+    finding, never a silent no-op."""
+    guards: Dict[str, str] = {}
+    consumed: Set[int] = set()
+    for method in cls.body:
+        if not isinstance(method, ast.FunctionDef) or method.name != "__init__":
+            continue
+        for node in ast.walk(method):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            match = GUARD_RE.search(module.line_text(node.lineno))
+            if not match:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for target in targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    guards[target.attr] = match.group(1)
+                    consumed.add(node.lineno)
+    return guards, consumed
+
+
+def _class_index(modules: List[Module]):
+    """(per-class records, class name -> guards, class name -> base names)
+    across the WHOLE tree — guards are inherited: a subclass touching a
+    base's annotated attr is held to the base's lock, including across
+    modules (ApiServerCluster extends controllers.cluster.Cluster)."""
+    records = []
+    guards_by_name: Dict[str, Dict[str, str]] = {}
+    bases_by_name: Dict[str, List[str]] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            own, consumed = _guarded_attrs(node, module)
+            records.append((module, node, consumed))
+            merged = guards_by_name.setdefault(node.name, {})
+            for attr, lock in own.items():
+                merged.setdefault(attr, lock)
+            names = bases_by_name.setdefault(node.name, [])
+            for base in node.bases:
+                name = base.attr if isinstance(base, ast.Attribute) else getattr(base, "id", None)
+                if name:
+                    names.append(name)
+    return records, guards_by_name, bases_by_name
+
+
+def _effective_guards(cls_name: str, guards_by_name, bases_by_name) -> Dict[str, str]:
+    """Own guards plus every transitively-inherited one (resolved by base
+    class name across the scanned tree; own declarations win)."""
+    effective: Dict[str, str] = {}
+    seen: Set[str] = set()
+    stack = [cls_name]
+    while stack:
+        current = stack.pop()
+        if current in seen:
+            continue
+        seen.add(current)
+        for attr, lock in guards_by_name.get(current, {}).items():
+            effective.setdefault(attr, lock)
+        stack.extend(bases_by_name.get(current, ()))
+    return effective
+
+
+def _initially_held(method: ast.FunctionDef, module: Module, guards: Dict[str, str]) -> Set[str]:
+    """Locks held on entry, spelled 'self.<lock>' to match _locks_acquired."""
+    held = {
+        f"self.{name}" for name in HOLDS_RE.findall(module.line_text(method.lineno))
+    }
+    if method.name.endswith("_locked"):
+        held |= {f"self.{name}" for name in guards.values()}
+    return held
+
+
+class _LockScan:
+    def __init__(self, module: Module, cls_name: str, guards: Dict[str, str]):
+        self.module = module
+        self.cls_name = cls_name
+        self.guards = guards
+        self.findings: List[Finding] = []
+
+    def visit(self, node: ast.AST, held: Set[str], method: str) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.visit(item, held, method)
+            inner = held | _locks_acquired(node)
+            for stmt in node.body:
+                self.visit(stmt, inner, method)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.guards
+            and f"self.{self.guards[node.attr]}" not in held
+        ):
+            self._record(node, method)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, held, method)
+
+    def _record(self, node: ast.Attribute, method: str) -> None:
+        if WAIVER_RE.search(self.module.line_text(node.lineno)):
+            return
+        lock = self.guards[node.attr]
+        self.findings.append(
+            Finding(
+                checker=LOCK_NAME,
+                file=self.module.rel,
+                line=node.lineno,
+                key=f"{self.cls_name}.{node.attr}@{method}",
+                message=(
+                    f"self.{node.attr} is guarded-by(self.{lock}) but "
+                    f"accessed outside it in {method}() — hold the lock, "
+                    f"rename the helper *_locked, or waive the line with "
+                    f"'# vet: unguarded(<reason>)'"
+                ),
+            )
+        )
+
+
+ANNOTATION_RE = re.compile(r"#\s*vet:\s*(.+)$")
+VALID_FORM_RE = re.compile(
+    r"^(guarded-by\(self\.\w+\)|holds\(self\.\w+\)|unguarded\([^)]+\))"
+)
+
+
+def _annotation_findings(module: Module, consumed_guard_lines: Set[int]):
+    """A `# vet:` comment that the checkers cannot or will not read is a
+    finding — silently-unenforced annotations are the worst failure mode
+    an enforcement tool can have (typo'd syntax, a guarded-by that landed
+    on the wrong line of a reformatted assignment, a holds() off the def
+    line)."""
+    def_lines = {
+        node.lineno
+        for node in ast.walk(module.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    ordinal = 0
+    for lineno, line in enumerate(module.lines, start=1):
+        match = ANNOTATION_RE.search(line)
+        if not match:
+            continue
+        body = match.group(1).strip()
+        problem = None
+        if not VALID_FORM_RE.match(body):
+            problem = f"unrecognized vet annotation {body!r} (guarded-by/holds/unguarded)"
+        elif body.startswith("guarded-by") and lineno not in consumed_guard_lines:
+            problem = (
+                "guarded-by annotation not consumed — it must sit on the "
+                "first line of a `self.<attr> = ...` assignment in __init__"
+            )
+        elif body.startswith("holds(") and lineno not in def_lines:
+            problem = "holds() annotation must sit on the `def` line it covers"
+        if problem is not None:
+            yield Finding(
+                checker=LOCK_NAME, file=module.rel, line=lineno,
+                key=f"vet-annotation#{ordinal}", message=problem,
+            )
+            ordinal += 1
+
+
+def _check_lock_discipline(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    records, guards_by_name, bases_by_name = _class_index(modules)
+    consumed_by_module: Dict[str, Set[int]] = {}
+    for module, cls, consumed in records:
+        consumed_by_module.setdefault(module.rel, set()).update(consumed)
+        guards = _effective_guards(cls.name, guards_by_name, bases_by_name)
+        if not guards:
+            continue
+        scan = _LockScan(module, cls.name, guards)
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__":
+                continue
+            held = _initially_held(method, module, guards)
+            for stmt in method.body:
+                scan.visit(stmt, held, method.name)
+        findings.extend(scan.findings)
+    for module in modules:
+        findings.extend(
+            _annotation_findings(module, consumed_by_module.get(module.rel, set()))
+        )
+    return findings
+
+
+# --- blocking-under-lock -----------------------------------------------------
+
+
+def _blocking_callee(call: ast.Call):
+    """The offending callee spelling, or None if this call may block-free."""
+    dotted = dotted_name(call.func)
+    if dotted:
+        for prefix in BLOCKING_PREFIXES:
+            if dotted.startswith(prefix):
+                return dotted
+        if dotted in BLOCKING_NAMES:
+            return dotted
+    if isinstance(call.func, ast.Attribute) and call.func.attr in BLOCKING_ATTRS:
+        return dotted or f"<expr>.{call.func.attr}"
+    return None
+
+
+def _scan_with_body(module: Module, node: ast.AST, qual: str, findings: List[Finding]) -> None:
+    """Flag blocking calls lexically under an acquired lock (nested defs
+    included: a closure built under a lock usually runs under it — waive
+    deliberate deferred execution case-by-case if one ever appears)."""
+    stack = list(node.body)
+    while stack:
+        child = stack.pop()
+        if isinstance(child, ast.Call):
+            callee = _blocking_callee(child)
+            if callee is not None and not scope_allows(ALLOWED, module.rel, qual):
+                findings.append(
+                    Finding(
+                        checker=BLOCK_NAME,
+                        file=module.rel,
+                        line=child.lineno,
+                        key=f"{qual or '<module>'}:{callee}",
+                        message=(
+                            f"{callee}() inside a `with <lock>:` body — "
+                            f"blocking under a lock convoys every other "
+                            f"holder; move it outside the critical section"
+                        ),
+                    )
+                )
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _check_blocking(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in modules:
+        for node, qual in walk_with_qualname(module.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)) and _locks_acquired(node):
+                _scan_with_body(module, node, qual, findings)
+    # A call under nested locks is reached from every enclosing With; one
+    # finding per site is enough.
+    return sorted(set(findings), key=lambda f: (f.file, f.line))
+
+
+CHECKERS = (
+    Checker(LOCK_NAME, _check_lock_discipline),
+    Checker(BLOCK_NAME, _check_blocking),
+)

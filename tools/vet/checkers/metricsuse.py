@@ -1,0 +1,165 @@
+"""metrics-consistency: every metric name is declared exactly once, and
+every use passes the declared number of label values.
+
+Declarations are ``REGISTRY.gauge/counter/histogram("name", help, [labels])``
+calls; the var each is assigned to is tracked across the whole tree (modules
+import each other's metric objects), and calls on those vars are checked
+for label arity: an ``inc()`` missing a label value silently creates a
+parallel series (``{}`` vs ``{reason="x"}``) that no dashboard query joins
+— the exact drift class a one-home declaration discipline exists to stop.
+Calls with ``*splat`` args are skipped (arity unknowable statically), as
+are vars bound to two declarations with different label counts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.vet.framework import Checker, Finding, Module, walk_with_qualname
+
+NAME = "metrics-consistency"
+
+KINDS = {"gauge", "counter", "histogram"}
+
+# method -> leading non-label positional args (value payloads).
+METHOD_LEADING = {
+    "set": 1,
+    "inc": 0,
+    "get": 0,
+    "observe": 1,
+    "observe_many": 1,
+    "measure": 0,
+    "count": 0,
+}
+
+
+def _decl_call(node: ast.AST) -> Optional[ast.Call]:
+    """The REGISTRY.<kind>(...) call if `node` is one."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in KINDS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id.endswith("REGISTRY")
+    ):
+        return node
+    return None
+
+
+def _decl_spec(call: ast.Call) -> Tuple[Optional[str], Optional[int]]:
+    """(metric name, label count) — None where not statically knowable."""
+    name = None
+    if call.args and isinstance(call.args[0], ast.Constant):
+        if isinstance(call.args[0].value, str):
+            name = call.args[0].value
+    labels_node = call.args[2] if len(call.args) >= 3 else None
+    if labels_node is None:
+        kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+        labels_node = kwargs.get("labels")
+    if labels_node is None:
+        return name, 0
+    if isinstance(labels_node, (ast.List, ast.Tuple)):
+        return name, len(labels_node.elts)
+    return name, None  # computed label list: arity unknown
+
+
+def _collect_declarations(modules: List[Module]):
+    """(metric name -> [(file, line)], var name -> [(kind, n_labels)])."""
+    by_name: Dict[str, List[Tuple[str, int]]] = {}
+    by_var: Dict[str, List[Tuple[str, Optional[int]]]] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            call = _decl_call(node.value) if isinstance(node, ast.Assign) else _decl_call(node)
+            if call is None:
+                continue
+            name, n_labels = _decl_spec(call)
+            if name is not None:
+                by_name.setdefault(name, []).append((module.rel, call.lineno))
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        by_var.setdefault(target.id, []).append(
+                            (call.func.attr, n_labels)
+                        )
+    return by_name, by_var
+
+
+def _duplicate_findings(by_name) -> List[Finding]:
+    findings = []
+    for name, sites in sorted(by_name.items()):
+        if len(set(sites)) < 2:
+            continue
+        for file, line in sorted(set(sites))[1:]:
+            findings.append(
+                Finding(
+                    checker=NAME,
+                    file=file,
+                    line=line,
+                    key=f"duplicate:{name}",
+                    message=(
+                        f"metric {name!r} declared more than once (first at "
+                        f"{sites[0][0]}); declare once and import the object"
+                    ),
+                )
+            )
+    return findings
+
+
+def _use_arity(call: ast.Call) -> Optional[Tuple[str, str, int]]:
+    """(var, method, n_label_args) for a checkable metric-method call."""
+    func = call.func
+    if not (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.attr in METHOD_LEADING
+    ):
+        return None
+    if any(isinstance(arg, ast.Starred) for arg in call.args):
+        return None
+    return func.value.id, func.attr, len(call.args) - METHOD_LEADING[func.attr]
+
+
+def _check_use(module: Module, node: ast.Call, qual: str, by_var) -> Optional[Finding]:
+    use = _use_arity(node)
+    if use is None:
+        return None
+    var, method, got = use
+    specs = set(by_var.get(var, ()))
+    if not specs:
+        return None
+    if {kind for kind, _ in specs} == {"counter"} and method == "set":
+        return Finding(
+            checker=NAME, file=module.rel, line=node.lineno,
+            key=f"counter-set:{var}@{qual}",
+            message=f"{var} is a Counter; set() breaks rate() — use inc()",
+        )
+    arities = {n for _, n in specs}
+    if len(arities) != 1 or None in arities:
+        return None
+    (want,) = arities
+    if got == want:
+        return None
+    return Finding(
+        checker=NAME, file=module.rel, line=node.lineno,
+        key=f"arity:{var}.{method}@{qual}",
+        message=(
+            f"{var}.{method}() passes {got} label value(s); declared with "
+            f"{want} — a mismatched series never joins the dashboards"
+        ),
+    )
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    by_name, by_var = _collect_declarations(modules)
+    findings = _duplicate_findings(by_name)
+    for module in modules:
+        for node, qual in walk_with_qualname(module.tree):
+            if isinstance(node, ast.Call):
+                finding = _check_use(module, node, qual or "<module>", by_var)
+                if finding is not None:
+                    findings.append(finding)
+    return findings
+
+
+CHECKERS = (Checker(NAME, _check),)

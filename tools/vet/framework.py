@@ -1,0 +1,264 @@
+"""Checker framework for the vet suite (see tools/vet/__init__.py).
+
+The moving parts:
+
+- ``Module``: one parsed production source file (path, source lines, AST),
+  loaded once and handed to every checker — the shared AST walk.
+- ``Checker``: a name plus a ``run(modules) -> findings`` function. Checkers
+  get the whole module list (metrics-consistency needs cross-module
+  declarations), not a per-file callback.
+- ``Finding``: one violation, carrying both a ``file:line`` render (so
+  terminal output is clickable) and a line-independent ``key`` used for
+  baselining — baseline entries survive unrelated edits shifting lines.
+- baseline: ``tools/vet/baseline.json`` maps checker name -> list of
+  ``"<file> <key>"`` entries. A finding matching an entry is suppressed; an
+  entry matching NO current finding is *stale* and fails the run (same
+  discipline as the complexity gate's allowlist — a fixed violation must not
+  linger as a silent future budget).
+
+Explicit paths (``python -m tools.vet some/file.py``) scan just those files
+with NO baseline applied: a violation deliberately introduced in a scratch
+file always fails loudly.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str
+    file: str  # repo-root-relative posix path
+    line: int
+    key: str  # stable identity without line numbers, for baselining
+    message: str
+
+    @property
+    def baseline_id(self) -> str:
+        return f"{self.file} {self.key}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line} {self.checker} {self.message}"
+
+
+class Checker:
+    """A named check. ``run(modules)`` returns the findings over the whole
+    scanned tree (most checkers iterate modules independently; whole-program
+    checkers correlate across them)."""
+
+    def __init__(self, name: str, run) -> None:
+        self.name = name
+        self.run = run
+
+
+class Module:
+    """One parsed source file, shared by every checker."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+
+    def line_text(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+# --- shared AST helpers ------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_with_qualname(tree: ast.AST) -> Iterator[Tuple[ast.AST, str]]:
+    """Yield (node, qualname) for every node, where qualname is the
+    Class.method / outer.inner path of the enclosing scopes ('' at module
+    level) — the same spelling the complexity gate uses."""
+    stack: List[Tuple[ast.AST, str]] = [(tree, "")]
+    while stack:
+        node, qual = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                child_qual = f"{qual}.{child.name}" if qual else child.name
+            else:
+                child_qual = qual
+            yield child, child_qual
+            stack.append((child, child_qual))
+
+
+def time_module_aliases(tree: ast.AST) -> set:
+    """Every local name bound to the ``time`` module, at any scope —
+    ``import time``, ``import time as _time`` (runtime-style function-local
+    imports included, since ast.walk sees all scopes)."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    aliases.add(alias.asname or "time")
+    return aliases
+
+
+def scope_allows(allowlist: Dict[str, str], rel: str, qual: str) -> bool:
+    """True when `rel` (whole file) or `rel::<qualname prefix>` carries a
+    documented allowlist entry. Prefix matching lets an entry cover a class
+    and all its methods without enumerating them."""
+    if rel in allowlist:
+        return True
+    parts = qual.split(".") if qual else []
+    for i in range(len(parts)):
+        if f"{rel}::{'.'.join(parts[: i + 1])}" in allowlist:
+            return True
+    return False
+
+
+# --- scope + runner ----------------------------------------------------------
+
+
+def production_scope() -> List[Path]:
+    """The tree the suite holds clean: the package plus the driver entry
+    files. tests/ and tools/ are out of scope by design — the smoke
+    harnesses time real wall-clock budgets and drive subprocesses, which is
+    their job, not a violation."""
+    return sorted((REPO_ROOT / "karpenter_tpu").rglob("*.py")) + [
+        REPO_ROOT / "__graft_entry__.py",
+        REPO_ROOT / "bench.py",
+    ]
+
+
+def load_modules(paths: Iterable[Path]) -> List[Module]:
+    modules = []
+    for path in paths:
+        files = sorted(path.rglob("*.py")) if path.is_dir() else [path]
+        for file in files:
+            try:
+                rel = file.resolve().relative_to(REPO_ROOT).as_posix()
+            except ValueError:  # scanned tree outside the repo
+                rel = file.as_posix()
+            modules.append(Module(file, rel))
+    return modules
+
+
+_production_modules: Optional[List[Module]] = None
+
+
+def production_modules() -> List[Module]:
+    """The default scope, parsed ONCE per process: tier-1 runs the tree
+    gate plus the backend-lint shims, and Modules are immutable — without
+    the cache each call re-reads and re-parses all ~80 files."""
+    global _production_modules
+    if _production_modules is None:
+        _production_modules = load_modules(production_scope())
+    return _production_modules
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> Dict[str, List[str]]:
+    if not path.exists():
+        return {}
+    return json.loads(path.read_text())
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, List[str]]
+) -> Tuple[List[Finding], List[Tuple[str, str]]]:
+    """Suppress baselined findings; return (kept, stale-entries)."""
+    kept: List[Finding] = []
+    matched = set()
+    for finding in findings:
+        if finding.baseline_id in baseline.get(finding.checker, ()):
+            matched.add((finding.checker, finding.baseline_id))
+        else:
+            kept.append(finding)
+    stale = [
+        (checker, entry)
+        for checker, entries in sorted(baseline.items())
+        for entry in entries
+        if (checker, entry) not in matched
+    ]
+    return kept, stale
+
+
+def run_checkers(modules: List[Module]) -> List[Finding]:
+    """Every checker over already-loaded modules, findings sorted."""
+    from tools.vet.checkers import ALL_CHECKERS
+
+    findings: List[Finding] = []
+    for checker in ALL_CHECKERS:
+        findings.extend(checker.run(modules))
+    findings.sort(key=lambda f: (f.file, f.line, f.checker))
+    return findings
+
+
+def run_vet(
+    paths: Optional[Sequence[Path]] = None,
+    baseline: Optional[Dict[str, List[str]]] = None,
+) -> Tuple[List[Finding], List[Tuple[str, str]]]:
+    """Run every checker. Default scope applies the baseline; explicit
+    paths scan raw (see module docstring)."""
+    explicit = paths is not None
+    findings = run_checkers(
+        load_modules(paths) if explicit else production_modules()
+    )
+    if explicit:
+        return findings, []
+    return apply_baseline(
+        findings, load_baseline() if baseline is None else baseline
+    )
+
+
+def checker_findings(name: str, paths: Optional[Sequence[Path]] = None) -> List[Finding]:
+    """One checker, no baseline — the hook test shims call through."""
+    from tools.vet.checkers import ALL_CHECKERS
+
+    checker = next(c for c in ALL_CHECKERS if c.name == name)
+    modules = load_modules(paths) if paths is not None else production_modules()
+    return sorted(
+        checker.run(modules), key=lambda f: (f.file, f.line, f.checker)
+    )
+
+
+def main(argv: Sequence[str]) -> int:
+    from tools.vet.checkers import ALL_CHECKERS
+
+    paths = [Path(p) for p in argv] or None
+    if paths:
+        missing = [p for p in paths if not p.exists()]
+        if missing:
+            print(f"ERROR: no such path: {', '.join(map(str, missing))}")
+            return 2
+    modules = load_modules(paths) if paths is not None else production_modules()
+    findings = run_checkers(modules)
+    stale: List[Tuple[str, str]] = []
+    if paths is None:
+        findings, stale = apply_baseline(findings, load_baseline())
+    for finding in findings:
+        print(finding.render())
+    for checker, entry in stale:
+        print(f"stale baseline entry ({checker}): {entry}")
+    if findings or stale:
+        print(f"\nFAIL: vet found {len(findings)} violation(s), {len(stale)} stale baseline entr(ies)")
+        return 1
+    print(f"OK: {len(ALL_CHECKERS)} checkers clean over {len(modules)} files")
+    return 0
