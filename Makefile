@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -58,6 +58,15 @@ crash-smoke:
 interruption-smoke:
 	timeout -k 10 120 python tools/interruption_smoke.py
 
+# The consolidation churn storm (tools/consolidation_smoke.py): scale up on
+# the fake provider, churn the workload down, sweep to convergence with
+# mid-storm crash+restarts at rotating consolidation crashpoints, then
+# assert steady-state cost_ratio strictly improved, PDBs never violated,
+# and zero leaked instances. Hard 120s timeout: a sweep that re-grows an
+# unbounded wait fails fast instead of wedging a driver run.
+consolidation-smoke:
+	timeout -k 10 120 python tools/consolidation_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -65,6 +74,7 @@ smoke:
 	$(MAKE) crash-smoke || rc=1; \
 	$(MAKE) degraded-smoke || rc=1; \
 	$(MAKE) interruption-smoke || rc=1; \
+	$(MAKE) consolidation-smoke || rc=1; \
 	exit $$rc
 
 proto:

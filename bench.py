@@ -141,6 +141,125 @@ def bench_bind(num_pods=10_000, pods_per_node=100):
     return elapsed_ms
 
 
+def bench_consolidation_churn(nodes=12, pods_per_node=4, seed=0):
+    """Steady-state churn scenario for the consolidation subsystem: scale a
+    fleet up on the fake provider, churn most of the workload away (the
+    cost drift the reference never recovers from — BENCH_r05 steady-state
+    cost_ratio 0.64 happens because capacity only ever grows), then run
+    consolidation sweeps to convergence. Reports cluster $/hr before the
+    sweeps (which IS the no-consolidation baseline: without the subsystem
+    the fleet never shrinks) and after, plus the converged cost_ratio
+    (after/before; < 1 = consolidation recovered cost) and action counts.
+    Fake clock + fake provider, no device work — this measures the control
+    loop's outcome, not solver latency."""
+    import random
+
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.api.pods import PodSpec
+    from karpenter_tpu.cloudprovider.fake import (
+        FakeCloudProvider,
+        consolidation_instance_types,
+    )
+    from karpenter_tpu.controllers.cluster import Cluster
+    from karpenter_tpu.controllers.consolidation import (
+        CONSOLIDATION_ACTIONS_TOTAL,
+        ConsolidationController,
+    )
+    from karpenter_tpu.controllers.node import NodeController
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.selection import SelectionController
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    cluster = Cluster(clock=clock)
+    cloud = FakeCloudProvider(
+        instance_types=consolidation_instance_types(), clock=clock
+    )
+    provisioning = ProvisioningController(cluster, cloud, None)
+    selection = SelectionController(cluster, provisioning)
+    termination = TerminationController(cluster, cloud)
+    node_lifecycle = NodeController(cluster)
+    consolidation = ConsolidationController(
+        cluster, cloud, provisioning, termination
+    )
+    cluster.apply_provisioner(Provisioner(name="churn", spec=ProvisionerSpec()))
+    provisioning.reconcile("churn")
+
+    pods = [
+        PodSpec(
+            name=f"churn-{i}",
+            requests={"cpu": "4", "memory": "2Gi"},
+            unschedulable=True,
+        )
+        for i in range(nodes * pods_per_node)
+    ]
+    for pod in pods:
+        cluster.apply_pod(pod)
+        selection.reconcile(pod.namespace, pod.name)
+    for worker in provisioning.workers.values():
+        worker.provision()
+
+    def beat():
+        consolidation.reconcile()
+        for worker in list(provisioning.workers.values()):
+            worker.provision()
+        for node in list(cluster.list_nodes()):
+            if not node.ready:
+                node.ready = True
+                node.status_reported_at = clock.now()
+                cluster.update_node(node)
+            node_lifecycle.reconcile(node.name)  # strips the not-ready taint
+            termination.reconcile(node.name)
+        termination.evictions.drain_once()
+
+    def cost() -> float:
+        catalog = {it.name: it for it in cloud.get_instance_types()}
+        total = 0.0
+        for node in cluster.list_nodes():
+            it = catalog.get(node.instance_type)
+            for offering in it.offerings if it else ():
+                if (
+                    offering.zone == node.zone
+                    and offering.capacity_type == node.capacity_type
+                ):
+                    total += offering.price
+                    break
+        return total
+
+    beat()  # mark nodes ready before the churn
+    # Churn: a seeded random two-thirds of the workload terminates.
+    rng = random.Random(seed)
+    victims = rng.sample(pods, (2 * len(pods)) // 3)
+    for pod in victims:
+        cluster.delete_pod(pod.namespace, pod.name)
+    cost_before = cost()
+    nodes_before = len(cluster.list_nodes())
+
+    def executed() -> float:
+        return CONSOLIDATION_ACTIONS_TOTAL.get(
+            "delete", "executed"
+        ) + CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed")
+
+    began_actions = executed()
+    began = time.perf_counter()
+    flat = 0
+    while flat < 3:  # converged = three beats with no new action
+        before = executed()
+        beat()
+        clock.advance(1.0)
+        flat = flat + 1 if executed() == before else 0
+    return {
+        "nodes_before": nodes_before,
+        "nodes_after": len(cluster.list_nodes()),
+        "cost_before": round(cost_before, 4),
+        "cost_after": round(cost(), 4),
+        "cost_ratio": round(cost() / cost_before, 4) if cost_before else 1.0,
+        "actions": int(executed() - began_actions),
+        "converge_ms": round((time.perf_counter() - began) * 1e3, 1),
+    }
+
+
 def bench_pod_storm(num_pods=10_000, concurrencies=(8, 32, 128), reps=1):
     """Pod-storm pipeline benchmark: drive num_pods unschedulable pods
     through the RUNNING threaded Manager over the apiserver-backed cluster
@@ -690,6 +809,11 @@ def main():
                 "stretch": stretch,
                 "pod_storm_10k": pod_storm,
                 "pod_storm_50k": pod_storm_50k,
+                # Steady-state churn + consolidation convergence (fake
+                # provider): cost_ratio is after/before — strictly < 1 means
+                # the new subsystem recovers cost the reference's
+                # grow-only lifecycle leaves on the table.
+                "consolidation_churn": bench_consolidation_churn(),
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),

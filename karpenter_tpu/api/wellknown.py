@@ -65,6 +65,11 @@ INTERRUPTION_DEADLINE_ANNOTATION = "karpenter.sh/interruption-deadline"
 # restart-idempotent provider would "adopt" the dying instance and rebind the
 # pod onto the node being reclaimed.
 RESCHEDULE_EPOCH_ANNOTATION = "karpenter.sh/reschedule-epoch"
+# Consolidation intent ("delete" | "replace"), stamped onto the victim Node
+# BEFORE any pod is displaced — the durable record a restarted controller
+# resumes the drain from (controllers/consolidation.py). Doubles as the
+# in-flight marker that caps concurrent voluntary disruption.
+CONSOLIDATION_ACTION_ANNOTATION = "karpenter.sh/consolidation-action"
 
 # --- Resource names --------------------------------------------------------
 RESOURCE_CPU = "cpu"

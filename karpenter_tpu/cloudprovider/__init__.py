@@ -25,11 +25,17 @@ OS_LINUX = "linux"
 
 @dataclass(frozen=True)
 class Offering:
-    """One purchasable (zone, capacity-type) combination for an instance type."""
+    """One purchasable (zone, capacity-type) combination for an instance type.
+
+    `consolidatable` is the provider's hint that capacity bought from this
+    pool may be voluntarily deprovisioned by the consolidation controller —
+    False marks commitments (reserved capacity, capacity blocks) where
+    shedding the node saves nothing because the bill keeps running."""
 
     zone: str
     capacity_type: str = wellknown.CAPACITY_TYPE_ON_DEMAND
     price: float = 0.0  # $/hr; 0.0 = unknown
+    consolidatable: bool = True
 
 
 @dataclass

@@ -109,6 +109,45 @@ def default_instance_types() -> List[InstanceType]:
     ]
 
 
+def consolidation_instance_types() -> List[InstanceType]:
+    """Utilization fixtures for the consolidation sweep: a size ladder with
+    an unambiguous cheaper-replacement structure (big-instance-type strictly
+    dominates mid and small on capacity while costing proportionally more,
+    so a drained-down big node always has a strictly cheaper feasible
+    replacement), plus a reserved pool whose offerings are marked
+    consolidatable=False — capacity bought there must never be nominated."""
+    return [
+        InstanceType(
+            name="small-consolidation-type",
+            capacity={"cpu": 4, "memory": "16Gi", "pods": 110},
+            offerings=_offerings(0.2),
+        ),
+        InstanceType(
+            name="mid-consolidation-type",
+            capacity={"cpu": 8, "memory": "32Gi", "pods": 110},
+            offerings=_offerings(0.4),
+        ),
+        InstanceType(
+            name="big-consolidation-type",
+            capacity={"cpu": 16, "memory": "64Gi", "pods": 110},
+            offerings=_offerings(0.8),
+        ),
+        InstanceType(
+            name="reserved-consolidation-type",
+            capacity={"cpu": 16, "memory": "64Gi", "pods": 110},
+            offerings=[
+                Offering(
+                    zone=zone,
+                    capacity_type=wellknown.CAPACITY_TYPE_ON_DEMAND,
+                    price=0.5,
+                    consolidatable=False,
+                )
+                for zone in ZONES
+            ],
+        ),
+    ]
+
+
 def instance_type_ladder(n: int) -> List[InstanceType]:
     """Linear size ladder for benchmarks (ref: fake/instancetype.go:69-80)."""
     return [

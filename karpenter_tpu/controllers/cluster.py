@@ -270,6 +270,16 @@ class Cluster:
     def update_node(self, node: NodeSpec) -> None:
         self._notify("node", node)
 
+    def remove_node_annotation(self, node: NodeSpec, key: str) -> None:
+        """Delete one annotation. A dedicated verb because removal does NOT
+        survive update_node on the apiserver backend: its merge-patch sends
+        the annotations map, and RFC 7386 keeps server keys absent from the
+        patch — the popped key would resurrect through the watch pump. The
+        apiserver override patches the key to null explicitly."""
+        with self._lock:
+            node.annotations.pop(key, None)
+        self._notify("node", node)
+
     def delete_node(self, name: str) -> None:
         """Marks deletion; the object lingers while finalizers remain
         (ref: the apiserver finalizer protocol driving termination §3.4)."""

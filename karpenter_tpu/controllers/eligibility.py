@@ -1,0 +1,60 @@
+"""Shared node-disruption eligibility predicates.
+
+Emptiness TTL deletion (controllers/node.py) and consolidation
+(controllers/consolidation.py) are both VOLUNTARY disruption paths — they
+choose to remove capacity that could keep running. Before this module each
+carried its own copy of "may I touch this node", and the copies could
+disagree: a node stamped with the emptiness timestamp could concurrently be
+nominated for a consolidation replace, double-disrupting it. The predicates
+live here exactly once; both controllers import them, so they cannot drift.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.controllers.cluster import Cluster
+
+
+def is_workload_pod(pod: PodSpec) -> bool:
+    """Counts against emptiness / consolidation headroom: a live pod not
+    bound to the node by ownership (daemon/static pods die with the node)
+    and not already on its way out (ref: emptiness.go isEmpty:84)."""
+    return not (
+        pod.is_terminal()
+        or pod.is_terminating()
+        or pod.is_owned_by_daemonset()
+        or pod.is_owned_by_node()
+    )
+
+
+def is_empty(cluster: Cluster, node: NodeSpec) -> bool:
+    """Empty = no workload pods (only daemons/static/terminating remain)."""
+    for pod in cluster.list_pods(node_name=node.name):
+        if is_workload_pod(pod):
+            return False
+    return True
+
+
+def voluntary_disruption_allowed(node: NodeSpec) -> bool:
+    """A node may be voluntarily disrupted only when no other lifecycle owns
+    it: it has joined (ready), is not already deleting (the finalizer path
+    owns it), and carries no interruption notice (the reclamation drain owns
+    it — voluntary cost actions must never fight the deadline-driven one)."""
+    return (
+        node.ready
+        and node.deletion_timestamp is None
+        and wellknown.INTERRUPTION_KIND_ANNOTATION not in node.annotations
+    )
+
+
+def emptiness_owns(provisioner, node: NodeSpec) -> bool:
+    """True when the emptiness TTL path has claimed this node (the TTL is
+    configured and the timestamp is stamped): its deletion is already
+    scheduled, so consolidation must not concurrently nominate it."""
+    return (
+        provisioner is not None
+        and provisioner.spec.ttl_seconds_after_empty is not None
+        and wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in node.annotations
+    )

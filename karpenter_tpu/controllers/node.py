@@ -12,6 +12,7 @@ from typing import List, Optional
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.taints import Taint
 from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.controllers import eligibility
 from karpenter_tpu.controllers.cluster import Cluster
 
 LIVENESS_TIMEOUT_SECONDS = 15 * 60  # ref: node/liveness.go:31
@@ -78,12 +79,18 @@ class Emptiness:
         ttl = provisioner.spec.ttl_seconds_after_empty
         if ttl is None:
             return None
-        if not node.ready:
+        # Shared voluntary-disruption gate (controllers/eligibility.py): the
+        # same predicate consolidation nominates through, so an interrupted
+        # or already-deleting node can't be claimed by both paths at once.
+        if not eligibility.voluntary_disruption_allowed(node):
             return None
-        if not self._is_empty(cluster, node):
+        if not eligibility.is_empty(cluster, node):
             if wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in node.annotations:
-                del node.annotations[wellknown.EMPTINESS_TIMESTAMP_ANNOTATION]
-                cluster.update_node(node)
+                # The dedicated removal verb: a plain update_node merge-patch
+                # cannot delete the key on the apiserver backend.
+                cluster.remove_node_annotation(
+                    node, wellknown.EMPTINESS_TIMESTAMP_ANNOTATION
+                )
             return None
         stamp = node.annotations.get(wellknown.EMPTINESS_TIMESTAMP_ANNOTATION)
         now = cluster.clock.now()
@@ -96,18 +103,6 @@ class Emptiness:
             cluster.delete_node(node.name)
             return None
         return ttl - elapsed
-
-    @staticmethod
-    def _is_empty(cluster: Cluster, node: NodeSpec) -> bool:
-        """Empty = no pods besides daemons/static pods
-        (ref: emptiness.go isEmpty:84)."""
-        for pod in cluster.list_pods(node_name=node.name):
-            if pod.is_terminal() or pod.is_terminating():
-                continue
-            if pod.is_owned_by_daemonset() or pod.is_owned_by_node():
-                continue
-            return False
-        return True
 
 
 class Finalizer:

@@ -498,6 +498,20 @@ class ApiServerCluster(Cluster):
                 raise
         super().update_node(node)
 
+    def remove_node_annotation(self, node: NodeSpec, key: str) -> None:
+        # Merge-patch null is the only way to DELETE a key server-side
+        # (RFC 7386); sending the remaining map would leave it in place and
+        # the watch pump would resurrect it into the cache.
+        try:
+            updated = self.api.patch(
+                f"{NODES}/{node.name}", {"metadata": {"annotations": {key: None}}}
+            )
+            self._record_rv("node", updated)
+        except ApiError as error:
+            if error.status != 404:
+                raise
+        super().remove_node_annotation(node, key)
+
     def delete_node(self, name: str) -> None:
         try:
             self.api.delete(f"{NODES}/{name}")

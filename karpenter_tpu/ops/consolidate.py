@@ -1,0 +1,239 @@
+"""Batched consolidation counterfactuals — the deprovisioning solve.
+
+The provisioning kernels answer "what capacity should be BOUGHT for these
+pending pods"; this module answers the inverse question the consolidation
+controller asks about capacity already RUNNING: for every candidate node,
+what happens to the cluster if the node were gone?
+
+Two counterfactual actions are scored for all candidates in ONE batched
+dispatch per sweep (the Go reference simulates candidates one at a time;
+evaluating the [C, N] / [C, T] tensors together is exactly the shape the
+batched solver was built for):
+
+- **delete** — the candidate's pods are first-fit-decreasing packed into the
+  free headroom of the remaining nodes ([C, N, R] fill, victim row masked
+  out per candidate). Feasible iff every pod places; savings = the node's
+  whole offering price.
+- **replace** — the candidate's pods move onto ONE fresh node of a cheaper
+  type. For a single receiving node, multi-dimensional feasibility is exact
+  additivity: total demand <= usable capacity, which is score_kernel's
+  `feasibility_mask` with the [C, R] demand matrix standing in for the group
+  axis. Savings = node price minus the cheapest feasible type's price.
+
+Per-candidate masking carries the envelope differences between candidates
+(`bin_mask` excludes the victim and ineligible receivers per candidate;
+`type_valid` carries per-candidate accelerator anti-waste), so heterogeneous
+candidates still share the single dispatch. Shapes are bucketed to powers of
+two (ops.pack_kernel.bucket_size) so repeat sweeps hit the jit cache, and
+the outputs come back in one device_get.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.ops.pack_kernel import bucket_size, pad_to
+from karpenter_tpu.ops.score_kernel import feasibility_mask
+
+ACTION_NONE = 0
+ACTION_DELETE = 1
+ACTION_REPLACE = 2
+
+# Savings below this ($/hr) are noise, not a reason to disrupt a node.
+MIN_SAVINGS_DOLLARS = 1e-6
+
+
+@dataclass
+class ConsolidationProblem:
+    """Dense inputs for one batched counterfactual solve.
+
+    pod_vectors/pod_counts are the candidates' replaceable pods grouped by
+    identical request vector (ops.encode.group_pods order: FFD-sorted desc),
+    zero-padded to a common group axis. headroom is the free USABLE capacity
+    of every live receiver node; bin_mask[c, j] says node j may receive
+    candidate c's pods (False on the victim's own row and on ineligible
+    receivers). type_capacity/type_prices densify the replacement fleet
+    (build_fleet output: usable capacity, cheapest allowed offering price);
+    type_valid[c, t] carries per-candidate masking (accelerator anti-waste).
+    """
+
+    pod_vectors: np.ndarray  # [C, G, R] float32
+    pod_counts: np.ndarray  # [C, G] int32
+    headroom: np.ndarray  # [N, R] float32
+    bin_mask: np.ndarray  # [C, N] bool
+    node_prices: np.ndarray  # [C] float64 — candidate's current offering $/hr
+    type_capacity: np.ndarray  # [T, R] float32
+    type_prices: np.ndarray  # [T] float32
+    type_valid: np.ndarray  # [C, T] bool
+
+    @property
+    def num_candidates(self) -> int:
+        return int(self.pod_vectors.shape[0])
+
+
+@dataclass
+class ConsolidationVerdicts:
+    """Per-candidate scores, one row per ConsolidationProblem candidate."""
+
+    delete_ok: np.ndarray  # [C] bool — every pod placed into headroom
+    delete_take: np.ndarray  # [C, G, N] int32 — pods of group g into bin j
+    replace_type: np.ndarray  # [C] int32 — cheapest feasible type (by index)
+    replace_price: np.ndarray  # [C] float — inf when no feasible type
+    savings: np.ndarray  # [C] float — $/hr shed by the best action (-inf none)
+    action: np.ndarray  # [C] int8 — ACTION_NONE | ACTION_DELETE | ACTION_REPLACE
+
+    def best(self) -> int:
+        """Index of the best cost-positive candidate, or -1."""
+        if self.savings.size == 0:
+            return -1
+        index = int(np.argmax(self.savings))
+        if self.action[index] == ACTION_NONE:
+            return -1
+        return index
+
+
+def _counterfactual_body(
+    pod_vectors, pod_counts, headroom, bin_mask, type_capacity, type_prices, type_valid
+):
+    """The fused counterfactual math — one traced computation per shape
+    bucket. Delete leg: batched first-fit-decreasing fill of the [C, N, R]
+    masked headroom (groups arrive FFD-sorted; per group the cumulative-sum
+    cutoff distributes the count across bins in row order — first-fit
+    without a per-pod loop). Replace leg: score_kernel.feasibility_mask
+    over the [C, R] total demand."""
+    counts = pod_counts.astype(jnp.float32)
+    room = jnp.where(bin_mask[:, :, None], headroom[None, :, :], 0.0)
+
+    def place(carry, g):
+        vec = pod_vectors[:, g, :]  # [C, R]
+        cnt = counts[:, g]  # [C]
+        positive = vec > 0
+        ratio = jnp.where(
+            positive[:, None, :],
+            carry / jnp.maximum(vec[:, None, :], 1e-9),
+            jnp.inf,
+        )  # [C, N, R]
+        fit = jnp.floor(jnp.min(ratio, axis=2) + 1e-6)  # [C, N]
+        # A group with an all-zero vector (padded rows) fits anywhere.
+        fit = jnp.where(jnp.isinf(fit), cnt[:, None], fit)
+        fit = jnp.maximum(fit, 0.0)
+        before = jnp.cumsum(fit, axis=1) - fit
+        take = jnp.clip(cnt[:, None] - before, 0.0, fit)  # [C, N]
+        carry = carry - take[:, :, None] * vec[:, None, :]
+        return carry, take
+
+    _, takes = jax.lax.scan(place, room, jnp.arange(pod_vectors.shape[1]))
+    takes = jnp.transpose(takes, (1, 0, 2))  # [C, G, N]
+    placed = takes.sum(axis=2)  # [C, G]
+    delete_ok = jnp.all(placed >= counts - 0.5, axis=1)
+
+    demand = (pod_vectors * counts[:, :, None]).sum(axis=1)  # [C, R]
+    fits = feasibility_mask(
+        demand, type_capacity, jnp.ones(type_capacity.shape[0], bool)
+    )  # [C, T]
+    fits = fits & type_valid
+    priced = jnp.where(fits, type_prices[None, :], jnp.inf)
+    replace_price = priced.min(axis=1)
+    replace_type = jnp.argmin(priced, axis=1)
+    return (
+        takes.astype(jnp.int32),
+        delete_ok,
+        replace_type.astype(jnp.int32),
+        replace_price,
+    )
+
+
+_counterfactual_kernel = jax.jit(_counterfactual_body)
+
+
+def _padded(problem: ConsolidationProblem) -> Tuple:
+    """Bucket-pad every axis to powers of two so repeat sweeps reuse the
+    compiled kernel. Padded candidates carry zero counts, padded bins a
+    False mask, padded types a False validity column."""
+    c_pad = bucket_size(max(problem.num_candidates, 1))
+    g_pad = bucket_size(max(int(problem.pod_vectors.shape[1]), 1))
+    n_pad = bucket_size(max(int(problem.headroom.shape[0]), 1))
+    t_pad = bucket_size(max(int(problem.type_capacity.shape[0]), 1))
+    return (
+        pad_to(pad_to(problem.pod_vectors.astype(np.float32), c_pad), g_pad, axis=1),
+        pad_to(pad_to(problem.pod_counts.astype(np.int32), c_pad), g_pad, axis=1),
+        pad_to(problem.headroom.astype(np.float32), n_pad),
+        pad_to(pad_to(problem.bin_mask.astype(bool), c_pad), n_pad, axis=1),
+        pad_to(problem.type_capacity.astype(np.float32), t_pad),
+        pad_to(problem.type_prices.astype(np.float32), t_pad),
+        pad_to(pad_to(problem.type_valid.astype(bool), c_pad), t_pad, axis=1),
+    )
+
+
+def solve_candidates(problem: ConsolidationProblem) -> ConsolidationVerdicts:
+    """Score every candidate's delete and replace counterfactuals in one
+    batched dispatch + one device->host fetch, then pick each candidate's
+    best cost-positive action host-side (delete preferred on ties — it
+    sheds the whole node instead of trading it)."""
+    num_candidates = problem.num_candidates
+    num_groups = int(problem.pod_vectors.shape[1])
+    num_bins = int(problem.headroom.shape[0])
+    vectors, counts, headroom, bin_mask, capacity, prices, valid = _padded(problem)
+    fetched = jax.device_get(
+        _counterfactual_kernel(
+            vectors, counts, headroom, bin_mask, capacity, prices, valid
+        )
+    )
+    takes, delete_ok, replace_type, replace_price = fetched
+    takes = np.asarray(takes)[:num_candidates, :num_groups, :num_bins]
+    delete_ok = np.asarray(delete_ok)[:num_candidates]
+    replace_type = np.asarray(replace_type)[:num_candidates]
+    replace_price = np.asarray(replace_price, dtype=np.float64)[:num_candidates]
+
+    node_prices = problem.node_prices.astype(np.float64)
+    savings_delete = np.where(delete_ok, node_prices, -np.inf)
+    replace_margin = node_prices - replace_price
+    savings_replace = np.where(
+        np.isfinite(replace_price) & (replace_margin > MIN_SAVINGS_DOLLARS),
+        replace_margin,
+        -np.inf,
+    )
+    action = np.full(num_candidates, ACTION_NONE, dtype=np.int8)
+    action[savings_replace > MIN_SAVINGS_DOLLARS] = ACTION_REPLACE
+    # Delete wins ties: shedding a node beats trading it at equal savings.
+    action[
+        (savings_delete > MIN_SAVINGS_DOLLARS) & (savings_delete >= savings_replace)
+    ] = ACTION_DELETE
+    savings = np.where(
+        action == ACTION_DELETE,
+        savings_delete,
+        np.where(action == ACTION_REPLACE, savings_replace, -np.inf),
+    )
+    return ConsolidationVerdicts(
+        delete_ok=delete_ok,
+        delete_take=takes,
+        replace_type=replace_type,
+        replace_price=replace_price,
+        savings=savings,
+        action=action,
+    )
+
+
+def delete_assignment(
+    verdicts: ConsolidationVerdicts, candidate: int, members: List[List]
+) -> List[Tuple[object, int]]:
+    """Decode one candidate's delete plan into (pod, bin index) pairs.
+    `members` is the candidate's PodGroups.members (group-major, the order
+    the counts were encoded in); pods are consumed group-cursor style like
+    models.solver._decode_rounds."""
+    plan: List[Tuple[object, int]] = []
+    take = verdicts.delete_take[candidate]
+    for g, group_members in enumerate(members):
+        cursor = 0
+        for j in np.nonzero(take[g] > 0)[0]:
+            n = int(take[g, j])
+            for pod in group_members[cursor : cursor + n]:
+                plan.append((pod, int(j)))
+            cursor += n
+    return plan

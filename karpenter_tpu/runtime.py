@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.metrics import MetricsController, POLL_SECONDS
 from karpenter_tpu.controllers.node import NodeController
@@ -427,6 +428,14 @@ class Manager:
             self.termination,
             escalate_fraction=options.interruption_escalate_fraction,
         )
+        self.consolidation = ConsolidationController(
+            cluster,
+            cloud,
+            self.provisioning,
+            self.termination,
+            max_disruption=options.consolidation_max_disruption,
+            cooldown_seconds=options.consolidation_cooldown,
+        )
         self.ready = threading.Event()
         # Set once the solver's compile debt is paid (immediately for host
         # solvers). Gates /readyz AND the batch loop: a batch window that
@@ -492,6 +501,11 @@ class Manager:
             # ahead of the deadline, replace before the pods land.
             "interruption": ReconcileLoop(
                 "interruption", self.interruption.reconcile, concurrency=1
+            ),
+            # Consolidation sweep: re-solve the live cluster for cost and
+            # shed/replace capacity the workload no longer justifies.
+            "consolidation": ReconcileLoop(
+                "consolidation", self.consolidation.reconcile, concurrency=1
             ),
         }
 
@@ -575,6 +589,7 @@ class Manager:
         self.loops["podgc"].enqueue("sweep")
         self.loops["instancegc"].enqueue("sweep")
         self.loops["interruption"].enqueue("sweep")
+        self.loops["consolidation"].enqueue("sweep")
         if getattr(self.solver, "needs_device_warmup", False):
             from karpenter_tpu.utils import backend_health
 

@@ -65,6 +65,17 @@ INTERRUPTION_SITES = (
     "interruption.before-delete",
 )
 
+# Consolidation pipeline commit points (docs/design/consolidation.md):
+# - ``consolidation.after-nominate``  action annotation stamped on the
+#   victim, nothing displaced yet
+# - ``consolidation.mid-drain``       fires per displaced pod (arm with at=N)
+# - ``consolidation.before-delete``   drain done, node deletion not yet issued
+CONSOLIDATION_SITES = (
+    "consolidation.after-nominate",
+    "consolidation.mid-drain",
+    "consolidation.before-delete",
+)
+
 
 class SimulatedCrash(BaseException):
     """The controller process 'died' at a named site. BaseException so no

@@ -57,6 +57,13 @@ class Options:
     # (PDB-respecting, do-not-evict honored) before the drain overrides both
     # rather than losing pods to the reclaim (controllers/interruption.py).
     interruption_escalate_fraction: float = 0.5
+    # Disruption budget for the consolidation sweep: at most this many nodes
+    # voluntarily disrupted per sweep (in-flight victims count against it);
+    # 0 disables consolidation entirely (controllers/consolidation.py).
+    consolidation_max_disruption: int = 1
+    # Seconds of quiet after any interruption/termination activity before
+    # consolidation acts again — the voluntary path yields to reclamation.
+    consolidation_cooldown: float = 60.0
 
     def validate(self) -> None:
         errors: List[str] = []
@@ -76,6 +83,16 @@ class Options:
             errors.append(
                 "interruption-escalate-fraction must be in (0, 1], got "
                 f"{self.interruption_escalate_fraction}"
+            )
+        if self.consolidation_max_disruption < 0:
+            errors.append(
+                "consolidation-max-disruption must be >= 0 (0 disables), got "
+                f"{self.consolidation_max_disruption}"
+            )
+        if self.consolidation_cooldown < 0:
+            errors.append(
+                f"consolidation-cooldown must be >= 0, got "
+                f"{self.consolidation_cooldown}"
             )
         if self.cluster_store != "memory" and self.cluster_store != "incluster" and not self.cluster_store.startswith(
             ("http://", "https://")
@@ -122,6 +139,14 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         "--interruption-escalate-fraction", type=float,
         default=float(_env("INTERRUPTION_ESCALATE_FRACTION", "0.5")),
     )
+    parser.add_argument(
+        "--consolidation-max-disruption", type=int,
+        default=int(_env("CONSOLIDATION_MAX_DISRUPTION", "1")),
+    )
+    parser.add_argument(
+        "--consolidation-cooldown", type=float,
+        default=float(_env("CONSOLIDATION_COOLDOWN", "60")),
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -138,6 +163,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         cluster_store=args.cluster_store,
         selection_concurrency=args.selection_concurrency,
         interruption_escalate_fraction=args.interruption_escalate_fraction,
+        consolidation_max_disruption=args.consolidation_max_disruption,
+        consolidation_cooldown=args.consolidation_cooldown,
     )
     options.validate()
     return options

@@ -13,6 +13,7 @@ from typing import List, Optional, Sequence
 from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
 from karpenter_tpu.controllers.instancegc import InstanceGcController
 from karpenter_tpu.controllers.interruption import InterruptionController
@@ -81,6 +82,9 @@ class Harness:
         self.metrics = MetricsController(self.cluster)
         self.instancegc = InstanceGcController(self.cluster, self.cloud)
         self.interruption = InterruptionController(
+            self.cluster, self.cloud, self.provisioning, self.termination
+        )
+        self.consolidation = ConsolidationController(
             self.cluster, self.cloud, self.provisioning, self.termination
         )
 

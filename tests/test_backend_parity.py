@@ -13,6 +13,7 @@ duration and closes the watch pumps the apiserver harnesses start.
 import pytest
 
 from tests import harness as harness_mod
+from tests import test_consolidation as consolidation
 from tests import test_crash_consistency as crash
 from tests import test_interruption as interruption
 from tests import test_node_lifecycle as lifecycle
@@ -126,4 +127,22 @@ class TestInterruptionOnApiserver(interruption.TestInterruption):
 
 
 class TestInterruptionCrashMatrixOnApiserver(interruption.TestInterruptionCrashMatrix):
+    pass
+
+
+class TestConsolidationOnApiserver(consolidation.TestConsolidation):
+    """The consolidation battletest against the fake apiserver: the action
+    annotation is durable Node metadata, displacement is a real merge-patch,
+    and delete-plan rebinds are fresh Binding POSTs."""
+
+
+class TestConsolidationCrashMatrixOnApiserver(
+    consolidation.TestConsolidationCrashMatrix
+):
+    pass
+
+
+class TestConsolidationChurnOnApiserver(
+    consolidation.TestConsolidationChurnConvergence
+):
     pass

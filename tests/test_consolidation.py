@@ -1,0 +1,719 @@
+"""Consolidation battletest: underutilized capacity must be shed (delete)
+or traded down (replace) through the drain path — PDB-gated, never
+overriding protections, yielding to the reclamation path, one disruption
+budget per sweep — and the same properties must survive a controller killed
+at any consolidation crashpoint.
+
+`make consolidation-smoke` wraps the churn-storm chaos harness
+(tools/consolidation_smoke.py) around the same subsystem; this module is
+the deterministic matrix. test_backend_parity re-runs the classes against
+the fake apiserver.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.cloudprovider.fake import consolidation_instance_types
+from karpenter_tpu.controllers import eligibility
+from karpenter_tpu.controllers.consolidation import (
+    CONSOLIDATION_ACTIONS_TOTAL,
+    CONSOLIDATION_CANDIDATES,
+    CONSOLIDATION_SAVINGS_TOTAL,
+    ConsolidationController,
+)
+from karpenter_tpu.controllers.instancegc import (
+    LAUNCH_GRACE_SECONDS,
+    InstanceGcController,
+)
+from karpenter_tpu.controllers.interruption import InterruptionController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.ops import consolidate
+from karpenter_tpu.utils import crashpoints
+from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+from tests import fixtures
+from tests.harness import Harness
+from tests.test_interruption import BindRecorder
+
+ANNOTATION = wellknown.CONSOLIDATION_ACTION_ANNOTATION
+
+
+def consolidation_harness(pods):
+    """Harness on the consolidation catalog + provisioner + pods provisioned
+    and every node marked ready (consolidation only disrupts joined nodes)."""
+    h = Harness(instance_types=consolidation_instance_types())
+    recorder = BindRecorder(h.cluster)
+    h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    h.provision(*pods)
+    ready_all(h)
+    return h, recorder
+
+
+def ready_all(h: Harness) -> None:
+    """The kubelet-join flow: mark ready, then let the node reconciler strip
+    the not-ready taint (receivers with NoSchedule taints are excluded from
+    consolidation's counterfactual bins)."""
+    for node in h.cluster.list_nodes():
+        if not node.ready:
+            node.ready = True
+            node.status_reported_at = h.clock.now()
+            h.cluster.update_node(node)
+        if node.deletion_timestamp is None:
+            h.node.reconcile(node.name)
+
+
+def scale_down(h: Harness, pods) -> None:
+    for pod in pods:
+        h.cluster.delete_pod(pod.namespace, pod.name)
+
+
+def converge(h: Harness, rounds: int = 6) -> None:
+    """Drive consolidation sweeps + provisioning + terminations to a
+    fixpoint (new capacity marked ready as it lands, like a joining
+    kubelet)."""
+    for _ in range(rounds):
+        h.consolidation.reconcile()
+        for worker in list(h.provisioning.workers.values()):
+            worker.provision()
+        ready_all(h)
+        h.reconcile_terminations(rounds=3)
+
+
+def restart(h: Harness) -> None:
+    """A controller-process restart over the surviving cluster + cloud
+    state, plus the boot re-list routing pending pods through selection."""
+    h.provisioning = ProvisioningController(h.cluster, h.cloud, None)
+    h.selection = SelectionController(h.cluster, h.provisioning)
+    h.termination = TerminationController(h.cluster, h.cloud)
+    h.instancegc = InstanceGcController(h.cluster, h.cloud)
+    h.interruption = InterruptionController(
+        h.cluster, h.cloud, h.provisioning, h.termination
+    )
+    h.consolidation = ConsolidationController(
+        h.cluster, h.cloud, h.provisioning, h.termination
+    )
+    for provisioner in h.cluster.list_provisioners():
+        h.provisioning.reconcile(provisioner.name)
+    for pod in h.cluster.list_pods():
+        if pod.is_provisionable():
+            h.selection.reconcile(pod.namespace, pod.name)
+
+
+def assert_no_leaks(h: Harness) -> None:
+    h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+    h.instancegc.reconcile()
+    h.instancegc.reconcile()
+    node_ids = {n.provider_id for n in h.cluster.list_nodes()}
+    leaked = set(h.cloud.instances) - node_ids
+    assert not leaked, f"instances with no Node after GC grace: {sorted(leaked)}"
+
+
+def cluster_cost(h: Harness) -> float:
+    catalog = {it.name: it for it in h.cloud.get_instance_types()}
+    total = 0.0
+    for node in h.cluster.list_nodes():
+        for offering in catalog[node.instance_type].offerings:
+            if (
+                offering.zone == node.zone
+                and offering.capacity_type == node.capacity_type
+            ):
+                total += offering.price
+                break
+    return total
+
+
+class PdbOracle:
+    """Watch-driven PDB health monitor: after EVERY pod mutation each PDB's
+    healthy count must sit at or above minAvailable — the zero-violations
+    acceptance invariant."""
+
+    def __init__(self, h: Harness):
+        self.h = h
+        self.violations = []
+        h.cluster.watch(self._on)
+
+    def _on(self, kind, _obj) -> None:
+        if kind != "pod":
+            return
+        for name, (match_labels, min_available) in list(
+            self.h.cluster._pdbs.items()
+        ):
+            healthy = sum(
+                1
+                for p in self.h.cluster.list_pods()
+                if p.deletion_timestamp is None
+                and p.node_name is not None
+                and all(p.labels.get(k) == v for k, v in match_labels.items())
+            )
+            if healthy < min_available:
+                self.violations.append((name, healthy, min_available))
+
+
+class TestEligibility:
+    """The shared voluntary-disruption predicates (satellite: emptiness TTL
+    deletion and consolidation must read ONE helper)."""
+
+    def test_is_empty_ignores_daemons_and_terminating(self):
+        h = Harness()
+        node = h.cluster.create_node(NodeSpec(name="n1", ready=True))
+        assert eligibility.is_empty(h.cluster, node)
+        daemon = fixtures.pod(owner_kind="DaemonSet")
+        h.cluster.apply_pod(daemon)
+        daemon.node_name = node.name
+        dying = fixtures.pod()
+        dying.deletion_timestamp = h.clock.now()
+        h.cluster.apply_pod(dying)
+        dying.node_name = node.name
+        assert eligibility.is_empty(h.cluster, node)
+        workload = fixtures.pod()
+        h.cluster.apply_pod(workload)
+        workload.node_name = node.name
+        assert not eligibility.is_empty(h.cluster, node)
+
+    def test_voluntary_disruption_gate(self):
+        node = NodeSpec(name="n1", ready=True)
+        assert eligibility.voluntary_disruption_allowed(node)
+        assert not eligibility.voluntary_disruption_allowed(
+            NodeSpec(name="n2", ready=False)
+        )
+        deleting = NodeSpec(name="n3", ready=True)
+        deleting.deletion_timestamp = 1.0
+        assert not eligibility.voluntary_disruption_allowed(deleting)
+        interrupted = NodeSpec(
+            name="n4",
+            ready=True,
+            annotations={wellknown.INTERRUPTION_KIND_ANNOTATION: "spot-interruption"},
+        )
+        assert not eligibility.voluntary_disruption_allowed(interrupted)
+
+    def test_emptiness_claim_blocks_consolidation_nomination(self):
+        provisioner = Provisioner(
+            name="p", spec=ProvisionerSpec(ttl_seconds_after_empty=30)
+        )
+        node = NodeSpec(name="n1", ready=True)
+        assert not eligibility.emptiness_owns(provisioner, node)
+        node.annotations[wellknown.EMPTINESS_TIMESTAMP_ANNOTATION] = "1.0"
+        assert eligibility.emptiness_owns(provisioner, node)
+        # Without the TTL configured the stamp is stale, not a claim.
+        unconfigured = Provisioner(name="q", spec=ProvisionerSpec())
+        assert not eligibility.emptiness_owns(unconfigured, node)
+
+
+class TestConsolidationSolve:
+    """ops/consolidate.py — the batched counterfactual scorer on bare
+    arrays (delete = FFD into remaining headroom, replace = one cheaper
+    node, per-candidate masking)."""
+
+    R = 8  # wellknown.NUM_RESOURCE_DIMS
+
+    def _vec(self, cpu, pods=1.0):
+        v = np.zeros(self.R, np.float32)
+        v[0] = cpu
+        v[2] = pods
+        return v
+
+    def problem(self, **overrides):
+        base = dict(
+            # one candidate: two 4000m pods
+            pod_vectors=np.stack([self._vec(4000.0)])[None, :, :],
+            pod_counts=np.array([[2]], np.int32),
+            headroom=np.stack([self._vec(8000.0, pods=100.0)]),
+            bin_mask=np.ones((1, 1), bool),
+            node_prices=np.array([0.48]),
+            type_capacity=np.stack(
+                [self._vec(8000.0, 100.0), self._vec(16000.0, 100.0)]
+            ),
+            type_prices=np.array([0.24, 0.48], np.float32),
+            type_valid=np.ones((1, 2), bool),
+        )
+        base.update(overrides)
+        return consolidate.ConsolidationProblem(**base)
+
+    def test_delete_feasible_wins_over_replace(self):
+        verdicts = consolidate.solve_candidates(self.problem())
+        assert verdicts.delete_ok[0]
+        assert verdicts.action[0] == consolidate.ACTION_DELETE
+        assert verdicts.savings[0] == pytest.approx(0.48)
+
+    def test_replace_when_headroom_short(self):
+        verdicts = consolidate.solve_candidates(
+            self.problem(headroom=np.stack([self._vec(4000.0, 100.0)]))
+        )
+        assert not verdicts.delete_ok[0]
+        assert verdicts.action[0] == consolidate.ACTION_REPLACE
+        assert verdicts.replace_type[0] == 0  # the 8-cpu type
+        assert verdicts.savings[0] == pytest.approx(0.48 - 0.24)
+
+    def test_no_action_when_nothing_cheaper(self):
+        verdicts = consolidate.solve_candidates(
+            self.problem(
+                headroom=np.stack([self._vec(0.0, 0.0)]),
+                type_prices=np.array([0.48, 0.9], np.float32),
+                type_capacity=np.stack(
+                    [self._vec(16000.0, 100.0), self._vec(32000.0, 100.0)]
+                ),
+            )
+        )
+        assert verdicts.action[0] == consolidate.ACTION_NONE
+        assert verdicts.best() == -1
+
+    def test_per_candidate_bin_mask_excludes_victim(self):
+        # Two candidates, two bins: each candidate's own row is masked out,
+        # so each sees only the OTHER node's headroom.
+        verdicts = consolidate.solve_candidates(
+            self.problem(
+                pod_vectors=np.stack(
+                    [np.stack([self._vec(4000.0)]), np.stack([self._vec(9000.0)])]
+                ),
+                pod_counts=np.array([[1], [1]], np.int32),
+                headroom=np.stack(
+                    [self._vec(9000.0, 100.0), self._vec(4000.0, 100.0)]
+                ),
+                bin_mask=np.array([[False, True], [True, False]]),
+                node_prices=np.array([0.48, 0.48]),
+                type_valid=np.ones((2, 2), bool),
+            )
+        )
+        # Candidate 0 (4-cpu pod) fits bin 1 (4 cpu free); candidate 1
+        # (9-cpu pod) fits bin 0 (9 cpu free).
+        assert verdicts.delete_ok.tolist() == [True, True]
+        assert verdicts.delete_take[0, 0, 1] == 1
+        assert verdicts.delete_take[1, 0, 0] == 1
+
+    def test_type_valid_mask_blocks_accelerated_replacement(self):
+        verdicts = consolidate.solve_candidates(
+            self.problem(type_valid=np.array([[False, True]]))
+        )
+        # The cheaper 8-cpu type is masked (anti-waste): only the equal-price
+        # 16-cpu type remains, so replace is not cost-positive.
+        assert verdicts.action[0] == consolidate.ACTION_DELETE
+        assert not np.isfinite(verdicts.replace_price[0]) or (
+            verdicts.replace_price[0] == pytest.approx(0.48)
+        )
+
+    def test_delete_assignment_decodes_group_cursor_order(self):
+        pods = [object(), object()]
+        verdicts = consolidate.solve_candidates(self.problem())
+        plan = consolidate.delete_assignment(verdicts, 0, [pods])
+        assert [(pod is pods[i]) for i, (pod, _) in enumerate(plan)] == [True, True]
+        assert all(j == 0 for _, j in plan)
+
+
+class TestConsolidation:
+    def test_delete_action_repacks_and_deletes(self):
+        """The acceptance scenario: an underutilized node's pods fit the
+        remaining headroom → delete wins, pods rebind onto the receiver,
+        the victim leaves through the finalizer path, savings accrue, zero
+        leaks."""
+        pods = fixtures.pods(8, cpu="4")
+        h, recorder = consolidation_harness(pods)
+        node_a = h.expect_scheduled(pods[0])
+        node_b = h.expect_scheduled(pods[4])
+        assert node_a.name != node_b.name
+        executed = CONSOLIDATION_ACTIONS_TOTAL.get("delete", "executed")
+        savings = CONSOLIDATION_SAVINGS_TOTAL.get()
+        # Churn both big nodes down to two pods each: either victim's pods
+        # fit the other's headroom, so delete (full node price) beats replace.
+        survivors = pods[2:4] + pods[6:]
+        scale_down(h, pods[:2] + pods[4:6])
+        cost_before = cluster_cost(h)
+
+        converge(h)
+        assert len(h.cluster.list_nodes()) == 1
+        survivor_node = h.cluster.list_nodes()[0]
+        for pod in survivors:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert live.node_name == survivor_node.name
+            assert len(recorder.bound[pod.uid]) <= 2  # at most one rebind
+        assert CONSOLIDATION_ACTIONS_TOTAL.get("delete", "executed") - executed == 1
+        assert CONSOLIDATION_SAVINGS_TOTAL.get() - savings == pytest.approx(
+            cost_before - cluster_cost(h)
+        )
+        assert cluster_cost(h) < cost_before
+        assert_no_leaks(h)
+
+    def test_replace_action_trades_down_to_cheaper_type(self):
+        """Delete infeasible (the other node is packed full) but a strictly
+        cheaper type holds the demand → replace: pods displaced to the
+        provisioner, replacement launches on the cheaper type, victim drains
+        and leaves."""
+        pods = fixtures.pods(6, cpu="4")
+        h, recorder = consolidation_harness(pods)
+        node_a = h.expect_scheduled(pods[0])  # big, 4 pods
+        node_b = h.expect_scheduled(pods[4])  # mid, 2 pods, full
+        assert node_b.instance_type == "mid-consolidation-type"
+        executed = CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed")
+        scale_down(h, pods[:2])  # big node drops to 2 pods, no headroom anywhere
+        cost_before = cluster_cost(h)
+
+        converge(h)
+        assert h.cluster.try_get_node(node_a.name) is None
+        for pod in pods[2:4]:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert live.node_name is not None
+            replacement = h.cluster.get_node(live.node_name)
+            assert replacement.instance_type == "mid-consolidation-type"
+        assert (
+            CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed") - executed == 1
+        )
+        assert cluster_cost(h) < cost_before
+        assert_no_leaks(h)
+
+    def test_one_action_per_sweep_budget(self):
+        """--consolidation-max-disruption (default 1): with two equally
+        deletable victims, one sweep claims exactly one."""
+        pods = fixtures.pods(8, cpu="4")
+        h, _ = consolidation_harness(pods)
+        scale_down(h, pods[:2] + pods[4:6])
+        h.consolidation.reconcile()
+        claimed = {
+            n.name
+            for n in h.cluster.list_nodes()
+            if ANNOTATION in n.annotations or n.deletion_timestamp is not None
+        }
+        assert len(claimed) == 1
+
+    def test_budget_flag_raises_parallel_disruption(self):
+        pods = fixtures.pods(8, cpu="4")
+        h, _ = consolidation_harness(pods)
+        h.consolidation = ConsolidationController(
+            h.cluster, h.cloud, h.provisioning, h.termination, max_disruption=2
+        )
+        scale_down(h, pods[:3] + pods[4:7])  # two nodes at 1 pod each
+        h.consolidation.reconcile()
+        claimed = {
+            n.name
+            for n in h.cluster.list_nodes()
+            if ANNOTATION in n.annotations or n.deletion_timestamp is not None
+        }
+        assert len(claimed) == 2
+
+    def test_in_flight_interruption_suppresses_consolidation(self):
+        """Satellite regression: an interruption drain in progress must
+        suppress consolidation entirely, and a cooldown must hold after the
+        activity clears."""
+        pods = fixtures.pods(8, cpu="4")
+        h, _ = consolidation_harness(pods)
+        # Two half-empty big nodes: cost-positive actions exist throughout.
+        scale_down(h, pods[:2] + pods[4:6])
+        victim = h.cluster.list_nodes()[0]
+        h.cloud.inject_interruption(victim, deadline_in=120.0)
+        h.interruption.reconcile()  # stamps the interruption annotation
+
+        h.consolidation.reconcile()
+        assert not any(
+            ANNOTATION in n.annotations for n in h.cluster.list_nodes()
+        ), "consolidation acted while an interruption drain was in flight"
+
+        # Let the reclamation finish, then stay inside the cooldown window.
+        for _ in range(4):
+            h.interruption.reconcile()
+            for worker in h.provisioning.workers.values():
+                worker.provision()
+            ready_all(h)
+            h.reconcile_terminations(rounds=3)
+        h.clock.advance(10.0)
+        h.consolidation.reconcile()
+        assert not any(
+            ANNOTATION in n.annotations for n in h.cluster.list_nodes()
+        ), "consolidation acted inside the reclamation cooldown"
+
+        # Past the cooldown the sweep acts again.
+        h.clock.advance(
+            ConsolidationController(
+                h.cluster, h.cloud, h.provisioning, h.termination
+            ).cooldown_seconds
+            + 1.0
+        )
+        h.consolidation.reconcile()
+        assert any(
+            ANNOTATION in n.annotations or n.deletion_timestamp is not None
+            for n in h.cluster.list_nodes()
+        ), "consolidation never resumed after the cooldown"
+
+    def test_emptiness_claimed_node_not_nominated(self):
+        """The shared-eligibility satellite end to end: a node stamped by
+        the emptiness TTL is never concurrently nominated, even when a
+        workload pod lands between the stamp and the next emptiness pass."""
+        h = Harness(instance_types=consolidation_instance_types())
+        h.apply_provisioner(
+            Provisioner(
+                name="default",
+                spec=ProvisionerSpec(ttl_seconds_after_empty=300),
+            )
+        )
+        pods = fixtures.pods(2, cpu="4")
+        h.provision(*pods)
+        ready_all(h)
+        node = h.expect_scheduled(pods[0])
+        scale_down(h, pods)
+        h.node.reconcile(node.name)  # stamps the emptiness timestamp
+        assert wellknown.EMPTINESS_TIMESTAMP_ANNOTATION in node.annotations
+        # A pod lands before the TTL fires; the stamp is still present.
+        late = fixtures.pod(cpu="1")
+        h.cluster.apply_pod(late)
+        h.cluster.bind_pod(late, node)
+        h.consolidation.reconcile()
+        assert ANNOTATION not in h.cluster.get_node(node.name).annotations
+
+    def test_non_consolidatable_offering_never_nominated(self):
+        """The cloudprovider hint: reserved capacity (consolidatable=False
+        offerings) is invisible to the sweep no matter how idle."""
+        h = Harness(instance_types=consolidation_instance_types())
+        spec = ProvisionerSpec()
+        spec.constraints.requirements = Requirements(
+            [
+                Requirement.in_(
+                    wellknown.INSTANCE_TYPE_LABEL,
+                    ["reserved-consolidation-type"],
+                )
+            ]
+        )
+        h.apply_provisioner(Provisioner(name="default", spec=spec))
+        pods = fixtures.pods(2, cpu="4")
+        h.provision(*pods)
+        ready_all(h)
+        node = h.expect_scheduled(pods[0])
+        assert node.instance_type == "reserved-consolidation-type"
+        h.consolidation.reconcile()
+        assert ANNOTATION not in h.cluster.get_node(node.name).annotations
+        assert node.deletion_timestamp is None
+
+    def test_do_not_evict_cancels_in_flight_action(self):
+        """A protection appearing mid-drain cancels the action (voluntary
+        disruption never overrides it): the claim is dropped, the cordon
+        undone, the cancellation counted — exercised through the restart
+        resume path, where the race is durable."""
+        pods = fixtures.pods(6, cpu="4")
+        h, _ = consolidation_harness(pods)
+        victim = h.expect_scheduled(pods[4])  # the 2-pod node
+        cancelled = CONSOLIDATION_ACTIONS_TOTAL.get("replace", "cancelled")
+        victim.annotations[ANNOTATION] = "replace"
+        h.cluster.update_node(victim)
+        protected = fixtures.pod(
+            cpu="1",
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"},
+        )
+        h.cluster.apply_pod(protected)
+        h.cluster.bind_pod(protected, victim)
+        h.consolidation.reconcile()  # resume path finds the claim, cancels
+        live = h.cluster.get_node(victim.name)
+        assert ANNOTATION not in live.annotations
+        assert not live.unschedulable
+        assert (
+            CONSOLIDATION_ACTIONS_TOTAL.get("replace", "cancelled") - cancelled
+            == 1
+        )
+        if h.backend == "apiserver":
+            # The claim must be gone SERVER-side too (merge-patch null): a
+            # key the patch merely omitted would resurrect through the watch
+            # pump and consume the disruption budget forever.
+            raw = h.cluster.api.get(f"/api/v1/nodes/{victim.name}")
+            assert ANNOTATION not in (
+                raw.get("metadata", {}).get("annotations") or {}
+            )
+        # The cancelled claim no longer consumes the budget: the next sweep
+        # is free to claim a genuine candidate.
+        scale_down(h, pods[:2])
+        h.consolidation.reconcile()
+        assert any(
+            ANNOTATION in n.annotations or n.deletion_timestamp is not None
+            for n in h.cluster.list_nodes()
+        ), "a cancelled claim still consumed the disruption budget"
+
+    def test_tainted_receiver_never_absorbs_intolerant_pods(self):
+        """Receiver taints gate both the counterfactual bins and the rebind:
+        intolerant pods never land on tainted capacity — the action degrades
+        to a provisioner re-solve instead."""
+        from karpenter_tpu.api.taints import Taint
+
+        pods = fixtures.pods(8, cpu="4")
+        h, _ = consolidation_harness(pods)
+        scale_down(h, pods[:2] + pods[4:6])
+        for node in h.cluster.list_nodes():
+            node.taints.append(
+                Taint(key="team", value="gpu", effect="NoSchedule")
+            )
+            h.cluster.update_node(node)
+        converge(h)
+        for pod in pods[2:4] + pods[6:]:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert live.node_name is not None
+            landed = h.cluster.get_node(live.node_name)
+            assert not any(t.key == "team" for t in landed.taints), (
+                f"{pod.name} bound onto tainted {landed.name}"
+            )
+
+    def test_pdb_gated_drain_rolls_without_violations(self):
+        """Voluntary disruption spends at most the PDB budget per sweep and
+        NEVER overrides it: the drain rolls one replica per rebind."""
+        pods = [fixtures.pod(cpu="4", labels={"app": "web"}) for _ in range(4)]
+        h, recorder = consolidation_harness(pods)
+        h.cluster.apply_pdb("web-pdb", {"app": "web"}, min_available=1)
+        oracle = PdbOracle(h)
+        scale_down(h, pods[:2])
+        node = h.expect_scheduled(pods[2])
+
+        h.consolidation.reconcile()
+        pending = [
+            p
+            for p in pods[2:]
+            if h.cluster.get_pod(p.namespace, p.name).node_name is None
+        ]
+        # With minAvailable=1 over two replicas at most one may be down at
+        # once; a direct rebind (delete plan) keeps even that window closed.
+        assert len(pending) <= 1
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        for pod in pods[2:]:
+            assert h.cluster.get_pod(pod.namespace, pod.name).node_name
+        assert oracle.violations == [], oracle.violations
+        assert_no_leaks(h)
+
+    def test_cordoned_node_not_nominated(self):
+        pods = fixtures.pods(6, cpu="4")
+        h, _ = consolidation_harness(pods)
+        scale_down(h, pods[:2])
+        for node in h.cluster.list_nodes():
+            node.unschedulable = True
+            h.cluster.update_node(node)
+        h.consolidation.reconcile()
+        assert not any(
+            ANNOTATION in n.annotations for n in h.cluster.list_nodes()
+        )
+
+    def test_max_disruption_zero_disables(self):
+        pods = fixtures.pods(6, cpu="4")
+        h, _ = consolidation_harness(pods)
+        h.consolidation = ConsolidationController(
+            h.cluster, h.cloud, h.provisioning, h.termination, max_disruption=0
+        )
+        scale_down(h, pods[:2])
+        h.consolidation.reconcile()
+        assert not any(
+            ANNOTATION in n.annotations or n.deletion_timestamp is not None
+            for n in h.cluster.list_nodes()
+        )
+
+    def test_metrics_registered_with_vet_checker(self):
+        """Satellite: the new metric names are visible to the vet
+        metrics-consistency checker — declared exactly once tree-wide, with
+        the label arity every call site is checked against."""
+        from tools.vet.checkers import metricsuse
+        from tools.vet.framework import production_modules
+
+        by_name, by_var = metricsuse._collect_declarations(production_modules())
+        for name in (
+            "consolidation_actions_total",
+            "consolidation_savings_dollars_total",
+            "consolidation_candidate_count",
+        ):
+            assert len(set(by_name[name])) == 1, f"{name} declared twice"
+        assert by_var["CONSOLIDATION_ACTIONS_TOTAL"] == [("counter", 2)]
+        assert by_var["CONSOLIDATION_SAVINGS_TOTAL"] == [("counter", 0)]
+        assert by_var["CONSOLIDATION_CANDIDATES"] == [("gauge", 0)]
+
+    def test_consolidation_flags_parse(self):
+        from karpenter_tpu.utils.options import OptionsError, parse
+
+        options = parse(
+            [
+                "--cluster-name", "t",
+                "--consolidation-max-disruption", "3",
+                "--consolidation-cooldown", "120",
+            ]
+        )
+        assert options.consolidation_max_disruption == 3
+        assert options.consolidation_cooldown == 120.0
+        with pytest.raises(OptionsError):
+            parse(["--cluster-name", "t", "--consolidation-max-disruption", "-1"])
+
+
+# Every consolidation site, plus mid-drain at its second passage (first pod
+# displaced, controller dies before the rest).
+CONSOLIDATION_MATRIX = [
+    (site, 1) for site in crashpoints.CONSOLIDATION_SITES
+] + [("consolidation.mid-drain", 2)]
+
+
+class TestConsolidationCrashMatrix:
+    """The crash half of the acceptance criteria: the controller killed at
+    every consolidation commit point, restarted over the surviving state,
+    and the sweep still converges — every pod bound exactly once to a live
+    node, victim gone, zero leaked instances, cost strictly lower."""
+
+    @pytest.mark.parametrize(
+        "site,at", CONSOLIDATION_MATRIX,
+        ids=[f"{s}@{a}" for s, a in CONSOLIDATION_MATRIX],
+    )
+    def test_kill_restart_converges(self, site, at):
+        pods = fixtures.pods(8, cpu="4")
+        h, recorder = consolidation_harness(pods)
+        scale_down(h, pods[:2] + pods[4:6])
+        cost_before = cluster_cost(h)
+        live_pods = pods[2:4] + pods[6:]
+        crashpoints.arm(site, at=at)
+        with pytest.raises(SimulatedCrash) as crash:
+            h.consolidation.reconcile()
+        assert crash.value.site == site
+        restart(h)
+        converge(h)
+        for pod in live_pods:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert live.node_name is not None, f"{pod.name} lost in the crash"
+            node = h.cluster.try_get_node(live.node_name)
+            assert node is not None and node.deletion_timestamp is None
+            # Bound exactly once per node it ever landed on: the recorder
+            # collapses consecutive duplicates, so any double-bind would
+            # show as a history longer than [origin] or [origin, moved].
+            assert len(recorder.bound[pod.uid]) <= 2, recorder.bound[pod.uid]
+        assert not any(
+            ANNOTATION in n.annotations for n in h.cluster.list_nodes()
+        ), "a consolidation claim survived convergence"
+        assert cluster_cost(h) < cost_before
+        assert_no_leaks(h)
+
+
+class TestConsolidationChurnConvergence:
+    def test_churn_storm_converges_cheaper(self):
+        """The bench scenario in miniature: scale up, churn down, sweep to a
+        fixpoint — steady-state cost strictly better, no further
+        cost-positive actions found, zero PDB violations, zero leaks."""
+        pods = fixtures.pods(16, cpu="4")
+        for pod in pods[:3]:
+            pod.labels["app"] = "guarded"
+        h, recorder = consolidation_harness(pods)
+        h.cluster.apply_pdb("guarded", {"app": "guarded"}, min_available=2)
+        oracle = PdbOracle(h)
+        survivors = pods[:3] + pods[10:]
+        scale_down(h, [p for p in pods if p not in survivors])
+        cost_before = cluster_cost(h)
+
+        for _ in range(12):
+            converge(h, rounds=1)
+            h.clock.advance(1.0)
+        cost_after = cluster_cost(h)
+        assert cost_after < cost_before
+        # Converged: one more sweep finds nothing cost-positive.
+        executed_before = (
+            CONSOLIDATION_ACTIONS_TOTAL.get("delete", "executed")
+            + CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed")
+        )
+        converge(h, rounds=2)
+        executed_after = (
+            CONSOLIDATION_ACTIONS_TOTAL.get("delete", "executed")
+            + CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed")
+        )
+        assert executed_after == executed_before, "sweep did not converge"
+        for pod in survivors:
+            assert h.cluster.get_pod(pod.namespace, pod.name).node_name
+        assert oracle.violations == [], oracle.violations
+        assert_no_leaks(h)

@@ -298,4 +298,4 @@ class TestCrashpointFacility:
             )
         assert found == set(crashpoints.SITES) | set(
             crashpoints.INTERRUPTION_SITES
-        )
+        ) | set(crashpoints.CONSOLIDATION_SITES)

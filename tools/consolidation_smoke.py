@@ -1,0 +1,293 @@
+"""Churn-storm chaos harness for the consolidation subsystem.
+
+The deterministic matrix lives in tests/test_consolidation.py; this tool is
+the storm: scale up a fleet on the fake provider, churn most of the
+workload away (the steady-state drift that motivates consolidation), then
+sweep to convergence with the controller "killed" at rotating consolidation
+crashpoints and rebuilt over the surviving state mid-storm. At the end:
+
+- consolidation has CONVERGED: one more sweep finds no cost-positive action;
+- steady-state cluster $/hr is STRICTLY better than the no-consolidation
+  baseline (the pre-sweep cost — without consolidation nothing ever shrinks);
+- ZERO PDB violations (watch-driven oracle on every pod mutation);
+- every surviving pod is bound to a live node;
+- ZERO leaked instances after the instancegc grace.
+
+`make consolidation-smoke` wraps this in a hard 120s timeout. Runs entirely
+on the fake provider + fake clock — no wall-clock sleeps.
+"""
+
+import sys
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+NODES = 6
+PODS_PER_NODE = 4
+GUARDED = 3  # pods behind a PDB that forces the drain to roll
+
+
+def build():
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.cloudprovider.fake import (
+        FakeCloudProvider,
+        consolidation_instance_types,
+    )
+    from karpenter_tpu.controllers.cluster import Cluster
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    cluster = Cluster(clock=clock)
+    cloud = FakeCloudProvider(
+        instance_types=consolidation_instance_types(), clock=clock
+    )
+    state = {"clock": clock, "cluster": cluster, "cloud": cloud}
+    restart(state)
+    cluster.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    state["provisioning"].reconcile("default")
+    return state
+
+
+def restart(state) -> None:
+    """Fresh controllers over the surviving cluster + cloud — what a
+    supervisor restart observes."""
+    from karpenter_tpu.controllers.consolidation import ConsolidationController
+    from karpenter_tpu.controllers.instancegc import InstanceGcController
+    from karpenter_tpu.controllers.interruption import InterruptionController
+    from karpenter_tpu.controllers.node import NodeController
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.selection import SelectionController
+    from karpenter_tpu.controllers.termination import TerminationController
+
+    cluster, cloud = state["cluster"], state["cloud"]
+    state["provisioning"] = ProvisioningController(cluster, cloud, None)
+    state["selection"] = SelectionController(cluster, state["provisioning"])
+    state["termination"] = TerminationController(cluster, cloud)
+    state["node"] = NodeController(cluster)
+    state["instancegc"] = InstanceGcController(cluster, cloud)
+    state["interruption"] = InterruptionController(
+        cluster, cloud, state["provisioning"], state["termination"]
+    )
+    state["consolidation"] = ConsolidationController(
+        cluster, cloud, state["provisioning"], state["termination"]
+    )
+    for provisioner in cluster.list_provisioners():
+        state["provisioning"].reconcile(provisioner.name)
+    for pod in cluster.list_pods():
+        if pod.is_provisionable():
+            state["selection"].reconcile(pod.namespace, pod.name)
+
+
+def step(state) -> None:
+    """One control-plane beat: consolidation sweep, provision, node
+    readiness (a joining kubelet), terminations."""
+    state["consolidation"].reconcile()
+    for worker in list(state["provisioning"].workers.values()):
+        worker.provision()
+    for node in list(state["cluster"].list_nodes()):
+        if not node.ready:
+            node.ready = True
+            node.status_reported_at = state["clock"].now()
+            state["cluster"].update_node(node)
+        state["node"].reconcile(node.name)  # strips the not-ready taint
+        state["termination"].reconcile(node.name)
+    state["termination"].evictions.drain_once()
+
+
+def cluster_cost(state) -> float:
+    catalog = {it.name: it for it in state["cloud"].get_instance_types()}
+    total = 0.0
+    for node in state["cluster"].list_nodes():
+        instance_type = catalog.get(node.instance_type)
+        if instance_type is None:
+            continue
+        for offering in instance_type.offerings:
+            if (
+                offering.zone == node.zone
+                and offering.capacity_type == node.capacity_type
+            ):
+                total += offering.price
+                break
+    return total
+
+
+class PdbOracle:
+    """Every pod mutation must leave every PDB at or above minAvailable —
+    the zero-violations acceptance invariant, checked continuously."""
+
+    def __init__(self, state):
+        self.state = state
+        self.violations = []
+        state["cluster"].watch(self._on)
+
+    def _on(self, kind, _obj) -> None:
+        if kind != "pod":
+            return
+        cluster = self.state["cluster"]
+        for name, (match_labels, min_available) in list(cluster._pdbs.items()):
+            healthy = sum(
+                1
+                for p in cluster.list_pods()
+                if p.deletion_timestamp is None
+                and p.node_name is not None
+                and all(p.labels.get(k) == v for k, v in match_labels.items())
+            )
+            if healthy < min_available:
+                self.violations.append((name, healthy, min_available))
+
+
+def load(state):
+    """Scale-up phase: fill the fleet, then churn it down — delete most of
+    the workload so the surviving pods rattle around overgrown capacity."""
+    from tests import fixtures
+
+    pods = fixtures.pods(NODES * PODS_PER_NODE, cpu="4")
+    for pod in pods[:GUARDED]:
+        pod.labels["app"] = "guarded"
+    state["cluster"].apply_pdb(
+        "guarded", {"app": "guarded"}, min_available=GUARDED - 1
+    )
+    for pod in pods:
+        state["cluster"].apply_pod(pod)
+        state["selection"].reconcile(pod.namespace, pod.name)
+    for worker in state["provisioning"].workers.values():
+        worker.provision()
+    for node in state["cluster"].list_nodes():
+        node.ready = True
+        node.status_reported_at = state["clock"].now()
+        state["cluster"].update_node(node)
+        state["node"].reconcile(node.name)
+    for pod in pods:
+        live = state["cluster"].get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} never scheduled"
+    # Churn: keep the guarded pods plus one plain pod per node; the rest go.
+    survivors = set()
+    by_node = {}
+    for pod in pods:
+        live = state["cluster"].get_pod(pod.namespace, pod.name)
+        if pod.labels.get("app") == "guarded":
+            survivors.add(pod.name)
+            continue
+        if by_node.get(live.node_name) is None:
+            by_node[live.node_name] = pod.name
+            survivors.add(pod.name)
+    for pod in pods:
+        if pod.name not in survivors:
+            state["cluster"].delete_pod(pod.namespace, pod.name)
+    return [p for p in pods if p.name in survivors]
+
+
+def storm(state):
+    """Sweep to convergence, killing the controller at a rotating
+    consolidation crashpoint every other beat and restarting it over the
+    wreckage. Returns (crash count, executed action count)."""
+    from karpenter_tpu.controllers.consolidation import (
+        CONSOLIDATION_ACTIONS_TOTAL,
+    )
+    from karpenter_tpu.utils import crashpoints
+    from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+    def executed() -> float:
+        return CONSOLIDATION_ACTIONS_TOTAL.get(
+            "delete", "executed"
+        ) + CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed")
+
+    crashes = 0
+    before = executed()
+    for beat in range(4 * NODES):
+        if beat % 2 == 1:
+            site = crashpoints.CONSOLIDATION_SITES[
+                (beat // 2) % len(crashpoints.CONSOLIDATION_SITES)
+            ]
+            crashpoints.arm(site)
+            try:
+                step(state)
+            except SimulatedCrash as crash:
+                crashes += 1
+                print(f"  killed at {crash.site}; restarting")
+                restart(state)
+            crashpoints.disarm_all()
+        step(state)
+        state["clock"].advance(1.0)
+    return crashes, executed() - before
+
+
+def settle_and_verify(state, survivors, cost_before, actions) -> None:
+    from karpenter_tpu.controllers.consolidation import (
+        CONSOLIDATION_ACTIONS_TOTAL,
+    )
+    from karpenter_tpu.controllers.instancegc import LAUNCH_GRACE_SECONDS
+
+    for _ in range(4):
+        step(state)
+    cost_after = cluster_cost(state)
+    assert actions > 0, "the storm executed no consolidation action"
+    assert cost_after < cost_before, (
+        f"steady-state cost did not improve: {cost_after} vs {cost_before}"
+    )
+    # Converged: further sweeps find nothing cost-positive.
+    before = CONSOLIDATION_ACTIONS_TOTAL.get(
+        "delete", "executed"
+    ) + CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed")
+    for _ in range(3):
+        step(state)
+        state["clock"].advance(1.0)
+    after = CONSOLIDATION_ACTIONS_TOTAL.get(
+        "delete", "executed"
+    ) + CONSOLIDATION_ACTIONS_TOTAL.get("replace", "executed")
+    assert after == before, "consolidation did not converge"
+    cluster = state["cluster"]
+    for pod in survivors:
+        live = cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} lost in the storm"
+        node = cluster.try_get_node(live.node_name)
+        assert node is not None and node.deletion_timestamp is None, (
+            f"{pod.name} bound to a dead node"
+        )
+    state["clock"].advance(LAUNCH_GRACE_SECONDS + 1)
+    state["instancegc"].reconcile()
+    state["instancegc"].reconcile()
+    leaked = set(state["cloud"].instances) - {
+        n.provider_id for n in cluster.list_nodes()
+    }
+    assert not leaked, f"leaked instances after GC grace: {sorted(leaked)}"
+    return cost_after
+
+
+def main() -> int:
+    began = time.time()
+    try:
+        state = build()
+        survivors = load(state)
+        # The oracle arms AFTER the load phase: the invariant guards pods
+        # that were up from being disrupted below budget, not the scale-up
+        # window where replicas haven't bound yet.
+        oracle = PdbOracle(state)
+        cost_before = cluster_cost(state)
+        nodes_before = len(state["cluster"].list_nodes())
+        print(
+            f"consolidation-smoke: {len(survivors)} pods left on "
+            f"{nodes_before} nodes (${cost_before:.2f}/hr); sweeping"
+        )
+        crashes, actions = storm(state)
+        cost_after = settle_and_verify(state, survivors, cost_before, actions)
+        assert oracle.violations == [], (
+            f"PDB violations during the storm: {oracle.violations}"
+        )
+    except AssertionError as failure:
+        print(
+            f"consolidation-smoke: FAIL in {time.time() - began:.1f}s: {failure}"
+        )
+        return 1
+    print(
+        f"consolidation-smoke: OK in {time.time() - began:.1f}s "
+        f"(cost ${cost_before:.2f} -> ${cost_after:.2f}/hr over "
+        f"{int(actions)} actions, {crashes} mid-storm crash+restarts, "
+        "0 PDB violations, 0 leaked instances)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
