@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -67,6 +67,14 @@ interruption-smoke:
 consolidation-smoke:
 	timeout -k 10 120 python tools/consolidation_smoke.py
 
+# The device-fetch budget guard (tools/fetch_smoke.py): shape math asserting
+# the compacted plan payload at 50k pods / 400 types stays <= 4 KB, plus a
+# real CPU-backend dispatch proving the compact payload matches the math and
+# decodes bit-identically to the dense spill. Keeps the erased fetch floor
+# from silently regressing.
+fetch-smoke:
+	timeout -k 10 120 python tools/fetch_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -75,6 +83,7 @@ smoke:
 	$(MAKE) degraded-smoke || rc=1; \
 	$(MAKE) interruption-smoke || rc=1; \
 	$(MAKE) consolidation-smoke || rc=1; \
+	$(MAKE) fetch-smoke || rc=1; \
 	exit $$rc
 
 proto:

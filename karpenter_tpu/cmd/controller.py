@@ -40,6 +40,9 @@ def build_cluster(options) -> Cluster:
 
 def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     tune_gc()  # long-running service: GOGC-style collector headroom
+    from karpenter_tpu.ops.pack_kernel import suppress_donation_advisory
+
+    suppress_donation_advisory()  # CPU-fallback rigs warn per compile
     options = options_pkg.parse(argv)
     log = klog.setup(options.log_level)
     log.info(

@@ -256,7 +256,10 @@ class ProvisionerWorker:
         # All schedules solve as ONE batch: device-backed solvers share a
         # single device->host round trip across them, and the sidecar's
         # streaming RPC does the same across the wire (the reference loops
-        # Pack per schedule — provisioner.go:102-135).
+        # Pack per schedule — provisioner.go:102-135). On the pipelined path
+        # the batch additionally OVERLAPS with bind: schedule N's nodes
+        # launch and bind while schedules N+1.. are still solving on the
+        # device (solve_many_pipelined).
         problems = [
             (
                 schedule.pods,
@@ -266,13 +269,7 @@ class ProvisionerWorker:
             )
             for schedule in schedules
         ]
-        with SOLVE_DURATION.measure(), TRACER.span(
-            "provision.solve",
-            schedules=len(problems),
-            pods=sum(len(p[0]) for p in problems),
-        ):
-            results = self.solver.solve_many(problems)
-        for schedule, result in zip(schedules, results):
+        for schedule, result in self._solve_results(schedules, problems):
             if stats.launch_errors:
                 # An earlier schedule's launch failed (e.g. ICE): its pools
                 # are now in the unavailable-offerings blackout, but this
@@ -298,6 +295,53 @@ class ProvisionerWorker:
                 live.status.last_scale_time = self.cluster.clock.now()
                 self.cluster.update_provisioner_status(live)
         return stats
+
+    def _solve_results(self, schedules, problems):
+        """Yield (schedule, result) pairs for the pass.
+
+        Default: the double-buffered solve->bind pipeline — the solver
+        dispatches every schedule's kernel (and queues its device->host
+        copy) up front, then results stream back in order, so each
+        schedule's bind/launch runs while the NEXT schedules are still
+        solving. When any crash test is armed the pass drops to the serial
+        solve-everything-then-bind flow: a mid-bind kill must leave the
+        deterministic minimal surviving state, which the battletest matrix
+        asserts, and interleaving binds with in-flight solves would leave
+        whatever the pipeline happened to finish (same rule as the serial
+        bind path in _register_and_bind)."""
+        if any_armed():
+            with SOLVE_DURATION.measure(), TRACER.span(
+                "provision.solve",
+                schedules=len(problems),
+                pods=sum(len(p[0]) for p in problems),
+            ):
+                results = self.solver.solve_many(problems)
+            yield from zip(schedules, results)
+            return
+        # Encode + dispatch is measured as its own sample: for device
+        # solvers this covers the spec->tensor encode and the async kernel
+        # dispatches of the WHOLE batch (plus any host-gated schedules'
+        # synchronous solves); per-schedule pulls below then record each
+        # schedule's residual solve wait — time the solver still needed
+        # AFTER the previous schedule's bind, i.e. the unoverlapped
+        # remainder the pipeline leaves on the critical path. Host solvers
+        # solve lazily per pull (base solve_encoded_pipelined), so their
+        # solve time lands in the per-schedule samples.
+        with SOLVE_DURATION.measure(), TRACER.span(
+            "provision.solve.dispatch",
+            schedules=len(problems),
+            pods=sum(len(p[0]) for p in problems),
+        ):
+            iterator = self.solver.solve_many_pipelined(problems)
+        for index, schedule in enumerate(schedules):
+            with SOLVE_DURATION.measure(), TRACER.span(
+                "provision.solve",
+                schedules=len(problems),
+                schedule=index,
+                pods=len(schedule.pods),
+            ):
+                result = next(iterator)
+            yield schedule, result
 
     def _daemon_schedules_here(self, template: PodSpec) -> bool:
         try:

@@ -14,7 +14,7 @@ import abc
 import functools
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,12 @@ from karpenter_tpu.cloudprovider import InstanceType
 from karpenter_tpu.ops import ffd
 from karpenter_tpu.ops import mix_pack
 from karpenter_tpu.ops.encode import InstanceFleet, PodGroups, build_fleet, group_pods
-from karpenter_tpu.ops.pack_kernel import bucket_size, pack_kernel, pad_to
+from karpenter_tpu.ops.pack_kernel import (  # noqa: F401 — fetch_bytes re-exported
+    bucket_size,
+    fetch_bytes,
+    pack_kernel,
+    pad_to,
+)
 from karpenter_tpu.ops import pallas_kernels
 from karpenter_tpu.ops.pallas_kernels import dominance_prices
 from karpenter_tpu.ops.score_kernel import (
@@ -82,17 +87,15 @@ class Solver(abc.ABC):
         )
         return self.solve_encoded(groups, fleet)
 
-    def solve_many(
-        self,
+    @staticmethod
+    def _encode_problems(
         problems: Sequence[
             Tuple[Sequence[PodSpec], Sequence[InstanceType], Constraints, Sequence[PodSpec]]
         ],
-    ) -> List[ffd.PackResult]:
-        """Solve a batch of independent schedule problems. Device-backed
-        solvers override solve_encoded_many to share one device->host round
-        trip across the whole batch (a pod batch regularly splits into many
-        schedules — ref: provisioner.go solves them in a loop, paying the
-        kernel per schedule)."""
+    ) -> List[Tuple[PodGroups, InstanceFleet]]:
+        """THE spec->tensor encoding of a problem batch, shared by the
+        barrier (solve_many) and pipelined (solve_many_pipelined) paths so
+        they can never drift."""
         encoded = []
         for pods, instance_types, constraints, daemons in problems:
             groups = group_pods(list(pods))
@@ -105,12 +108,52 @@ class Solver(abc.ABC):
                     ),
                 )
             )
-        return self.solve_encoded_many(encoded)
+        return encoded
+
+    def solve_many(
+        self,
+        problems: Sequence[
+            Tuple[Sequence[PodSpec], Sequence[InstanceType], Constraints, Sequence[PodSpec]]
+        ],
+    ) -> List[ffd.PackResult]:
+        """Solve a batch of independent schedule problems. Device-backed
+        solvers override solve_encoded_many to share one device->host round
+        trip across the whole batch (a pod batch regularly splits into many
+        schedules — ref: provisioner.go solves them in a loop, paying the
+        kernel per schedule)."""
+        return self.solve_encoded_many(self._encode_problems(problems))
 
     def solve_encoded_many(
         self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
     ) -> List[ffd.PackResult]:
         return [self.solve_encoded(groups, fleet) for groups, fleet in items]
+
+    def solve_many_pipelined(
+        self,
+        problems: Sequence[
+            Tuple[Sequence[PodSpec], Sequence[InstanceType], Constraints, Sequence[PodSpec]]
+        ],
+    ) -> Iterator[ffd.PackResult]:
+        """solve_many as a generator: results come back one schedule at a
+        time, in order, so the caller can bind schedule N while later
+        schedules are still solving. Device-backed solvers override
+        solve_encoded_pipelined to genuinely overlap the remaining kernels
+        and device->host copies with the caller's bind work; the base
+        implementation solves the whole batch up front and just yields."""
+        return self.solve_encoded_pipelined(self._encode_problems(problems))
+
+    def solve_encoded_pipelined(
+        self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
+    ) -> Iterator[ffd.PackResult]:
+        """Base implementation: solve each schedule ON DEMAND at its pull.
+        Host solvers have no device work to overlap, but lazy per-pull
+        solving keeps the caller's per-schedule timing honest (each
+        SOLVE_DURATION sample in provisioning measures a real solve, not a
+        pre-solved batch) and matches the pipelined contract: work for
+        schedule N+1 happens after schedule N was handed over. Batching
+        solvers (CostSolver, RemoteSolver) override this with genuinely
+        overlapped implementations."""
+        return (self.solve_encoded(groups, fleet) for groups, fleet in items)
 
     @abc.abstractmethod
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
@@ -160,16 +203,22 @@ class NativeSolver(Solver):
 
 
 def _cost_fused_body(
-    vectors, counts, capacity, total, valid, prices, *, lp_steps: int, constrain=None
+    vectors, counts, capacity, total, valid, prices, *, lp_steps: int,
+    constrain=None, replicate=None,
 ):
     """All three CostSolver candidates as ONE XLA computation: greedy-FFD
     rounds, cost-greedy rounds, and the LP relaxation. Fusing them means a
     single dispatch and a single device->host round trip per solve — on a
     tunneled accelerator the round trips cost more than the math. The
-    outputs are packed into TWO flat arrays (one int32, one float32): each
-    fetched leaf adds per-transfer overhead on the tunnel, so 15 leaves
-    cost ~20ms over the fetch floor while 2 cost ~3ms (see unpack_fused
-    for the layout).
+    outputs come back in FOUR leaves with very different fetch policies
+    (see FusedHandle): a compacted int32 payload plus the scalar LP
+    objective are fetched eagerly (a few KB — ops/pack_kernel.compact_plan);
+    the dense round state is a spill fetched only when compaction overflows
+    its COO entry budget; and the [G, T] LP assignment (the bulk of the old
+    38KB payload) stays DEVICE-RESIDENT until the scoring pass actually
+    decides to realize the LP plan. Few leaves still matters: each fetched
+    leaf adds per-transfer overhead on the tunnel, so the eager payload is
+    two leaves, not fifteen.
 
     Price model: a node packed for type t launches as the cheapest pool of
     ANY type whose capacity dominates t's (the plan offers the price-ranked
@@ -181,7 +230,12 @@ def _cost_fused_body(
     `constrain` shards the LP's [G, T] tensors over a device mesh on the
     multi-chip path (see _sharded_fused_kernel); the sequential pack rounds
     stay replicated — they are lax.while_loop state machines with no
-    parallelizable [G, T] bulk."""
+    parallelizable [G, T] bulk. `replicate`, also supplied only by the
+    sharded kernel, pins the compaction's inputs to a replicated layout:
+    the prefix-sum + scatter compaction is a sequential post-pass, and
+    letting GSPMD partition it produces corrupted COO entries (observed:
+    shard-strided indices and a shard-multiplied nnz on an 8-way CPU
+    mesh)."""
     valid_prices = jnp.where(valid, prices, jnp.inf)
     # [T, T'] dominance + masked min as a VMEM-resident pallas kernel on TPU
     # (ops/pallas_kernels.py), XLA formulation elsewhere.
@@ -211,23 +265,27 @@ def _cost_fused_body(
             r.overflow.astype(jnp.int32).reshape(1),
         ]
 
-    ints = jnp.concatenate(
+    from karpenter_tpu.ops.pack_kernel import compact_plan
+
+    dense_ints = jnp.concatenate(
         rounds_ints(rounds_ffd)
         + rounds_ints(rounds_cost)
         + [feasible_any.astype(jnp.int32).ravel()]
     )
-    floats = jnp.concatenate(
-        [lp.assignment.ravel(), lp.objective.reshape(1).astype(jnp.float32)]
-    )
-    return ints, floats
+    compact_ffd, compact_cost, compact_feasible = rounds_ffd, rounds_cost, feasible_any
+    if replicate is not None:
+        compact_ffd = jax.tree_util.tree_map(replicate, compact_ffd)
+        compact_cost = jax.tree_util.tree_map(replicate, compact_cost)
+        compact_feasible = replicate(compact_feasible)
+    compact = compact_plan(compact_ffd, compact_cost, compact_feasible)
+    objective = lp.objective.reshape(1).astype(jnp.float32)
+    return compact, objective, dense_ints, lp.assignment.ravel()
 
 
-def unpack_fused(
-    ints: np.ndarray, floats: np.ndarray, num_groups: int, num_types: int
-) -> Tuple:
-    """Host-side inverse of _cost_fused_body's output packing:
-    (rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective)
-    from the two flat arrays, given the PADDED group/type counts."""
+def unpack_dense(ints: np.ndarray, num_groups: int) -> Tuple:
+    """Host-side inverse of the dense spill packing:
+    (rounds_ffd, rounds_cost, feasible_any) from the flat int array, given
+    the PADDED group count."""
     from karpenter_tpu.ops.pack_kernel import PackRounds, max_rounds
 
     mr = max_rounds(num_groups)
@@ -252,27 +310,106 @@ def unpack_fused(
     rounds_ffd = take_rounds()
     rounds_cost = take_rounds()
     feasible_any = take(num_groups).astype(bool)
-    lp_assignment = floats[: num_groups * num_types].reshape(
-        num_groups, num_types
-    )
-    lp_objective = floats[num_groups * num_types]
-    return rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective
+    return rounds_ffd, rounds_cost, feasible_any
 
 
 class FusedHandle(NamedTuple):
-    """A dispatched fused solve: two in-flight device arrays plus the
-    static padded shapes needed to unpack them after the fetch."""
+    """A dispatched fused solve: in-flight device arrays plus the static
+    padded shapes needed to decode them after the fetch. Only `eager`
+    (compact payload + LP objective, a few KB) is fetched on the hot path;
+    `dense` is the spill for COO-budget overflow, and `lp` stays on device
+    unless the scoring pass realizes the LP plan (fetch_plans)."""
 
-    ints: object  # [NI] int32 (device array until fetched)
-    floats: object  # [NF] float32
+    compact: object  # [NW] int32 (device array until fetched)
+    objective: object  # [1] float32
+    dense: object  # [NI] int32 — dense spill, fetched only on overflow
+    lp: object  # [G*T] float32 — deferred LP assignment
     num_groups: int  # padded G
     num_types: int  # padded T
 
+    @property
+    def eager(self):
+        return (self.compact, self.objective)
+
 
 _cost_fused_kernel = functools.partial(
-    jax.jit(_cost_fused_body, static_argnames=("lp_steps", "constrain")),
+    # vectors/counts donated: per-solve arrays nothing reads after dispatch
+    # (ops/pack_kernel.pack_kernel documents the invariant). The fleet-side
+    # args may be device_resident handles shared across sweeps and must
+    # never be donated.
+    jax.jit(
+        _cost_fused_body,
+        static_argnames=("lp_steps", "constrain", "replicate"),
+        donate_argnums=(0, 1),
+    ),
     constrain=None,
+    replicate=None,
 )
+
+
+class FetchedPlan:
+    """A fused solve's decoded eager payload plus deferred device handles.
+
+    The compacted fetch helpers (fetch_plan / fetch_plans) produce these;
+    cost_solve_finish consumes them. lp_assignment() triggers the deferred
+    [G, T] fetch the first time the LP realization pass actually runs —
+    solves whose kernel candidates win outright never transfer it."""
+
+    def __init__(self, rounds_ffd, rounds_cost, feasible_any, lp_objective, handle):
+        self.rounds_ffd = rounds_ffd
+        self.rounds_cost = rounds_cost
+        self.feasible_any = feasible_any
+        self.lp_objective = lp_objective
+        self._handle = handle
+        self._lp: Optional[np.ndarray] = None
+
+    def lp_assignment(self) -> np.ndarray:
+        if self._lp is None:
+            handle = self._handle
+            self._lp = np.asarray(_to_host(handle.lp)).reshape(
+                handle.num_groups, handle.num_types
+            )
+        return self._lp
+
+
+def plan_start_fetch(handle: FusedHandle) -> None:
+    """Queue the EAGER leaves' device->host copies (compact payload +
+    objective) behind the dispatched kernel — the compacted analogue of
+    calling _start_fetch on a whole output tree."""
+    _start_fetch(handle.eager)
+
+
+def fetch_plans(handles: Sequence[FusedHandle]) -> List["FetchedPlan"]:
+    """THE compacted fetch: one device->host transfer for every handle's
+    eager payload (a batch shares one round trip), then host-side decode.
+    A plan that overflowed the COO entry budget falls back to its dense
+    spill — correctness never depends on the budget."""
+    from karpenter_tpu.ops.pack_kernel import decompact_plan
+
+    eager = _to_host([handle.eager for handle in handles])
+    plans: List[FetchedPlan] = []
+    for handle, (compact, objective) in zip(handles, eager):
+        rounds_ffd, rounds_cost, feasible_any, ok = decompact_plan(
+            np.asarray(compact), handle.num_groups
+        )
+        if not ok:  # pragma: no cover — entry budget sized to never trip
+            rounds_ffd, rounds_cost, feasible_any = unpack_dense(
+                np.asarray(_to_host(handle.dense)), handle.num_groups
+            )
+        plans.append(
+            FetchedPlan(
+                rounds_ffd,
+                rounds_cost,
+                feasible_any,
+                float(np.asarray(objective)[0]),
+                handle,
+            )
+        )
+    return plans
+
+
+def fetch_plan(handle: FusedHandle) -> "FetchedPlan":
+    return fetch_plans([handle])[0]
 
 
 _SHARDED_KERNEL_CACHE: Dict[Tuple, Tuple] = {}
@@ -298,20 +435,25 @@ def _sharded_fused_kernel(mesh=None):
     if cached is not None:
         return cached
     gt_sharding = NamedSharding(mesh, P(GROUPS_AXIS, TYPES_AXIS))
+    replicated = NamedSharding(mesh, P())
 
     def constrain(x):
         return jax.lax.with_sharding_constraint(x, gt_sharding)
 
+    def replicate(x):
+        return jax.lax.with_sharding_constraint(x, replicated)
+
     kernel = functools.partial(
         jax.jit(
             _cost_fused_body,
-            static_argnames=("lp_steps", "constrain"),
+            static_argnames=("lp_steps", "constrain", "replicate"),
             # Replicated outputs: every process (and every device) holds the
             # full result, so rank 0 of a multi-host slice can fetch it
             # without touching non-addressable shards (parallel/spmd.py).
             out_shardings=NamedSharding(mesh, P()),
         ),
         constrain=constrain,
+        replicate=replicate,
     )
     groups_size, types_size = mesh.devices.shape
     cached = (kernel, (int(groups_size), int(types_size)))
@@ -660,14 +802,9 @@ def _start_fetch(tree) -> None:
                 return
 
 
-def fetch_bytes(tree) -> int:
-    """Total bytes of a fused-kernel output pytree — the per-solve
-    device->host payload (published by bench.py as `fetch_bytes`)."""
-    return sum(
-        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
-        for leaf in jax.tree_util.tree_leaves(tree)
-        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
-    )
+# fetch_bytes — THE payload byte accounting — is re-exported from
+# ops/pack_kernel (top-of-module import), where it lives next to the
+# compact layout shape math, shared with consolidate's eager fetch.
 
 
 def _kernel_rounds_to_list(host_rounds: "PackRounds", num_groups: int):
@@ -779,7 +916,9 @@ def cost_solve_dense(
     # candidates answer in milliseconds and carry the cost win; the device
     # path owns scale, where its throughput and mesh sharding pay for the
     # trip. Falls through when the native library is unavailable.
-    if host_solve_enabled(int(np.asarray(counts).sum())):
+    if host_solve_enabled(
+        int(np.asarray(counts).sum())  # vet: host-array(dense inputs arrive as numpy)
+    ):
         if callable(pool_prices):
             pool_prices = pool_prices()
         dense = cost_solve_host(
@@ -802,10 +941,10 @@ def cost_solve_dense(
         # column-LP mix candidate (enumeration, pricing, covering LP,
         # integerization) run in a worker thread CONCURRENTLY with the
         # fetch — they add nothing to the solve's latency.
-        _start_fetch(fused)
+        plan_start_fetch(fused)
         overlap = _HostOverlap([(vectors, counts, capacity, pool_prices)])
         overlap.start()
-        fetched = _to_host(fused)
+        fetched = fetch_plan(fused)
         (pool_prices,), (mix_plan,) = overlap.join()
 
     return cost_solve_finish(
@@ -822,13 +961,21 @@ class _HostOverlap:
     concurrently with the blocking device fetch (device_get releases the
     GIL while it waits on the transfer). Mix candidates are best-effort (an
     internal error degrades that item to no-mix); a pool-matrix failure
-    re-raises on join, since the finish path cannot proceed without it."""
+    re-raises on join, since the finish path cannot proceed without it.
+
+    Items complete IN ORDER and each completion sets a per-item event, so
+    the pipelined consumers (solve_encoded_pipelined, the sidecar's
+    SolveStream) can wait(k) for just their item instead of joining the
+    whole batch — the hand-off that lets schedule k's decode start while
+    later schedules' host work is still running."""
 
     def __init__(self, items: Sequence[Tuple]):
         self._items = list(items)
         self.pool_prices: List = [None] * len(self._items)
         self.mix_plans: List = [None] * len(self._items)
         self._error: Optional[BaseException] = None
+        self._error_index = len(self._items)
+        self._done = [threading.Event() for _ in self._items]
         self._thread = threading.Thread(
             target=self._run, name="solve-host-overlap", daemon=True
         )
@@ -847,6 +994,9 @@ class _HostOverlap:
                 self.pool_prices[index] = pool_prices
             except BaseException as error:  # noqa: BLE001 — re-raised on join
                 self._error = error
+                self._error_index = index
+                for event in self._done[index:]:
+                    event.set()
                 return
             try:
                 self.mix_plans[index] = compute_mix_candidate(
@@ -856,6 +1006,15 @@ class _HostOverlap:
                 klog.named("solver").warning(
                     "mix candidate failed; solving without it", exc_info=True
                 )
+            self._done[index].set()
+
+    def wait(self, index: int) -> None:
+        """Block until item `index` is finished; re-raise the pool-matrix
+        error iff it poisoned this item (items before the failure stay
+        usable — their slots were already filled in order)."""
+        self._done[index].wait()
+        if self._error is not None and index >= self._error_index:
+            raise self._error
 
     def join(self) -> Tuple[List, List]:
         self._thread.join()
@@ -915,15 +1074,20 @@ def compute_mix_candidate(
 
 
 # Below this many pods a solve goes host-only: the device fetch costs a
-# full (often tunneled) round trip — ~70ms on the bench rig — while the
-# host candidates (compiled FFD + the column-LP mix) answer faster with
-# identical plans. Measured break-even on the bench rig: 10k pods × 200
-# types host-solves in ~49ms vs ~94ms on device (same cost ratios under
-# both accountings); at 50k × 400 the device wins (~93ms vs ~157ms host)
-# and additionally scales via mesh sharding. 10k is the last measured
-# point where host wins — it is also the CAP on boot calibration below:
-# past it the host's own superlinear growth (types × pods FFD walk) is
-# unvalidated territory regardless of how slow the fetch is.
+# full (often tunneled) round trip — ~70ms on the bench rig for the OLD
+# dense payload; the compacted payload (ops/pack_kernel.compact_plan) is a
+# few KB and latency-bound, so on a recalibrated rig the probed floor is
+# what a compacted fetch actually costs, not the dense 38KB one — while
+# the host candidates (compiled FFD + the column-LP mix) answer faster
+# with identical plans. Measured break-even on the bench rig (dense-era):
+# 10k pods × 200 types host-solves in ~49ms vs ~94ms on device; at
+# 50k × 400 the device wins and additionally scales via mesh sharding.
+# 10k is the last measured point where host wins — it is also the CAP on
+# boot calibration below: past it the host's own superlinear growth
+# (types × pods FFD walk) is unvalidated territory regardless of how slow
+# the fetch is. Boot calibration (calibrate_break_even) probes the
+# COMPACTED fetch size and will derive a far lower break-even wherever
+# the floor shrank — this constant is only the never-calibrated default.
 HOST_SOLVE_MAX_PODS = 10_000
 # The BATCHED paths (solve_encoded_many, the sidecar's SolveStream) share
 # ONE device fetch across K schedules, so the per-schedule device cost is
@@ -936,7 +1100,11 @@ HOST_SOLVE_MAX_PODS_BATCHED = 2_000
 # roughly flat across the ladder (the round loop, not the payload,
 # dominates) — measured on the bench rig at 10k×200 (94ms total − 70ms
 # floor) and 50k×400 (93ms − 70ms). Used by break-even calibration as the
-# device-side cost the host must beat on top of the fetch floor.
+# device-side cost the host must beat on top of the fetch floor. The
+# compaction post-pass adds negligible compute (a prefix-sum + scatter over
+# [MR×G] cells), so this estimate holds for the compacted pipeline; boot
+# warmup measures the real value on the live backend anyway and only falls
+# back to this constant when it can't.
 DEVICE_COMPUTE_EST_MS = 22.0
 
 
@@ -957,17 +1125,23 @@ _break_even_lock = threading.Lock()
 
 
 def _probe_fetch_floor_ms(reps: int = 3) -> float:
-    """One device->host round trip with a negligible payload — the same
-    fetch path _to_host uses (bench.py publishes the identical probe as
-    device_fetch_floor_ms). min-of-reps: the floor, not the noise."""
+    """One device->host round trip with a COMPACT-sized payload — the same
+    fetch path _to_host uses, sized to what a compacted plan fetch actually
+    transfers at the headline group bucket (compact_words(16) int32s, a few
+    KB) rather than a toy 8-int probe, so the break-even the calibration
+    derives prices the real payload. min-of-reps: the floor, not the
+    noise. bench.py publishes the identical probe as
+    device_fetch_floor_ms."""
     import time as _time
 
-    probe = jnp.zeros((8,), jnp.int32) + 1
+    from karpenter_tpu.ops.pack_kernel import compact_words
+
+    probe = jnp.zeros((compact_words(16),), jnp.int32) + 1
     jax.block_until_ready(probe)
     samples = []
     for _ in range(reps):
         start = _time.perf_counter()
-        jax.device_get(probe)
+        _to_host(probe)
         samples.append((_time.perf_counter() - start) * 1e3)
     return min(samples)
 
@@ -1201,7 +1375,15 @@ def cost_solve_dispatch(
     mesh = solve_mesh()
     if mesh is None:
         padded = pad_kernel_args(vectors, counts, capacity, total, prices)
-        ints, floats = _cost_fused_kernel(*padded, lp_steps=lp_steps)
+        # Fleet-side args ride device-resident handles: back-to-back sweeps
+        # over the same encoded fleet (repeat batches, provision ->
+        # consolidate in one reconcile turn) skip the host->device transfer
+        # of the [T, R] state entirely. Pod-side args (vectors, counts) stay
+        # host arrays — they change every solve and the kernel DONATES them.
+        from karpenter_tpu.ops.pack_kernel import device_resident
+
+        padded = padded[:2] + tuple(device_resident(a) for a in padded[2:])
+        out = _cost_fused_kernel(*padded, lp_steps=lp_steps)
     else:
         kernel, (g_mult, t_mult) = _sharded_fused_kernel(mesh)
         padded = pad_kernel_args(
@@ -1212,40 +1394,44 @@ def cost_solve_dispatch(
             # (SPMD) — replicate this solve to the followers first.
             from karpenter_tpu.parallel import spmd
 
-            ints, floats = spmd.lead_dispatch(kernel, padded, lp_steps)
+            out = spmd.lead_dispatch(kernel, padded, lp_steps)
         else:
-            ints, floats = kernel(*padded, lp_steps=lp_steps)
+            out = kernel(*padded, lp_steps=lp_steps)
+    compact, objective, dense_ints, lp_flat = out
     return FusedHandle(
-        ints=ints,
-        floats=floats,
+        compact=compact,
+        objective=objective,
+        dense=dense_ints,
+        lp=lp_flat,
         num_groups=int(padded[0].shape[0]),
         num_types=int(padded[2].shape[0]),
     )
 
 
 def _collect_candidates(fetched, num_groups: int, host_candidates, mix_plan):
-    """Assemble the candidate pool for scoring — kernel outputs (unpacked
-    from the fused fetch), host candidates, and the mix plan — in round
+    """Assemble the candidate pool for scoring — kernel outputs (decoded
+    from the compacted fetch), host candidates, and the mix plan — in round
     form, with a parallel label list for explain output. Returns
-    (candidates, labels, lp_assignment, feasible_any, lp_objective)."""
-    lp_assignment = feasible_any = None
+    (candidates, labels, lp_supplier, feasible_any, lp_objective):
+    lp_supplier is a zero-arg callable producing the [G, T] LP assignment —
+    for a FetchedPlan it defers the device fetch until the realization pass
+    actually runs."""
+    lp_supplier = feasible_any = None
     lp_objective = np.inf
     candidates: List[Tuple[List[Tuple[int, np.ndarray, int]], np.ndarray]] = []
     labels: List[str] = []
     if fetched is not None:
-        if isinstance(fetched, FusedHandle):
-            rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
-                unpack_fused(
-                    np.asarray(fetched.ints),
-                    np.asarray(fetched.floats),
-                    fetched.num_groups,
-                    fetched.num_types,
-                )
-            )
+        if isinstance(fetched, FetchedPlan):
+            rounds_ffd = fetched.rounds_ffd
+            rounds_cost = fetched.rounds_cost
+            feasible_any = fetched.feasible_any
+            lp_objective = fetched.lp_objective
+            lp_supplier = fetched.lp_assignment
         else:  # pre-packing tuple form (kept for direct kernel callers)
             rounds_ffd, rounds_cost, lp_assignment, feasible_any, lp_objective = (
                 fetched
             )
+            lp_supplier = (lambda a=lp_assignment: a) if lp_assignment is not None else None
         for label, rounds in (("kernel_ffd", rounds_ffd), ("kernel_cost", rounds_cost)):
             if not bool(rounds.overflow):
                 candidates.append(
@@ -1261,7 +1447,7 @@ def _collect_candidates(fetched, num_groups: int, host_candidates, mix_plan):
     if mix_plan is not None:
         candidates.append(mix_plan)
         labels.append("mix")
-    return candidates, labels, lp_assignment, feasible_any, lp_objective
+    return candidates, labels, lp_supplier, feasible_any, lp_objective
 
 
 def cost_solve_finish(
@@ -1291,7 +1477,7 @@ def cost_solve_finish(
     "candidates" — so analysis tooling (tools/rank_consistency.py) can
     compare the expected-price ranking against realized market cost."""
     num_groups = int(vectors.shape[0])
-    candidates, labels, lp_assignment, feasible_any, lp_objective = (
+    candidates, labels, lp_supplier, feasible_any, lp_objective = (
         _collect_candidates(fetched, num_groups, host_candidates, mix_plan)
     )
 
@@ -1371,16 +1557,19 @@ def cost_solve_finish(
     # relaxed cost, so a kernel candidate clearly under the LP's fractional
     # objective makes the (host-side, ~15ms) realization pass very unlikely
     # to win; LP_REALIZE_SLACK covers the price-model gap between the two.
+    # Only HERE does the deferred [G, T] LP assignment get fetched off the
+    # device (lp_supplier) — the common case, a kernel candidate beating the
+    # objective outright, never transfers it.
     scores = {id(c): score(c) for c in candidates}
     best_kernel_cost = min(
         (s[1] for s in scores.values() if s[0] == 0), default=np.inf
     )
-    if lp_assignment is not None and (
+    if lp_supplier is not None and (
         not candidates
         or best_kernel_cost > float(lp_objective) * LP_REALIZE_SLACK
     ):
         lp_candidate = _realize_lp_dense(
-            lp_assignment, feasible_any, vectors, counts, capacity, total
+            lp_supplier(), feasible_any, vectors, counts, capacity, total
         )
         if lp_candidate is not None:
             candidates.append(lp_candidate)
@@ -1428,7 +1617,7 @@ def _batch_pool_options(
     distinct: Dict[bytes, Tuple[int, np.ndarray]] = {}
     for round_list, _ in candidates:
         for t, fill, _ in round_list:
-            fill = np.asarray(fill)
+            fill = np.asarray(fill)  # vet: host-array(candidate rounds are post-fetch numpy)
             key = fill.tobytes()
             if key not in distinct and key not in memo:
                 distinct[key] = (t, fill)
@@ -1492,7 +1681,9 @@ def _realize_lp_dense(
     # per-type shards that round into poorly-filled single nodes. Keep
     # each group's heaviest types (up to 8) and renormalize — the
     # realized node count drops sharply at negligible objective cost.
-    lp_assignment = np.asarray(lp_assignment, dtype=np.float64).copy()
+    lp_assignment = np.asarray(  # vet: host-array(already fetched by the caller)
+        lp_assignment, dtype=np.float64
+    ).copy()
     for g in range(num):
         row = lp_assignment[g]
         total_mass = row.sum()
@@ -1604,15 +1795,14 @@ class CostSolver(Solver):
             )
         return decode_dense_result(dense, groups, fleet, pool_zones)
 
-    def solve_encoded_many(
-        self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
-    ) -> List[ffd.PackResult]:
-        """Batch path: dispatch every schedule's fused kernel first (async),
-        build all pool matrices while the device works, then fetch ALL
-        outputs in one device->host transfer — K schedules cost one round
-        trip instead of K (the round trip dominates on tunneled devices)."""
+    def _dispatch_batch(self, items):
+        """Shared first stage of the batched and pipelined paths: host-solve
+        or dispatch every schedule (async, device->host copies queued), and
+        start ONE overlap worker for the pending schedules' host work.
+        Returns (results, pending, zones_box, overlap) where `results` holds
+        the already-finished slots and pending the in-flight ones."""
         results: List[Optional[ffd.PackResult]] = [None] * len(items)
-        pending = []  # (index, groups, fleet, fused)
+        pending = []  # (index, groups, fleet, fused, prebuilt_pool)
         for i, (groups, fleet) in enumerate(items):
             if fleet.num_types == 0 or groups.num_groups == 0:
                 results[i] = ffd.pack_groups(fleet, groups)
@@ -1647,18 +1837,18 @@ class CostSolver(Solver):
                 fleet.prices,
                 self.lp_steps,
             )
-            _start_fetch(fused)
+            plan_start_fetch(fused)
             pending.append((i, groups, fleet, fused, prebuilt_pool))
 
+        overlap = None
+        zones_box: List[Optional[List[str]]] = [None] * len(pending)
         if pending:
             # Per-schedule host work (pool matrices + mix candidates) runs in
-            # a worker thread concurrently with the ONE blocking batch fetch,
-            # exactly like the single-solve path. The thunks stash each
-            # fleet's zone axis so the finish loop doesn't rebuild it, and
-            # reuse a matrix the host-gate branch already built (rare
-            # fallthrough: native overflow after the gate passed).
-            zones_box: List[Optional[List[str]]] = [None] * len(pending)
-
+            # a worker thread concurrently with the blocking fetches, exactly
+            # like the single-solve path. The thunks stash each fleet's zone
+            # axis so the finish loop doesn't rebuild it, and reuse a matrix
+            # the host-gate branch already built (rare fallthrough: native
+            # overflow after the gate passed).
             def _matrix_thunk(
                 fleet: InstanceFleet, slot: int, prebuilt
             ) -> np.ndarray:
@@ -1677,30 +1867,92 @@ class CostSolver(Solver):
                     for k, (_, groups, fleet, _, prebuilt) in enumerate(pending)
                 ]
             ).start()
+        return results, pending, zones_box, overlap
+
+    def _finish_one(self, entry, zones, pool_prices, mix_plan, plan):
+        """Score + decode one pending schedule from its fetched plan."""
+        _, groups, fleet, _, _ = entry
+        dense = cost_solve_finish(
+            plan,
+            groups.vectors,
+            groups.counts,
+            fleet.capacity,
+            fleet.total,
+            fleet.prices,
+            pool_prices,
+            mix_plan=mix_plan,
+        )
+        return (
+            ffd.pack_groups(fleet, groups)
+            if dense is None
+            else decode_dense_result(dense, groups, fleet, zones)
+        )
+
+    def solve_encoded_many(
+        self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
+    ) -> List[ffd.PackResult]:
+        """Batch path: dispatch every schedule's fused kernel first (async),
+        build all pool matrices while the device works, then fetch ALL
+        compacted payloads in one device->host transfer — K schedules cost
+        one round trip instead of K (the round trip dominates on tunneled
+        devices)."""
+        results, pending, zones_box, overlap = self._dispatch_batch(items)
+        if pending:
             with device_profile(TRACER), TRACER.span(
                 "solve.device.batch", solves=len(pending)
             ):
-                fetched_all = _to_host([entry[3] for entry in pending])
+                plans = fetch_plans([entry[3] for entry in pending])
             pool_matrices, mix_plans = overlap.join()
-            for (i, groups, fleet, _, _), zones, pool_prices, mix_plan, fetched in zip(
-                pending, zones_box, pool_matrices, mix_plans, fetched_all
+            for entry, zones, pool_prices, mix_plan, plan in zip(
+                pending, zones_box, pool_matrices, mix_plans, plans
             ):
-                dense = cost_solve_finish(
-                    fetched,
-                    groups.vectors,
-                    groups.counts,
-                    fleet.capacity,
-                    fleet.total,
-                    fleet.prices,
-                    pool_prices,
-                    mix_plan=mix_plan,
-                )
-                results[i] = (
-                    ffd.pack_groups(fleet, groups)
-                    if dense is None
-                    else decode_dense_result(dense, groups, fleet, zones)
+                results[entry[0]] = self._finish_one(
+                    entry, zones, pool_prices, mix_plan, plan
                 )
         return results
+
+    def solve_encoded_pipelined(
+        self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
+    ) -> Iterator[ffd.PackResult]:
+        """The solve->bind pipeline: every schedule's kernel is dispatched
+        and its compacted device->host copy queued UP FRONT (double-buffered
+        — the copies stream behind the kernels on the device queue), then
+        results yield in schedule order. While the caller binds/launches
+        result N, schedules N+1.. are still computing and copying; each
+        fetch here finds its payload already staged instead of starting a
+        round trip. Crash-consistency note: provisioning only takes this
+        path when no crashpoint is armed (armed runs use the serial
+        solve-then-bind flow so mid-bind kills stay deterministic —
+        controllers/provisioning._solve_results).
+
+        Dispatch happens EAGERLY at the call (not at the first pull): the
+        caller's dispatch-stage timing stays honest, and the device starts
+        working before the first bind regardless of when iteration
+        begins."""
+        results, pending, zones_box, overlap = self._dispatch_batch(items)
+
+        def _results() -> Iterator[ffd.PackResult]:
+            next_pending = 0
+            for i in range(len(items)):
+                if results[i] is not None:
+                    yield results[i]
+                    continue
+                entry = pending[next_pending]
+                k = next_pending
+                next_pending += 1
+                # Wait for THIS schedule's host work only — later schedules'
+                # mix candidates keep computing while this one decodes/binds.
+                overlap.wait(k)
+                with device_profile(TRACER), TRACER.span(
+                    "solve.device.pipelined", solve=k
+                ):
+                    plan = fetch_plan(entry[3])
+                yield self._finish_one(
+                    entry, zones_box[k], overlap.pool_prices[k],
+                    overlap.mix_plans[k], plan,
+                )
+
+        return _results()
 
 
 def decode_dense_result(

@@ -105,13 +105,15 @@ def _compile_shapes(shapes: str) -> None:
 def _timed_device_solve_ms(num_groups: int, num_types: int) -> float:
     """Run one device solve at the given shape (compiling it if cold) and
     return its wall time — the warmup compile pass and the device-compute
-    probe are the same call."""
+    probe are the same call. Fetches through the COMPACTED helper so the
+    timed number is the real pipeline's cost (eager payload only), not the
+    dense spill + LP assignment the hot path never transfers."""
     from karpenter_tpu.models import solver as solver_models
 
     vectors, counts, capacity = make_synthetic_problem(num_groups, num_types)
     prices = (0.1 * np.arange(1, num_types + 1, dtype=np.float32))
     start = time.perf_counter()
-    solver_models._to_host(
+    solver_models.fetch_plan(
         solver_models.cost_solve_dispatch(
             vectors, counts, capacity, capacity.copy(), prices, 300,
             count=False,  # warmup, not a routed solve

@@ -25,20 +25,27 @@ Per-candidate masking carries the envelope differences between candidates
 `type_valid` carries per-candidate accelerator anti-waste), so heterogeneous
 candidates still share the single dispatch. Shapes are bucketed to powers of
 two (ops.pack_kernel.bucket_size) so repeat sweeps hit the jit cache, and
-the outputs come back in one device_get.
+the eager fetch is SMALL: the [C] scalar verdict columns plus the on-device
+argmax winner's [G, N] plan row — the full [C, G, N] plan tensor stays
+device-resident behind lazy accessors (docs/design/device-residency.md).
 """
 
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
-from typing import List, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from karpenter_tpu.ops.pack_kernel import bucket_size, pad_to
+from karpenter_tpu.ops.pack_kernel import (
+    bucket_size,
+    device_resident,
+    fetch_bytes,
+    pad_to,
+)
 from karpenter_tpu.ops.score_kernel import feasibility_mask
 
 ACTION_NONE = 0
@@ -79,14 +86,23 @@ class ConsolidationProblem:
 
 @dataclass
 class ConsolidationVerdicts:
-    """Per-candidate scores, one row per ConsolidationProblem candidate."""
+    """Per-candidate scores, one row per ConsolidationProblem candidate.
+
+    The [C, G, N] delete-plan tensor stays DEVICE-RESIDENT: the eager fetch
+    carries only the [C] scalar columns plus the argmax winner's [G, N] row
+    (prefetched on device — the only plan the common one-action sweep ever
+    decodes). take_row lazily fetches other candidates' rows on demand;
+    the delete_take property fetches the whole tensor (tests, tooling)."""
 
     delete_ok: np.ndarray  # [C] bool — every pod placed into headroom
-    delete_take: np.ndarray  # [C, G, N] int32 — pods of group g into bin j
     replace_type: np.ndarray  # [C] int32 — cheapest feasible type (by index)
     replace_price: np.ndarray  # [C] float — inf when no feasible type
     savings: np.ndarray  # [C] float — $/hr shed by the best action (-inf none)
     action: np.ndarray  # [C] int8 — ACTION_NONE | ACTION_DELETE | ACTION_REPLACE
+    _takes: object = None  # [Cp, Gp, Np] int32 device array (padded)
+    _shape: Tuple[int, int, int] = (0, 0, 0)  # real (C, G, N)
+    _rows: Dict[int, np.ndarray] = field(default_factory=dict)
+    _takes_host: Optional[np.ndarray] = None
 
     def best(self) -> int:
         """Index of the best cost-positive candidate, or -1."""
@@ -97,16 +113,46 @@ class ConsolidationVerdicts:
             return -1
         return index
 
+    def take_row(self, candidate: int) -> np.ndarray:
+        """One candidate's [G, N] delete plan. The device-argmax winner's
+        row arrived with the eager fetch; any other row is a tiny staged
+        device-side slice fetch, paid only when a sweep actually executes
+        more than the best action."""
+        row = self._rows.get(candidate)
+        if row is None:
+            _, num_groups, num_bins = self._shape
+            row = np.asarray(  # vet: host-array(_fetch returns numpy)
+                _fetch(self._takes[candidate])
+            )[:num_groups, :num_bins]
+            self._rows[candidate] = row
+        return row
+
+    @property
+    def delete_take(self) -> np.ndarray:
+        """The full [C, G, N] plan tensor, fetched on first use — test and
+        tooling convenience, NOT the sweep hot path."""
+        if self._takes_host is None:
+            num_candidates, num_groups, num_bins = self._shape
+            self._takes_host = np.asarray(  # vet: host-array(_fetch returns numpy)
+                _fetch(self._takes)
+            )[:num_candidates, :num_groups, :num_bins]
+        return self._takes_host
+
 
 def _counterfactual_body(
-    pod_vectors, pod_counts, headroom, bin_mask, type_capacity, type_prices, type_valid
+    pod_vectors, pod_counts, headroom, bin_mask, type_capacity, type_prices,
+    type_valid, node_prices, cand_valid,
 ):
     """The fused counterfactual math — one traced computation per shape
     bucket. Delete leg: batched first-fit-decreasing fill of the [C, N, R]
     masked headroom (groups arrive FFD-sorted; per group the cumulative-sum
     cutoff distributes the count across bins in row order — first-fit
     without a per-pod loop). Replace leg: score_kernel.feasibility_mask
-    over the [C, R] total demand."""
+    over the [C, R] total demand. Tail post-pass: the same savings/action
+    scoring the host applies (float32 here; the host re-derives it in
+    float64 as the authoritative copy) drives an ON-DEVICE argmax so the
+    winning candidate's [G, N] delete plan can be gathered and fetched
+    without transferring the full [C, G, N] tensor."""
     counts = pod_counts.astype(jnp.float32)
     room = jnp.where(bin_mask[:, :, None], headroom[None, :, :], 0.0)
 
@@ -141,55 +187,108 @@ def _counterfactual_body(
     priced = jnp.where(fits, type_prices[None, :], jnp.inf)
     replace_price = priced.min(axis=1)
     replace_type = jnp.argmin(priced, axis=1)
+
+    # On-device best-candidate selection (mirrors the host scoring below;
+    # padded candidates are masked out via cand_valid). Ties between the
+    # device float32 argmax and the host float64 re-derivation are resolved
+    # by the host — solve_candidates falls back to a lazy row fetch when
+    # the two disagree, so the prefetched row is an optimization, never the
+    # authority.
+    savings_delete = jnp.where(
+        delete_ok & cand_valid, node_prices, -jnp.inf
+    )
+    margin = node_prices - replace_price
+    savings_replace = jnp.where(
+        jnp.isfinite(replace_price)
+        & (margin > MIN_SAVINGS_DOLLARS)
+        & cand_valid,
+        margin,
+        -jnp.inf,
+    )
+    best = jnp.argmax(jnp.maximum(savings_delete, savings_replace))
+    best_take = takes[best]  # [G, N]
     return (
         takes.astype(jnp.int32),
         delete_ok,
         replace_type.astype(jnp.int32),
         replace_price,
+        best.astype(jnp.int32),
+        best_take.astype(jnp.int32),
     )
 
 
-_counterfactual_kernel = jax.jit(_counterfactual_body)
+# Per-sweep operands donated (nothing reads them after dispatch); the type
+# catalog arrays (argnums 4, 5) are NOT — they ride device_resident handles
+# reused across sweeps, and donation would kill them after one call.
+_counterfactual_kernel = jax.jit(
+    _counterfactual_body, donate_argnums=(0, 1, 2, 3, 6, 7, 8)
+)
+
+
+def _fetch(tree):
+    """THE single raw device->host fetch site of this module (everything
+    else — the eager scalar columns, lazy plan rows, the full-tensor test
+    convenience — routes through here; tools/vet's fetch-discipline checker
+    pins that)."""
+    return jax.device_get(tree)
+
+
+# Eager fetch payload (bytes) of the most recent solve_candidates call —
+# published by bench.py as the consolidation path's fetch_bytes. Plain
+# module state, written by the (single-threaded per sweep) solve path.
+LAST_FETCH_BYTES = 0
 
 
 def _padded(problem: ConsolidationProblem) -> Tuple:
     """Bucket-pad every axis to powers of two so repeat sweeps reuse the
     compiled kernel. Padded candidates carry zero counts, padded bins a
-    False mask, padded types a False validity column."""
+    False mask, padded types a False validity column. The type-catalog
+    arrays ride device_resident handles: back-to-back sweeps (and the
+    provision solve they follow) reuse the same encoded fleet content
+    without a fresh host->device transfer."""
     c_pad = bucket_size(max(problem.num_candidates, 1))
     g_pad = bucket_size(max(int(problem.pod_vectors.shape[1]), 1))
     n_pad = bucket_size(max(int(problem.headroom.shape[0]), 1))
     t_pad = bucket_size(max(int(problem.type_capacity.shape[0]), 1))
+    cand_valid = np.zeros(c_pad, dtype=bool)
+    cand_valid[: problem.num_candidates] = True
     return (
         pad_to(pad_to(problem.pod_vectors.astype(np.float32), c_pad), g_pad, axis=1),
         pad_to(pad_to(problem.pod_counts.astype(np.int32), c_pad), g_pad, axis=1),
         pad_to(problem.headroom.astype(np.float32), n_pad),
         pad_to(pad_to(problem.bin_mask.astype(bool), c_pad), n_pad, axis=1),
-        pad_to(problem.type_capacity.astype(np.float32), t_pad),
-        pad_to(problem.type_prices.astype(np.float32), t_pad),
+        device_resident(pad_to(problem.type_capacity.astype(np.float32), t_pad)),
+        device_resident(pad_to(problem.type_prices.astype(np.float32), t_pad)),
         pad_to(pad_to(problem.type_valid.astype(bool), c_pad), t_pad, axis=1),
+        pad_to(problem.node_prices.astype(np.float32), c_pad),
+        cand_valid,
     )
 
 
 def solve_candidates(problem: ConsolidationProblem) -> ConsolidationVerdicts:
     """Score every candidate's delete and replace counterfactuals in one
-    batched dispatch + one device->host fetch, then pick each candidate's
-    best cost-positive action host-side (delete preferred on ties — it
-    sheds the whole node instead of trading it)."""
+    batched dispatch + one SMALL device->host fetch — the [C] scalar
+    columns plus the on-device-argmax winner's [G, N] plan row; the full
+    [C, G, N] plan tensor stays device-resident behind lazy accessors.
+    Action selection is re-derived host-side in float64 (authoritative;
+    delete preferred on ties — it sheds the whole node instead of trading
+    it)."""
+    global LAST_FETCH_BYTES
     num_candidates = problem.num_candidates
     num_groups = int(problem.pod_vectors.shape[1])
     num_bins = int(problem.headroom.shape[0])
-    vectors, counts, headroom, bin_mask, capacity, prices, valid = _padded(problem)
-    fetched = jax.device_get(
-        _counterfactual_kernel(
-            vectors, counts, headroom, bin_mask, capacity, prices, valid
-        )
+    padded = _padded(problem)
+    takes_dev, delete_ok_d, replace_type_d, replace_price_d, best_d, best_take_d = (
+        _counterfactual_kernel(*padded)
     )
-    takes, delete_ok, replace_type, replace_price = fetched
-    takes = np.asarray(takes)[:num_candidates, :num_groups, :num_bins]
-    delete_ok = np.asarray(delete_ok)[:num_candidates]
-    replace_type = np.asarray(replace_type)[:num_candidates]
-    replace_price = np.asarray(replace_price, dtype=np.float64)[:num_candidates]
+    eager = (delete_ok_d, replace_type_d, replace_price_d, best_d, best_take_d)
+    LAST_FETCH_BYTES = fetch_bytes(eager)
+    delete_ok, replace_type, replace_price, device_best, best_take = _fetch(eager)
+    delete_ok = delete_ok[:num_candidates]
+    replace_type = replace_type[:num_candidates]
+    replace_price = np.asarray(  # vet: host-array(_fetch returns numpy)
+        replace_price, dtype=np.float64
+    )[:num_candidates]
 
     node_prices = problem.node_prices.astype(np.float64)
     savings_delete = np.where(delete_ok, node_prices, -np.inf)
@@ -210,14 +309,22 @@ def solve_candidates(problem: ConsolidationProblem) -> ConsolidationVerdicts:
         savings_delete,
         np.where(action == ACTION_REPLACE, savings_replace, -np.inf),
     )
-    return ConsolidationVerdicts(
+    verdicts = ConsolidationVerdicts(
         delete_ok=delete_ok,
-        delete_take=takes,
         replace_type=replace_type,
         replace_price=replace_price,
         savings=savings,
         action=action,
+        _takes=takes_dev,
+        _shape=(num_candidates, num_groups, num_bins),
     )
+    # Seed the row cache with the device winner's prefetched plan. The host
+    # float64 scoring is authoritative: if it disagrees with the device's
+    # float32 argmax (a tie at the precision boundary), take_row simply
+    # fetches the right row lazily instead.
+    if int(device_best) < num_candidates:
+        verdicts._rows[int(device_best)] = best_take[:num_groups, :num_bins]
+    return verdicts
 
 
 def delete_assignment(
@@ -228,7 +335,7 @@ def delete_assignment(
     the counts were encoded in); pods are consumed group-cursor style like
     models.solver._decode_rounds."""
     plan: List[Tuple[object, int]] = []
-    take = verdicts.delete_take[candidate]
+    take = verdicts.take_row(candidate)
     for g, group_members in enumerate(members):
         cursor = 0
         for j in np.nonzero(take[g] > 0)[0]:

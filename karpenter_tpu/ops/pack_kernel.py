@@ -31,7 +31,11 @@ All shapes padded: G -> groups (counts 0), T -> types (valid_types mask).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+import threading
+from collections import OrderedDict
+from typing import NamedTuple, Tuple
+
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +43,20 @@ import numpy as np
 
 _EPS = 1e-4
 _INF = jnp.inf
+
+
+def suppress_donation_advisory() -> None:
+    """Silence jax's "Some donated buffers were not usable" UserWarning for
+    this process. Buffer donation is a hint: backends that can't alias a
+    donated input into an output (XLA:CPU for most shapes) ignore it and
+    warn per compile, and on a CPU-fallback rig that advisory is expected
+    noise, not a signal. Called by OUR process entry points (controller,
+    sidecar, bench, smokes) — deliberately NOT at library import, so an
+    application embedding this package keeps its own warning filters
+    (pytest.ini applies the same filter for the test suite)."""
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
 
 
 class PackRounds(NamedTuple):
@@ -111,6 +129,13 @@ class _LoopState(NamedTuple):
 
 
 @functools.partial(
+    # NO donation here, deliberately: this kernel is traced INSIDE the
+    # fused cost kernel, twice, over the same operands — inner-jit donation
+    # would let XLA alias the first call's inputs into its outputs while
+    # the second call (and the LP) still read them. Donation lives on the
+    # TOP-LEVEL dispatch kernels only (models/solver._cost_fused_kernel,
+    # ops/consolidate._counterfactual_kernel), where the buffers really are
+    # dead after the call.
     jax.jit, static_argnames=("quirk", "mode")
 )
 def pack_kernel(
@@ -249,3 +274,187 @@ def bucket_size(n: int, minimum: int = 8) -> int:
     while size < n:
         size *= 2
     return size
+
+
+# --- on-device plan compaction ----------------------------------------------
+#
+# The dense PackRounds state is mostly padding: round_fill is [MR, G] but a
+# real plan touches a handful of (round, group) cells, and on a tunneled
+# accelerator every byte fetched rides the same ~70ms round trip. The
+# compaction post-pass runs ON DEVICE at the tail of the fused kernel and
+# squeezes each candidate plan into per-round (type, repl) rows plus a
+# prefix-sum-compacted COO list of the nonzero fill entries — a few KB for
+# the headline 50k-pod solve instead of the 38KB padded state. Decode
+# (decompact_plan) rebuilds the exact dense arrays, so everything downstream
+# of the fetch is bit-identical to the dense path.
+
+
+def entry_budget(num_groups: int) -> int:
+    """Static COO entry budget per candidate plan: 4 entries per round.
+    Opening FFD rounds touch many groups but replication retires them fast,
+    so real plans sit far below this; a plan that overflows the budget sets
+    the payload's nnz past it and the caller falls back to fetching the
+    dense spill (correctness never depends on the budget)."""
+    return 4 * max_rounds(num_groups)
+
+
+def compact_words(num_groups: int) -> int:
+    """int32 word count of compact_plan's payload for a padded group axis —
+    THE shape math `make fetch-smoke` holds the fetch budget against."""
+    mr = max_rounds(num_groups)
+    budget = entry_budget(num_groups)
+    per_candidate = mr + mr + 1 + num_groups + 1 + 1 + 2 * budget
+    return 2 * per_candidate + num_groups
+
+
+def compact_bytes(num_groups: int) -> int:
+    """Total eager fetch payload in bytes: the compact int32 words plus the
+    one float32 LP objective."""
+    return 4 * compact_words(num_groups) + 4
+
+
+def fetch_bytes(tree) -> int:
+    """Total bytes of an output pytree — the per-solve device->host payload
+    (published by bench.py per fetch path). THE byte accounting, shared by
+    the solver handles and the consolidation eager fetch so the two can't
+    drift from the real layouts."""
+    return sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+    )
+
+
+def _compact_rounds(rounds: PackRounds):
+    """Device-side compaction of one PackRounds: fixed-size int32 segments
+    [round_type, round_repl, num_rounds, unschedulable, overflow, nnz,
+    entry_idx, entry_fill]. entry_idx holds flat r*G+g indices of nonzero
+    round_fill cells, front-compacted by prefix sum; indices past the entry
+    budget are dropped by the scatter (mode="drop") and signalled via nnz."""
+    num_groups = rounds.round_fill.shape[1]
+    budget = entry_budget(num_groups)
+    flat = rounds.round_fill.reshape(-1)
+    mask = flat != 0
+    nnz = mask.sum().astype(jnp.int32)
+    position = jnp.cumsum(mask) - 1
+    dest = jnp.where(mask, position, budget)
+    entry_idx = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[dest]
+        .set(jnp.arange(flat.shape[0], dtype=jnp.int32), mode="drop")
+    )
+    entry_fill = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[dest]
+        .set(flat.astype(jnp.int32), mode="drop")
+    )
+    return [
+        rounds.round_type.astype(jnp.int32),
+        rounds.round_repl.astype(jnp.int32),
+        rounds.num_rounds.reshape(1).astype(jnp.int32),
+        rounds.unschedulable.astype(jnp.int32),
+        rounds.overflow.astype(jnp.int32).reshape(1),
+        nnz.reshape(1),
+        entry_idx,
+        entry_fill,
+    ]
+
+
+def compact_plan(rounds_ffd: PackRounds, rounds_cost: PackRounds, feasible_any):
+    """Both candidate plans plus the feasibility vector as ONE flat int32
+    array — the eager device->host payload of a fused cost solve."""
+    return jnp.concatenate(
+        _compact_rounds(rounds_ffd)
+        + _compact_rounds(rounds_cost)
+        + [feasible_any.astype(jnp.int32)]
+    )
+
+
+def decompact_plan(
+    words: np.ndarray, num_groups: int
+) -> Tuple[PackRounds, PackRounds, np.ndarray, bool]:
+    """Host-side inverse of compact_plan: (rounds_ffd, rounds_cost,
+    feasible_any, ok) with the dense [MR, G] fill matrices rebuilt
+    bit-identically. ok=False when either plan overflowed the COO entry
+    budget — the caller must fetch the dense spill instead."""
+    mr = max_rounds(num_groups)
+    budget = entry_budget(num_groups)
+    cursor = 0
+
+    def take(n):
+        nonlocal cursor
+        out = words[cursor : cursor + n]
+        cursor += n
+        return out
+
+    plans = []
+    ok = True
+    for _ in range(2):
+        round_type = take(mr)
+        round_repl = take(mr)
+        num_rounds = take(1)[0]
+        unschedulable = take(num_groups)
+        overflow = bool(take(1)[0])
+        nnz = int(take(1)[0])
+        entry_idx = take(budget)
+        entry_fill = take(budget)
+        if nnz > budget:
+            ok = False
+            plans.append(None)
+            continue
+        fill = np.zeros((mr * num_groups,), np.int32)
+        fill[entry_idx[:nnz]] = entry_fill[:nnz]
+        plans.append(
+            PackRounds(
+                round_type=round_type,
+                round_fill=fill.reshape(mr, num_groups),
+                round_repl=round_repl,
+                num_rounds=num_rounds,
+                unschedulable=unschedulable,
+                overflow=overflow,
+            )
+        )
+    feasible_any = take(num_groups).astype(bool)
+    return plans[0], plans[1], feasible_any, ok
+
+
+# --- device-resident encode reuse --------------------------------------------
+
+# Content-keyed cache of device handles for padded encode arrays (fleet
+# capacity/total/valid/prices, consolidation type arrays): back-to-back
+# sweeps in one reconcile turn (provision -> consolidate) re-derive the same
+# encoded state, and without the cache every dispatch pays a fresh
+# host->device transfer for it. Keyed by content, not object identity, so a
+# rebuilt-but-identical fleet still hits. NEVER pass a cached handle as a
+# donated argument — donation kills the buffer after one call.
+_DEVICE_RESIDENT: "OrderedDict[Tuple, object]" = OrderedDict()
+_DEVICE_RESIDENT_MAX = 64
+_device_resident_lock = threading.Lock()
+
+
+def device_resident(array: np.ndarray):
+    """A device handle holding `array`'s contents, shared across dispatches
+    with equal content. Pass-through for anything already on device."""
+    if not isinstance(array, np.ndarray):
+        return array
+    key = (array.shape, array.dtype.str, array.tobytes())
+    with _device_resident_lock:
+        cached = _DEVICE_RESIDENT.get(key)
+        if cached is not None:
+            _DEVICE_RESIDENT.move_to_end(key)
+            return cached
+    # The transfer runs OUTSIDE the lock (device work must not serialize
+    # unrelated dispatch threads); a racing double-put is harmless — last
+    # writer wins and the loser's handle is dropped.
+    handle = jax.device_put(array)
+    with _device_resident_lock:
+        while len(_DEVICE_RESIDENT) >= _DEVICE_RESIDENT_MAX:
+            _DEVICE_RESIDENT.popitem(last=False)
+        _DEVICE_RESIDENT[key] = handle
+    return handle
+
+
+def reset_device_resident() -> None:
+    """Test hook: drop every cached device handle."""
+    with _device_resident_lock:
+        _DEVICE_RESIDENT.clear()

@@ -130,8 +130,8 @@ def lp_relax_solve(
 def round_assignment(assignment: np.ndarray, counts: np.ndarray) -> np.ndarray:
     """Largest-remainder rounding of [G, T] relaxed assignment so each group's
     row sums exactly to counts[g]. Returns int64 [G, T]."""
-    assignment = np.asarray(assignment, dtype=np.float64)
-    counts = np.asarray(counts, dtype=np.int64)
+    assignment = np.asarray(assignment, dtype=np.float64)  # vet: host-array(host rounding pass)
+    counts = np.asarray(counts, dtype=np.int64)  # vet: host-array(host rounding pass)
     out = np.floor(assignment).astype(np.int64)
     for g in range(assignment.shape[0]):
         deficit = int(counts[g] - out[g].sum())

@@ -179,7 +179,7 @@ def sharded_lp_solve(
 def _pad(array, size, value):
     import numpy as np
 
-    array = np.asarray(array)
+    array = np.asarray(array)  # vet: host-array(padding runs on host inputs)
     if array.shape[0] >= size:
         return array
     widths = [(0, size - array.shape[0])] + [(0, 0)] * (array.ndim - 1)

@@ -62,16 +62,20 @@ def _broadcast_operands(padded):
     vectors, counts, capacity, total, valid, prices = padded
     out = _broadcast(
         (
-            np.asarray(vectors, np.float32),
-            np.asarray(counts, np.int32),
-            np.asarray(capacity, np.float32),
-            np.asarray(total, np.float32),
-            np.asarray(valid, np.uint8),
-            np.asarray(prices, np.float32),
+            np.asarray(vectors, np.float32),  # vet: host-array(padded numpy operands)
+            np.asarray(counts, np.int32),  # vet: host-array(padded numpy operands)
+            np.asarray(capacity, np.float32),  # vet: host-array(padded numpy operands)
+            np.asarray(total, np.float32),  # vet: host-array(padded numpy operands)
+            np.asarray(valid, np.uint8),  # vet: host-array(padded numpy operands)
+            np.asarray(prices, np.float32),  # vet: host-array(padded numpy operands)
         )
     )
     vectors, counts, capacity, total, valid, prices = (
-        np.asarray(leaf) for leaf in out
+        # The broadcast result is a committed device array and this IS a
+        # deliberate fetch: every process must feed the sharded kernel
+        # identical host operands, and the collective is the only transport.
+        np.asarray(leaf)  # vet: host-array(SPMD replication fetch, deliberate)
+        for leaf in out
     )
     return vectors, counts, capacity, total, valid.astype(bool), prices
 
@@ -125,7 +129,9 @@ def follower_loop() -> None:
         jax.process_index(), jax.process_count(), jax.device_count(),
     )
     while True:
-        header = np.asarray(_broadcast(np.zeros(4, np.int32)))
+        header = np.asarray(  # vet: host-array(4-int SPMD header, deliberate fetch)
+            _broadcast(np.zeros(4, np.int32))
+        )
         op, g_pad, t_pad, lp_steps = (int(x) for x in header)
         if op == OP_STOP:
             log.info("SPMD follower %d stopping", jax.process_index())

@@ -11,6 +11,8 @@ ref: aws/instancetypes.go:37,174-183).
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Callable, Optional
 
 import grpc
@@ -60,6 +62,17 @@ BLACKOUT_TOTAL = REGISTRY.counter(
     "Sidecar endpoint blackouts armed, by failure shape",
     ["reason"],
 )
+
+
+def _await_half_close(received, stream_done, failure) -> None:
+    """After every pipelined item yielded, give the stream's half-close
+    event a moment to land so the RPC histogram records true wire time (the
+    drain stamps stream_done before its terminal put)."""
+    if failure is None and stream_done[0] is None:
+        try:
+            received.get(timeout=1.0)
+        except queue.Empty:  # pragma: no cover — wedged half-close
+            pass
 
 
 class RemoteSolver(Solver):
@@ -217,6 +230,119 @@ class RemoteSolver(Solver):
                 responses, items, built
             )
         ]
+
+    def solve_encoded_pipelined(self, items):
+        """The remote half of the solve->bind pipeline: responses decode and
+        yield AS THEY ARRIVE off the stream (the sidecar yields each
+        schedule's response the moment it finishes —
+        solver_service/server.solve_stream), so the provisioner binds
+        schedule N while the sidecar still solves N+1.. across the wire.
+
+        Failure semantics degrade per item instead of per batch: results
+        already yielded are live (they may already be binding), so a
+        mid-stream RPC failure arms the blackout and host-solves only the
+        REMAINING schedules; per-request "error" markers host-solve that
+        item inline, and a stream where EVERY item errored arms the
+        poisoned-batch blackout exactly like solve_encoded_many.
+
+        A receiver thread drains the stream EAGERLY into a queue: the gRPC
+        deadline (sized for solve time) must never span the caller's
+        bind/launch work between pulls — lazy next() calls over seconds of
+        binds would hit DEADLINE_EXCEEDED on a perfectly healthy sidecar.
+        The same thread stamps stream completion, so the RPC histogram
+        records wire time only, not bind time."""
+        items = list(items)
+        if not items:
+            return
+        if self.clock() < self._blackout_until or not self._check_warm():
+            yield from self.fallback.solve_encoded_pipelined(items)
+            return
+        built = [self._build_request(groups, fleet) for groups, fleet in items]
+        deadline = min(
+            STREAM_TIMEOUT_CAP_SECONDS,
+            self.timeout_s + STREAM_PER_ITEM_SECONDS * len(items),
+        )
+        start = self.clock()
+        responses = self._stream_rpc(
+            iter(request for request, _ in built), timeout=deadline
+        )
+        received, stream_done = self._start_stream_drain(responses)
+        produced = 0
+        errored = 0
+        failure = None
+        while produced < len(items):
+            kind, payload = received.get()
+            if kind == "error":
+                failure = getattr(payload, "code", lambda: payload)()
+                break
+            if kind == "end":
+                failure = "short stream"
+                break
+            groups, fleet = items[produced]
+            _, zones = built[produced]
+            if payload.solver == "error":
+                errored += 1
+                yield self.fallback.solve_encoded(groups, fleet)
+            else:
+                yield self._decode(payload, groups, fleet, zones)
+            produced += 1
+        _await_half_close(received, stream_done, failure)
+        rpc_elapsed = (stream_done[0] or self.clock()) - start
+        if self._note_stream_outcome(
+            failure, produced, len(items), errored, rpc_elapsed
+        ):
+            for groups, fleet in items[produced:]:
+                yield self.fallback.solve_encoded(groups, fleet)
+
+    def _start_stream_drain(self, responses):
+        """Eagerly drain a SolveStream response iterator into a queue from a
+        background thread (see solve_encoded_pipelined). Returns the queue
+        and a 1-box stamped with the stream's end time (wire time, bind-free
+        — the terminal put always follows the stamp)."""
+        received: "queue.Queue" = queue.Queue()
+        stream_done = [None]
+
+        def _drain():
+            try:
+                for response in responses:
+                    received.put(("item", response))
+            except grpc.RpcError as error:
+                stream_done[0] = self.clock()
+                received.put(("error", error))
+            else:
+                stream_done[0] = self.clock()
+                received.put(("end", None))
+
+        threading.Thread(
+            target=_drain, name="remote-solve-drain", daemon=True
+        ).start()
+        return received, stream_done
+
+    def _note_stream_outcome(
+        self, failure, produced: int, total: int, errored: int,
+        rpc_elapsed: float,
+    ) -> bool:
+        """Histogram + blackout bookkeeping after a pipelined stream ends;
+        True means the caller must host-solve the unyielded remainder."""
+        if failure is not None:
+            _RPC_HISTOGRAM.observe(rpc_elapsed, "error")
+            self._blackout_until = self.clock() + self.blackout_s
+            BLACKOUT_TOTAL.inc("stream")
+            log.warning(
+                "sidecar %s pipelined stream failed after %d/%d (%s); host "
+                "fallback for %.0fs",
+                self.endpoint, produced, total, failure, self.blackout_s,
+            )
+            return True
+        _RPC_HISTOGRAM.observe(rpc_elapsed, "ok")
+        if errored == total:
+            self._blackout_until = self.clock() + self.blackout_s
+            BLACKOUT_TOTAL.inc("stream_poisoned")
+            log.warning(
+                "sidecar %s errored every stream item; host fallback for %.0fs",
+                self.endpoint, self.blackout_s,
+            )
+        return False
 
     def solve_encoded(self, groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
         if self.clock() < self._blackout_until:

@@ -163,7 +163,9 @@ class _Handler:
             )
 
         response.unschedulable.CopyFrom(
-            wire.encode_tensor(np.asarray(unschedulable, dtype=np.int64))
+            wire.encode_tensor(
+                np.asarray(unschedulable, dtype=np.int64)  # vet: host-array(post-fetch counts)
+            )
         )
         response.solve_ms = (time.perf_counter() - start) * 1e3
         with self._lock:
@@ -187,11 +189,14 @@ class _Handler:
         return dense.unschedulable
 
     def solve_stream(self, request_iterator, context):
-        """Batched solve: dispatch every cost-mode request's kernel before
-        fetching any result, so the stream shares ONE device->host round trip
-        (the latency floor on tunneled accelerators). Responses are yielded
-        in request order. Non-cost / empty requests take the unary path
-        inline."""
+        """Batched, pipelined solve: dispatch every cost-mode request's
+        kernel (and queue its compacted device->host copy) before fetching
+        any result, then yield responses IN REQUEST ORDER as each finishes —
+        the client starts decoding/binding schedule N while schedules N+1..
+        are still computing and copying on the device. Each per-item fetch
+        finds its payload already staged (plan_start_fetch at dispatch), so
+        the stream still pays ~one round trip of latency, not one per item.
+        Non-cost / empty requests take the unary path inline."""
         ready = {}  # order -> finished SolveResponse
         pending = []  # (order, start, fused, arrays..., pool_prices)
         order = 0
@@ -230,7 +235,7 @@ class _Handler:
                         prices,
                         int(request.lp_steps) or 300,
                     )
-                    solver_models._start_fetch(fused)
+                    solver_models.plan_start_fetch(fused)
                     pending.append(
                         (order, start, fused, vectors, counts, capacity, total,
                          prices, pool_prices)
@@ -241,62 +246,55 @@ class _Handler:
                 ready[order] = _error_response(repr(err))
             order += 1
 
+        # Column-LP mix candidates: host work running in a worker thread
+        # CONCURRENTLY with the (staged) fetches — the same _HostOverlap the
+        # in-process paths use, consumed per item so request N's response
+        # doesn't wait on request N+1's mix candidate. Best-effort per slot;
+        # pool matrices arrive off the wire, so wait() cannot raise here.
+        # The finish phase stays isolated per request: a poisoned fetch or
+        # finish failure marks only ITS slot for client fallback — completed
+        # responses always reach the client, and the responses already
+        # yielded were on the wire before the failure happened.
+        overlap = None
         if pending:
-            # Column-LP mix candidates: host work running in a worker thread
-            # CONCURRENTLY with the one batch fetch (the blocking device_get
-            # releases the GIL while it waits on the tunnel) — the same
-            # _HostOverlap the in-process paths use. Best-effort per slot;
-            # pool matrices arrive off the wire, so join cannot raise.
             overlap = solver_models._HostOverlap(
                 [
                     (entry[3], entry[4], entry[5], entry[8])
                     for entry in pending
                 ]
             ).start()
-            # The finish phase is isolated per request too: a poisoned batch
-            # fetch marks every pending slot for client fallback, and a
-            # per-item finish failure marks only that slot — completed
-            # responses always reach the client.
-            fetched_all = None
-            try:
-                with TRACER.span("solver.serve.stream", solves=len(pending)):
-                    fetched_all = solver_models._to_host(
-                        [entry[2] for entry in pending]
+        next_pending = 0
+        with TRACER.span("solver.serve.stream", solves=len(pending)):
+            for slot in range(order):
+                if slot in ready:
+                    yield ready[slot]
+                    continue
+                k = next_pending
+                next_pending += 1
+                (_, start, fused, vectors, counts, capacity, total, prices,
+                 pool_prices) = pending[k]
+                try:
+                    overlap.wait(k)
+                    plan = solver_models.fetch_plan(fused)
+                    response = pb.SolveResponse()
+                    dense = solver_models.cost_solve_finish(
+                        plan, vectors, counts, capacity, total, prices,
+                        pool_prices, mix_plan=overlap.mix_plans[k],
                     )
-            except Exception as err:  # noqa: BLE001
-                for entry in pending:
-                    ready[entry[0]] = _error_response(f"batch fetch: {err!r}")
-            _, mix_plans = overlap.join()
-            if fetched_all is not None:
-                for (
-                    (slot, start, _, vectors, counts, capacity, total, prices,
-                     pool_prices),
-                    mix_plan,
-                    fetched,
-                ) in zip(pending, mix_plans, fetched_all):
-                    try:
-                        response = pb.SolveResponse()
-                        dense = solver_models.cost_solve_finish(
-                            fetched, vectors, counts, capacity, total, prices,
-                            pool_prices, mix_plan=mix_plan,
+                    unschedulable = self._encode_cost(
+                        response, dense, vectors, counts, capacity, total
+                    )
+                    response.unschedulable.CopyFrom(
+                        wire.encode_tensor(
+                            np.asarray(unschedulable, dtype=np.int64)  # vet: host-array(post-fetch counts)
                         )
-                        unschedulable = self._encode_cost(
-                            response, dense, vectors, counts, capacity, total
-                        )
-                        response.unschedulable.CopyFrom(
-                            wire.encode_tensor(
-                                np.asarray(unschedulable, dtype=np.int64)
-                            )
-                        )
-                        response.solve_ms = (time.perf_counter() - start) * 1e3
-                        with self._lock:
-                            self.solves += 1
-                    except Exception as err:  # noqa: BLE001
-                        response = _error_response(repr(err))
-                    ready[slot] = response
-
-        for slot in range(order):
-            yield ready[slot]
+                    )
+                    response.solve_ms = (time.perf_counter() - start) * 1e3
+                    with self._lock:
+                        self.solves += 1
+                except Exception as err:  # noqa: BLE001
+                    response = _error_response(repr(err))
+                yield response
 
     @staticmethod
     def _ffd_rounds(vectors, counts, capacity, total, prices, quirk):
@@ -418,6 +416,9 @@ def main(argv=None) -> None:
     from karpenter_tpu.utils.gctune import tune_gc
 
     tune_gc()  # long-running service: GOGC-style collector headroom
+    from karpenter_tpu.ops.pack_kernel import suppress_donation_advisory
+
+    suppress_donation_advisory()  # CPU-fallback rigs warn per compile
     parser = argparse.ArgumentParser(description="karpenter-tpu solver sidecar")
     parser.add_argument("--port", type=int, default=9090)
     parser.add_argument("--host", default="0.0.0.0")

@@ -362,6 +362,38 @@ class TestSolveStream:
     def test_empty_batch(self, remote):
         assert remote.solve_encoded_many([]) == []
 
+    def test_pipelined_matches_batched(self, server, constraints):
+        """The remote solve->bind pipeline (responses decoded and yielded as
+        they arrive off the stream) must produce exactly the barrier path's
+        plans, in order."""
+        client = RemoteSolver(f"127.0.0.1:{server.port}")
+        problems = [
+            (make_pods(40), make_instance_types(5), constraints, ()),
+            (make_pods(25), make_instance_types(8), constraints, ()),
+            (make_pods(10), make_instance_types(3), constraints, ()),
+        ]
+        batched = client.solve_many(problems)
+        pipelined = list(client.solve_many_pipelined(problems))
+        client.close()
+        assert len(pipelined) == 3
+        for got, want in zip(pipelined, batched):
+            assert _packing_signature(got) == _packing_signature(want)
+
+    def test_pipelined_falls_back_on_dead_endpoint(self, constraints):
+        """A dead sidecar mid-pipeline arms the blackout and host-solves the
+        remaining schedules — every schedule still gets a valid plan."""
+        clock = FakeClock()
+        client = RemoteSolver("127.0.0.1:1", timeout_s=0.3, clock=clock)
+        problems = [
+            (make_pods(10), make_instance_types(3), constraints, ()),
+            (make_pods(6), make_instance_types(2), constraints, ()),
+        ]
+        results = list(client.solve_many_pipelined(problems))
+        client.close()
+        oracle = GreedySolver().solve_many(problems)
+        assert [r.node_count for r in results] == [r.node_count for r in oracle]
+        assert clock() < client._blackout_until  # blackout armed
+
     def test_stream_isolates_malformed_request(self, server, constraints):
         """One bad request in a stream must not abort the whole batch
         (ADVICE r1: context.abort inside SolveStream killed every in-flight

@@ -175,6 +175,32 @@ CASES = [
             return jax.devices()
         """,
     ),
+    (
+        "fetch-discipline",
+        """
+        import jax
+        import numpy as np
+
+        def grab(tree):
+            fetched = jax.device_get(tree)
+            staged = tree.copy_to_host_async()
+            return np.asarray(fetched), staged
+        """,
+        """
+        import jax
+        import numpy as np
+
+        def _to_host(tree):
+            return tree
+
+        def decode(tree, counts):
+            plan = np.asarray(_to_host(tree))
+            total = int(
+                np.asarray(counts).sum()  # vet: host-array(wire input is numpy)
+            )
+            return plan, total
+        """,
+    ),
 ]
 
 
@@ -407,4 +433,4 @@ def test_production_tree_is_vet_clean():
 
 def test_checker_names_unique():
     names = [checker.name for checker in ALL_CHECKERS]
-    assert len(names) == len(set(names)) == 7
+    assert len(names) == len(set(names)) == 8

@@ -1,0 +1,111 @@
+"""fetch-smoke: the compacted-fetch budget guard, runnable on the CPU backend.
+
+Two assertions, both cheap enough for every `make smoke`:
+
+1. **Shape math** — the compacted plan payload at the headline scale
+   (50k pods / 400 types: 16 request shapes -> a 16-group bucket) stays
+   <= 4 KB. The budget is pure arithmetic over the compact layout
+   (ops/pack_kernel.compact_words), so this can't silently drift when
+   someone widens a segment — the number is recomputed from the same code
+   the kernel emits.
+
+2. **Bit-identical decode** — a real (CPU-backend) fused dispatch's
+   compacted payload decodes to exactly the dense spill's PackRounds, and
+   the eager payload the device actually produced matches the shape math.
+
+Run: timeout -k 10 120 python tools/fetch_smoke.py   (or `make fetch-smoke`)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HEADLINE_GROUPS_BUCKET = 16  # 50k bench pods collapse to 16 shapes
+FETCH_BUDGET_BYTES = 4096
+
+
+def main() -> int:
+    from karpenter_tpu.utils import backend_health
+
+    backend_health.pin_cpu()  # CPU backend by design — no probe needed
+
+    from karpenter_tpu.ops.pack_kernel import (
+        bucket_size,
+        compact_bytes,
+        suppress_donation_advisory,
+    )
+
+    suppress_donation_advisory()  # the smoke runs on CPU by design
+
+    # 1. Shape math: the eager payload at the headline bucket.
+    budget = compact_bytes(HEADLINE_GROUPS_BUCKET)
+    print(
+        f"compact payload @ G={HEADLINE_GROUPS_BUCKET} bucket: {budget} bytes "
+        f"(budget {FETCH_BUDGET_BYTES})"
+    )
+    assert budget <= FETCH_BUDGET_BYTES, (
+        f"compacted plan payload {budget}B exceeds the {FETCH_BUDGET_BYTES}B "
+        f"fetch budget at 50k pods / 400 types — the device-fetch floor win "
+        f"regressed"
+    )
+
+    # 2. A real dispatch: eager bytes == shape math, compact decode ==
+    # dense spill, across a few shapes including the headline bucket.
+    for num_groups, num_types in ((5, 9), (16, 64), (16, 400)):
+        _verify_shape(num_groups, num_types)
+
+    # The headline bucket really is 16 for the bench workload's 16 shapes.
+    assert bucket_size(16) == HEADLINE_GROUPS_BUCKET
+    print("OK: fetch-smoke — compact payload within budget, decode exact")
+    return 0
+
+
+def _verify_shape(num_groups: int, num_types: int) -> None:
+    import numpy as np
+
+    from karpenter_tpu.models import solver as solver_models
+    from karpenter_tpu.models.warmup import make_synthetic_problem
+    from karpenter_tpu.ops.pack_kernel import compact_bytes, decompact_plan
+
+    vectors, counts, capacity = make_synthetic_problem(
+        num_groups, num_types, pods_per_group=7
+    )
+    prices = 0.1 * np.arange(1, num_types + 1, dtype=np.float32)
+    handle = solver_models.cost_solve_dispatch(
+        vectors, counts, capacity, capacity.copy(), prices, 8, count=False
+    )
+    eager_bytes = solver_models.fetch_bytes(handle.eager)
+    expected = compact_bytes(handle.num_groups)
+    assert eager_bytes == expected, (
+        f"eager payload {eager_bytes}B != shape math {expected}B at "
+        f"G={handle.num_groups}"
+    )
+    assert eager_bytes <= FETCH_BUDGET_BYTES or handle.num_groups > 16
+    compact, objective = solver_models._to_host(handle.eager)
+    ffd_c, cost_c, feasible_c, ok = decompact_plan(
+        np.asarray(compact), handle.num_groups
+    )
+    assert ok, f"entry budget overflowed at G={num_groups}, T={num_types}"
+    dense = np.asarray(solver_models._to_host(handle.dense))
+    ffd_d, cost_d, feasible_d = solver_models.unpack_dense(
+        dense, handle.num_groups
+    )
+    for compacted, spilled in ((ffd_c, ffd_d), (cost_c, cost_d)):
+        assert np.array_equal(compacted.round_type, spilled.round_type)
+        assert np.array_equal(compacted.round_fill, spilled.round_fill), (
+            "compacted COO decode diverged from the dense fill matrix"
+        )
+        assert np.array_equal(compacted.round_repl, spilled.round_repl)
+        assert int(compacted.num_rounds) == int(spilled.num_rounds)
+        assert np.array_equal(compacted.unschedulable, spilled.unschedulable)
+        assert bool(compacted.overflow) == bool(spilled.overflow)
+    assert np.array_equal(feasible_c, feasible_d)
+    print(
+        f"G={num_groups} T={num_types}: eager {eager_bytes}B "
+        f"(bucket G={handle.num_groups}), decode bit-identical"
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

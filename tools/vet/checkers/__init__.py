@@ -1,7 +1,7 @@
 """Checker registry. Each checker module exports CHECKERS (a tuple of
 framework.Checker); ALL_CHECKERS is the suite `python -m tools.vet` runs."""
 
-from tools.vet.checkers import backend, clocks, crash, locks, metricsuse
+from tools.vet.checkers import backend, clocks, crash, fetch, locks, metricsuse
 
 ALL_CHECKERS = (
     *locks.CHECKERS,
@@ -9,6 +9,7 @@ ALL_CHECKERS = (
     *clocks.CHECKERS,
     *metricsuse.CHECKERS,
     *backend.CHECKERS,
+    *fetch.CHECKERS,
 )
 
 CHECKERS_BY_NAME = {checker.name: checker for checker in ALL_CHECKERS}

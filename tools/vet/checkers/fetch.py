@@ -1,0 +1,147 @@
+"""fetch-discipline: device->host transfers go through the compacted fetch
+helpers, never ad hoc.
+
+The hot path's entire latency story (ISSUE 6 / ROADMAP item 1) rests on
+plans staying device-resident and crossing to the host as a few-KB
+compacted payload. One stray ``jax.device_get`` (or ``np.asarray`` on a jax
+Array — the slow element-protocol path) re-grows a full-payload round trip
+silently, so the raw fetch primitives are pinned to three owners:
+
+- ``karpenter_tpu/models/solver.py::_to_host`` — THE raw fetch every
+  compacted helper (fetch_plan/fetch_plans, FetchedPlan.lp_assignment)
+  bottoms out in;
+- ``karpenter_tpu/ops/consolidate.py::_fetch`` — consolidation's single
+  fetch site (eager columns, lazy plan rows);
+- ``karpenter_tpu/utils/backend_health.py`` — the liveness probe.
+
+``copy_to_host_async`` is likewise owned by ``_start_fetch`` (solver.py):
+staging policy lives in one place or the double-buffered pipeline's
+"already staged" invariant rots.
+
+``np.asarray`` is only a fetch when its argument is a device array, which a
+static pass can't always prove; the rule is self-documenting instead: in a
+module that imports jax, every ``np.asarray`` call must either consume a
+``_to_host``/``_fetch`` result directly, sit in an allowlisted scope, or
+carry a ``# vet: host-array(<why the operand is host-resident>)`` waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.vet.framework import (
+    Checker,
+    Finding,
+    Module,
+    dotted_name,
+    scope_allows,
+    walk_with_qualname,
+)
+
+NAME = "fetch-discipline"
+
+DEVICE_GET_ALLOWED = {
+    "karpenter_tpu/models/solver.py::_to_host": "the one raw fetch",
+    "karpenter_tpu/ops/consolidate.py::_fetch": "consolidate's single fetch site",
+    "karpenter_tpu/utils/backend_health.py": "the liveness probe",
+}
+COPY_ASYNC_ALLOWED = {
+    "karpenter_tpu/models/solver.py::_start_fetch": "THE staging helper",
+}
+ASARRAY_ALLOWED = {
+    "karpenter_tpu/models/solver.py::fetch_plans": "decodes _to_host output",
+}
+WAIVER = "# vet: host-array("
+# Calls whose result is host-resident by construction: consuming them is
+# never a device fetch.
+HOST_PRODUCERS = ("_to_host", "_fetch")
+
+
+def _imports_jax(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.") for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax" or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+def _is_asarray(func: ast.AST) -> bool:
+    name = dotted_name(func)
+    return name in ("np.asarray", "numpy.asarray")
+
+
+def _consumes_host_producer(call: ast.Call) -> bool:
+    if len(call.args) != 1 or not isinstance(call.args[0], ast.Call):
+        return False
+    inner = dotted_name(call.args[0].func) or ""
+    return inner.split(".")[-1] in HOST_PRODUCERS
+
+
+def _waived(module: Module, lineno: int) -> bool:
+    return WAIVER in module.line_text(lineno)
+
+
+def _finding(module: Module, node: ast.AST, qual: str, kind: str, message: str):
+    return Finding(
+        checker=NAME,
+        file=module.rel,
+        line=node.lineno,
+        key=f"{kind}:{qual or '<module>'}",
+        message=message,
+    )
+
+
+def _call_finding(module: Module, node: ast.Call, qual: str, has_jax: bool):
+    name = dotted_name(node.func) or ""
+    if name == "device_get" or name.endswith(".device_get"):
+        if scope_allows(DEVICE_GET_ALLOWED, module.rel, qual):
+            return None
+        return _finding(
+            module, node, qual, "device-get",
+            "raw jax.device_get outside the compacted fetch helpers; route "
+            "through models/solver fetch_plan(s)/_to_host",
+        )
+    if (
+        has_jax
+        and _is_asarray(node.func)
+        and not _consumes_host_producer(node)
+        and not scope_allows(ASARRAY_ALLOWED, module.rel, qual)
+        and not _waived(module, node.lineno)
+    ):
+        return _finding(
+            module, node, qual, "asarray",
+            "np.asarray in a jax-importing module may be a device fetch; "
+            "consume a _to_host/_fetch result, or annotate the line with "
+            "`# vet: host-array(<reason>)` if the operand is host-resident",
+        )
+    return None
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in modules:
+        has_jax = _imports_jax(module.tree)
+        for node, qual in walk_with_qualname(module.tree):
+            found = None
+            if isinstance(node, ast.Call):
+                found = _call_finding(module, node, qual, has_jax)
+            elif (
+                isinstance(node, ast.Attribute)
+                and node.attr == "copy_to_host_async"
+                and not scope_allows(COPY_ASYNC_ALLOWED, module.rel, qual)
+            ):
+                found = _finding(
+                    module, node, qual, "copy-async",
+                    "copy_to_host_async staging is owned by "
+                    "models/solver._start_fetch (plan_start_fetch)",
+                )
+            if found is not None:
+                findings.append(found)
+    return findings
+
+
+CHECKERS = (Checker(NAME, _check),)

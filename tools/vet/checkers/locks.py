@@ -215,7 +215,8 @@ class _LockScan:
 
 ANNOTATION_RE = re.compile(r"#\s*vet:\s*(.+)$")
 VALID_FORM_RE = re.compile(
-    r"^(guarded-by\(self\.\w+\)|holds\(self\.\w+\)|unguarded\([^)]+\))"
+    r"^(guarded-by\(self\.\w+\)|holds\(self\.\w+\)|unguarded\([^)]+\)"
+    r"|host-array\([^)]+\))"
 )
 
 
@@ -230,22 +231,41 @@ def _annotation_findings(module: Module, consumed_guard_lines: Set[int]):
         for node in ast.walk(module.tree)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
     }
+    # host-array(...) waivers (consumed by the fetch-discipline checker)
+    # must sit on the np.asarray call line they cover — anywhere else they
+    # waive nothing.
+    asarray_lines = {
+        node.lineno
+        for node in ast.walk(module.tree)
+        if isinstance(node, ast.Call)
+        and dotted_name(node.func) in ("np.asarray", "numpy.asarray")
+    }
+    def diagnose(body: str, lineno: int):
+        if not VALID_FORM_RE.match(body):
+            return (
+                f"unrecognized vet annotation {body!r} "
+                f"(guarded-by/holds/unguarded/host-array)"
+            )
+        if body.startswith("guarded-by") and lineno not in consumed_guard_lines:
+            return (
+                "guarded-by annotation not consumed — it must sit on the "
+                "first line of a `self.<attr> = ...` assignment in __init__"
+            )
+        if body.startswith("holds(") and lineno not in def_lines:
+            return "holds() annotation must sit on the `def` line it covers"
+        if body.startswith("host-array") and lineno not in asarray_lines:
+            return (
+                "host-array() waiver must sit on the np.asarray call line "
+                "it covers"
+            )
+        return None
+
     ordinal = 0
     for lineno, line in enumerate(module.lines, start=1):
         match = ANNOTATION_RE.search(line)
         if not match:
             continue
-        body = match.group(1).strip()
-        problem = None
-        if not VALID_FORM_RE.match(body):
-            problem = f"unrecognized vet annotation {body!r} (guarded-by/holds/unguarded)"
-        elif body.startswith("guarded-by") and lineno not in consumed_guard_lines:
-            problem = (
-                "guarded-by annotation not consumed — it must sit on the "
-                "first line of a `self.<attr> = ...` assignment in __init__"
-            )
-        elif body.startswith("holds(") and lineno not in def_lines:
-            problem = "holds() annotation must sit on the `def` line it covers"
+        problem = diagnose(match.group(1).strip(), lineno)
         if problem is not None:
             yield Finding(
                 checker=LOCK_NAME, file=module.rel, line=lineno,
