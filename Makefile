@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -75,6 +75,14 @@ consolidation-smoke:
 fetch-smoke:
 	timeout -k 10 120 python tools/fetch_smoke.py
 
+# The incremental-encode guard (tools/encode_smoke.py): a churn loop over
+# the delta-maintained cluster tensors asserting bit-identical parity with
+# the snapshot encode every N events, the O(delta) timing budget (per-sweep
+# encode must beat a full snapshot encode by a wide relative margin),
+# tombstone-threshold compaction, and encode.mid-apply crash convergence.
+encode-smoke:
+	timeout -k 10 120 python tools/encode_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -84,6 +92,7 @@ smoke:
 	$(MAKE) interruption-smoke || rc=1; \
 	$(MAKE) consolidation-smoke || rc=1; \
 	$(MAKE) fetch-smoke || rc=1; \
+	$(MAKE) encode-smoke || rc=1; \
 	exit $$rc
 
 proto:

@@ -56,6 +56,7 @@ class Cluster:
         self._pdbs: Dict[str, Tuple[Dict[str, str], int]] = {}  # vet: guarded-by(self._lock) — selector, minAvailable
         self._leases: Dict[str, Tuple[str, float]] = {}  # vet: guarded-by(self._lock) — name -> (holder, expiry)
         self._watchers: List[Callable[[str, object], None]] = []
+        self._delta_watchers: List[Callable[[str, str, object], None]] = []
 
     # --- watch plumbing ----------------------------------------------------
 
@@ -64,9 +65,26 @@ class Cluster:
         {pod, node, provisioner, daemonset}."""
         self._watchers.append(callback)
 
-    def _notify(self, kind: str, obj) -> None:
+    def watch_deltas(self, callback: Callable[[str, str, object], None]) -> None:
+        """callback(verb, kind, obj) on every mutation — the verb-level feed
+        the incremental encoder consumes (models/cluster_state.py). Verbs:
+        apply | bind | update | delete | reschedule. Delivery order across
+        threads is NOT guaranteed; consumers must treat each event as a
+        sync-this-key hint and re-read the store (which is always at least
+        as new as the event), never as a replayable op log."""
+        self._delta_watchers.append(callback)
+
+    def _notify(self, kind: str, obj, verb: str = "apply") -> None:
+        # INVARIANT (pinned by the blocking-under-lock vet rule): callback
+        # dispatch runs OUTSIDE self._lock. Watch callbacks fan out into
+        # reconcile enqueues and the incremental-encode sync, both of which
+        # take their own locks — firing them under the store lock would
+        # convoy every verb behind the slowest consumer and invite
+        # lock-order inversions.
         for callback in list(self._watchers):
             callback(kind, obj)
+        for callback in list(self._delta_watchers):
+            callback(verb, kind, obj)
 
     # --- pods --------------------------------------------------------------
 
@@ -114,7 +132,7 @@ class Cluster:
                 raise NotFoundError(f"pod {pod.namespace}/{pod.name}")
             stored.node_name = node.name
             stored.unschedulable = False
-        self._notify("pod", stored)
+        self._notify("pod", stored, verb="bind")
 
     def delete_pod(
         self, namespace: str, name: str, uid: Optional[str] = None
@@ -130,7 +148,7 @@ class Cluster:
             if uid and (getattr(pod, "uid", "") or "") != uid:
                 return False
             self._pods.pop((namespace, name), None)
-        self._notify("pod", pod)
+        self._notify("pod", pod, verb="delete")
         return True
 
     def evict_pod(self, namespace: str, name: str) -> None:
@@ -145,7 +163,7 @@ class Cluster:
 
                 raise PDBViolationError(f"pod {namespace}/{name} blocked by PDB")
             pod.deletion_timestamp = self.clock.now()
-        self._notify("pod", pod)
+        self._notify("pod", pod, verb="update")
 
     def reschedule_pod(
         self, namespace: str, name: str, override_pdb: bool = False
@@ -185,7 +203,7 @@ class Cluster:
             pod.annotations[wellknown.RESCHEDULE_EPOCH_ANNOTATION] = str(
                 reschedule_epoch(pod) + 1
             )
-        self._notify("pod", pod)
+        self._notify("pod", pod, verb="reschedule")
         return pod
 
     # --- pod disruption budgets (simplified) --------------------------------
@@ -268,7 +286,7 @@ class Cluster:
         return nodes
 
     def update_node(self, node: NodeSpec) -> None:
-        self._notify("node", node)
+        self._notify("node", node, verb="update")
 
     def remove_node_annotation(self, node: NodeSpec, key: str) -> None:
         """Delete one annotation. A dedicated verb because removal does NOT
@@ -278,7 +296,7 @@ class Cluster:
         apiserver override patches the key to null explicitly."""
         with self._lock:
             node.annotations.pop(key, None)
-        self._notify("node", node)
+        self._notify("node", node, verb="update")
 
     def delete_node(self, name: str) -> None:
         """Marks deletion; the object lingers while finalizers remain
@@ -289,17 +307,19 @@ class Cluster:
                 return
             if node.deletion_timestamp is None:
                 node.deletion_timestamp = self.clock.now()
-            if not node.finalizers:
+            removed = not node.finalizers
+            if removed:
                 self._nodes.pop(name, None)
-        self._notify("node", node)
+        self._notify("node", node, verb="delete" if removed else "update")
 
     def remove_finalizer(self, node: NodeSpec, finalizer: str) -> None:
         with self._lock:
             if finalizer in node.finalizers:
                 node.finalizers.remove(finalizer)
-            if node.deletion_timestamp is not None and not node.finalizers:
+            removed = node.deletion_timestamp is not None and not node.finalizers
+            if removed:
                 self._nodes.pop(node.name, None)
-        self._notify("node", node)
+        self._notify("node", node, verb="delete" if removed else "update")
 
     # --- provisioners ------------------------------------------------------
 
@@ -336,7 +356,7 @@ class Cluster:
             provisioner = self._provisioners.pop(name, None)
         if provisioner is not None:
             provisioner.deletion_timestamp = self.clock.now()
-            self._notify("provisioner", provisioner)
+            self._notify("provisioner", provisioner, verb="delete")
 
     # --- daemonsets ---------------------------------------------------------
 

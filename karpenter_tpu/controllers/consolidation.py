@@ -141,6 +141,7 @@ class ConsolidationController:
         termination: TerminationController,
         max_disruption: int = DEFAULT_MAX_DISRUPTION,
         cooldown_seconds: float = DEFAULT_COOLDOWN_SECONDS,
+        cluster_state=None,
     ):
         self.cluster = cluster
         self.cloud = cloud
@@ -148,6 +149,12 @@ class ConsolidationController:
         self.termination = termination
         self.max_disruption = max_disruption
         self.cooldown_seconds = cooldown_seconds
+        # Incremental encoder (models/cluster_state.DeviceClusterState):
+        # nomination and receiver scoring read its O(delta)-maintained
+        # per-node pod index and used vectors instead of re-listing every
+        # pod per node per sweep (O(nodes x pods) on the snapshot path).
+        # EXECUTION (drain / rebind) stays on the authoritative store.
+        self.cluster_state = cluster_state
         self.log = klog.named("consolidation")
         # In-memory accounting only: the ACTION ANNOTATION on the victim is
         # the durable intent a restart resumes from. Savings estimates are
@@ -227,6 +234,20 @@ class ConsolidationController:
         candidates.sort(key=lambda c: (c.utilization, c.node.name))
         return candidates[:MAX_CANDIDATES]
 
+    def _pods_on(self, name: str) -> List[PodSpec]:
+        """One node's pods: the incremental index (O(pods on the node))
+        when the state is wired, the full-store filter otherwise."""
+        if self.cluster_state is not None:
+            return self.cluster_state.pods_on_node(name)
+        return self.cluster.list_pods(node_name=name)
+
+    def _used_on(self, name: str) -> Optional[np.ndarray]:
+        """One node's summed non-terminal request vector from the
+        incremental state, or None to compute from a pod walk."""
+        if self.cluster_state is not None:
+            return self.cluster_state.node_used(name)
+        return None
+
     def _nominate_one(self, node: NodeSpec, catalog) -> Optional[Candidate]:
         provisioner_name = self._owned_and_free(node)
         if provisioner_name is None:
@@ -234,7 +255,7 @@ class ConsolidationController:
         offering = self._offering(node, catalog)
         if offering is None or not offering.consolidatable or offering.price <= 0:
             return None
-        pods = self.cluster.list_pods(node_name=node.name)
+        pods = self._pods_on(node.name)
         replaceable = self._drainable_pods(pods)
         if replaceable is None:
             return None
@@ -346,9 +367,10 @@ class ConsolidationController:
         for node in self.cluster.list_nodes():
             if not self._can_receive(node):
                 continue
-            headroom = self._usable_capacity(node, catalog) - self._used(
-                self.cluster.list_pods(node_name=node.name)
-            )
+            used = self._used_on(node.name)
+            if used is None:
+                used = self._used(self._pods_on(node.name))
+            headroom = self._usable_capacity(node, catalog) - used
             receivers.append((node, np.maximum(headroom, 0.0)))
         cpu = 0  # RESOURCE_DIMS[0] is cpu; deterministic tie-break on name
         receivers.sort(key=lambda item: (item[1][cpu], item[0].name))

@@ -109,12 +109,19 @@ class InterruptionController:
         provisioning: ProvisioningController,
         termination: TerminationController,
         escalate_fraction: float = DEFAULT_ESCALATE_FRACTION,
+        cluster_state=None,
     ):
         self.cluster = cluster
         self.cloud = cloud
         self.provisioning = provisioning
         self.termination = termination
         self.escalate_fraction = escalate_fraction
+        # Incremental encoder: the drain's replaceable-pod listing reads its
+        # O(delta)-maintained per-node index instead of filtering the whole
+        # store per node per sweep; displacement itself re-reads the store
+        # (reschedule_pod), and the replacement re-solve the displaced pods
+        # feed (ProvisionerWorker.add) solves against the same state.
+        self.cluster_state = cluster_state
         self.log = klog.named("interruption")
         # node name -> first sweep that saw its interruption; the escalation
         # anchor. In-memory only: after a restart the window re-anchors at
@@ -258,11 +265,11 @@ class InterruptionController:
         """Pods worth replacement capacity — the same drain-eligibility
         predicate the terminator's eviction set uses, so the 'nothing
         replaceable left' handoff and the finalizer drain cannot disagree."""
-        return [
-            pod
-            for pod in self.cluster.list_pods(node_name=node.name)
-            if pod.survives_node_drain()
-        ]
+        if self.cluster_state is not None:
+            pods = self.cluster_state.pods_on_node(node.name)
+        else:
+            pods = self.cluster.list_pods(node_name=node.name)
+        return [pod for pod in pods if pod.survives_node_drain()]
 
     def _displace(self, node: NodeSpec, pod: PodSpec, escalated: bool) -> bool:
         """Unbind one pod back to pending and feed it to the provisioner.

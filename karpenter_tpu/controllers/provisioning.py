@@ -132,11 +132,17 @@ class ProvisionerWorker:
         cluster: Cluster,
         cloud: CloudProvider,
         solver: Optional[Solver] = None,
+        cluster_state=None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
         self.cloud = cloud
         self.solver = solver or GreedySolver()
+        # Incremental encoder (models/cluster_state.DeviceClusterState):
+        # when its delta-maintained tensors cover a schedule's batch, the
+        # spec->tensor encode is skipped and the solve runs against the
+        # device-resident state — O(churn) per sweep instead of O(cluster).
+        self.cluster_state = cluster_state
         self.scheduler = Scheduler(cluster)
         self._pending: List[PodSpec] = []  # vet: guarded-by(self._lock)
         # Pods beyond the batch cap wait HERE, not in the selection queue: a
@@ -261,13 +267,7 @@ class ProvisionerWorker:
         # launch and bind while schedules N+1.. are still solving on the
         # device (solve_many_pipelined).
         problems = [
-            (
-                schedule.pods,
-                self.cloud.get_instance_types(schedule.constraints),
-                schedule.constraints,
-                daemons,
-            )
-            for schedule in schedules
+            self._encode_problem(schedule, daemons) for schedule in schedules
         ]
         for schedule, result in self._solve_results(schedules, problems):
             if stats.launch_errors:
@@ -296,6 +296,28 @@ class ProvisionerWorker:
                 self.cluster.update_provisioner_status(live)
         return stats
 
+    def _encode_problem(self, schedule, daemons):
+        """One schedule as a solver problem. Fast path: when the incremental
+        encoder's pending tensors cover exactly this schedule's pods, hand
+        the solver the PRE-ENCODED (groups, fleet) pair — group_pods /
+        build_fleet are skipped and the kernel consumes the device-resident
+        arrays (Solver._encode_problems passes the pair through). Any
+        mismatch (multi-schedule pass, mid-pass churn, torn state) falls
+        back to the snapshot encode, which stays authoritative."""
+        instance_types = self.cloud.get_instance_types(schedule.constraints)
+        if self.cluster_state is not None:
+            encoded = self.cluster_state.encode_schedule(
+                schedule.pods, instance_types, schedule.constraints, daemons
+            )
+            if encoded is not None:
+                return encoded
+        return (schedule.pods, instance_types, schedule.constraints, daemons)
+
+    @staticmethod
+    def _problem_pods(problem) -> int:
+        # A pre-encoded problem is a (PodGroups, InstanceFleet) pair.
+        return problem[0].num_pods if len(problem) == 2 else len(problem[0])
+
     def _solve_results(self, schedules, problems):
         """Yield (schedule, result) pairs for the pass.
 
@@ -313,7 +335,7 @@ class ProvisionerWorker:
             with SOLVE_DURATION.measure(), TRACER.span(
                 "provision.solve",
                 schedules=len(problems),
-                pods=sum(len(p[0]) for p in problems),
+                pods=sum(self._problem_pods(p) for p in problems),
             ):
                 results = self.solver.solve_many(problems)
             yield from zip(schedules, results)
@@ -330,7 +352,7 @@ class ProvisionerWorker:
         with SOLVE_DURATION.measure(), TRACER.span(
             "provision.solve.dispatch",
             schedules=len(problems),
-            pods=sum(len(p[0]) for p in problems),
+            pods=sum(self._problem_pods(p) for p in problems),
         ):
             iterator = self.solver.solve_many_pipelined(problems)
         for index, schedule in enumerate(schedules):
@@ -522,10 +544,12 @@ class ProvisioningController:
         cluster: Cluster,
         cloud: CloudProvider,
         solver: Optional[Solver] = None,
+        cluster_state=None,
     ):
         self.cluster = cluster
         self.cloud = cloud
         self.solver = solver
+        self.cluster_state = cluster_state
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, int] = {}
         # Runtime wiring (runtime.Manager): propagated to every worker so a
@@ -562,7 +586,8 @@ class ProvisioningController:
         if self._hashes.get(provisioner.name) != new_hash:
             self._hashes[provisioner.name] = new_hash
             replacement = ProvisionerWorker(
-                effective, self.cluster, self.cloud, self.solver
+                effective, self.cluster, self.cloud, self.solver,
+                cluster_state=self.cluster_state,
             )
             replacement.batch_full = self.batch_full
             # Hand the old worker's accepted backlog (batch + overflow) to

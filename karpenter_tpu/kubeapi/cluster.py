@@ -308,7 +308,7 @@ class ApiServerCluster(Cluster):
             with self._lock:
                 node = self._nodes.pop(key, None)
             if node is not None:
-                self._notify("node", node)
+                self._notify("node", node, verb="delete")
         elif kind == "provisioner":
             with self._lock:
                 provisioner = self._provisioners.pop(key, None)
@@ -316,7 +316,7 @@ class ApiServerCluster(Cluster):
                 provisioner.deletion_timestamp = (
                     provisioner.deletion_timestamp or self.clock.now()
                 )
-                self._notify("provisioner", provisioner)
+                self._notify("provisioner", provisioner, verb="delete")
         elif kind == "daemonset":
             with self._lock:
                 self._daemonsets.pop(key, None)
@@ -406,7 +406,7 @@ class ApiServerCluster(Cluster):
         pod = super().try_get_pod(namespace, name)
         if pod is not None:
             pod.deletion_timestamp = self.clock.now()
-            self._notify("pod", pod)
+            self._notify("pod", pod, verb="update")
 
     def _reschedule_local(self, namespace: str, name: str):
         """Write-through displacement: clear spec.nodeName (merge-patch null

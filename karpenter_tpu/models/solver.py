@@ -95,9 +95,21 @@ class Solver(abc.ABC):
     ) -> List[Tuple[PodGroups, InstanceFleet]]:
         """THE spec->tensor encoding of a problem batch, shared by the
         barrier (solve_many) and pipelined (solve_many_pipelined) paths so
-        they can never drift."""
+        they can never drift.
+
+        Encoded-state fast path: a problem may arrive ALREADY encoded as a
+        (PodGroups, InstanceFleet) pair — the incremental encoder
+        (models/cluster_state.DeviceClusterState) hands these over when its
+        delta-maintained tensors cover the batch, and group_pods/build_fleet
+        are skipped entirely (per-sweep encode cost O(churn), not
+        O(cluster)). The pair passes through untouched so the two sources
+        stay interchangeable downstream."""
         encoded = []
-        for pods, instance_types, constraints, daemons in problems:
+        for item in problems:
+            if len(item) == 2 and isinstance(item[0], PodGroups):
+                encoded.append((item[0], item[1]))
+                continue
+            pods, instance_types, constraints, daemons = item
             groups = group_pods(list(pods))
             encoded.append(
                 (
@@ -341,6 +353,20 @@ _cost_fused_kernel = functools.partial(
         _cost_fused_body,
         static_argnames=("lp_steps", "constrain", "replicate"),
         donate_argnums=(0, 1),
+    ),
+    constrain=None,
+    replicate=None,
+)
+
+_cost_fused_kernel_nodonate = functools.partial(
+    # The no-donation twin for encoded-state solves: pod tensors coming from
+    # the incremental encode layer (models/cluster_state) are device arrays
+    # a caller may still read after the dispatch (parity checks, a retry
+    # against the same handle) — incremental buffers are NEVER donated
+    # (docs/design/incremental-encode.md), so those solves route here.
+    jax.jit(
+        _cost_fused_body,
+        static_argnames=("lp_steps", "constrain", "replicate"),
     ),
     constrain=None,
     replicate=None,
@@ -884,6 +910,18 @@ LP_REALIZE_SLACK = 0.8
 PRIORITY_DECAY = 0.5
 
 
+def device_pod_args(groups: PodGroups):
+    """The pod-side kernel tensors for a schedule: the encoded-state device
+    arrays when the groups carry them (DeviceClusterState handles — already
+    sorted + bucket-padded, and dispatched through the NON-donating kernel),
+    None otherwise (caller uses the host numpy tensors)."""
+    device_vectors = getattr(groups, "device_vectors", None)
+    device_counts = getattr(groups, "device_counts", None)
+    if device_vectors is None or device_counts is None:
+        return None
+    return device_vectors, device_counts
+
+
 def cost_solve_dense(
     vectors: np.ndarray,
     counts: np.ndarray,
@@ -893,6 +931,7 @@ def cost_solve_dense(
     pool_prices,
     lp_steps: int = 300,
     explain: Optional[dict] = None,
+    device_pods=None,
 ) -> Optional[DenseSolveResult]:
     """The flagship solve on dense tensors only — shared by the in-process
     CostSolver and the gRPC sidecar (which has no PodSpec/InstanceType
@@ -934,7 +973,14 @@ def cost_solve_dense(
     with device_profile(TRACER), TRACER.span(
         "solve.device", groups=num_groups, types=num_types
     ):
-        fused = cost_solve_dispatch(vectors, counts, capacity, total, prices, lp_steps)
+        # Encoded-state solves hand the kernel the device-resident pod
+        # tensors (skipping the host->device transfer AND donation); the
+        # host numpy mirrors keep serving the gate above and the scoring
+        # pass below — the two views are bit-identical by construction.
+        pod_vectors, pod_counts = device_pods or (vectors, counts)
+        fused = cost_solve_dispatch(
+            pod_vectors, pod_counts, capacity, total, prices, lp_steps
+        )
         # Overlap with the device AND the fetch: dispatch is async and the
         # blocking device_get releases the GIL while it waits on the (often
         # tunneled) transfer, so the pool matrix build and the entire
@@ -1383,7 +1429,13 @@ def cost_solve_dispatch(
         from karpenter_tpu.ops.pack_kernel import device_resident
 
         padded = padded[:2] + tuple(device_resident(a) for a in padded[2:])
-        out = _cost_fused_kernel(*padded, lp_steps=lp_steps)
+        if isinstance(vectors, np.ndarray):
+            out = _cost_fused_kernel(*padded, lp_steps=lp_steps)
+        else:
+            # Pod tensors already on device (the incremental encode layer's
+            # sorted gather): same math, NO donation — the handle stays
+            # readable after the solve.
+            out = _cost_fused_kernel_nodonate(*padded, lp_steps=lp_steps)
     else:
         kernel, (g_mult, t_mult) = _sharded_fused_kernel(mesh)
         padded = pad_kernel_args(
@@ -1786,6 +1838,7 @@ class CostSolver(Solver):
             pool_prices_fn,
             lp_steps=self.lp_steps,
             explain=explain,
+            device_pods=device_pod_args(groups),
         )
         if dense is None:
             return ffd.pack_groups(fleet, groups)
@@ -1829,9 +1882,13 @@ class CostSolver(Solver):
                         dense, groups, fleet, prebuilt_pool[0]
                     )
                     continue
-            fused = cost_solve_dispatch(
+            pod_vectors, pod_counts = device_pod_args(groups) or (
                 groups.vectors,
                 groups.counts,
+            )
+            fused = cost_solve_dispatch(
+                pod_vectors,
+                pod_counts,
                 fleet.capacity,
                 fleet.total,
                 fleet.prices,

@@ -98,20 +98,38 @@ def _compile_shapes(shapes: str) -> None:
         try:
             num_groups, num_types = (int(x) for x in token.split("x"))
             _timed_device_solve_ms(num_groups, num_types)
+            # The encoded-state fast path (models/cluster_state) dispatches
+            # device-resident pod tensors through the NON-donating fused
+            # kernel twin, which carries its own jit cache — compile it per
+            # rung too, or the first incremental solve at each bucket pays
+            # the XLA debt on a live batch.
+            _timed_device_solve_ms(num_groups, num_types, device_pods=True)
         except Exception:  # noqa: BLE001 — warmup must never kill boot
             log.warning("warmup shape %s failed", token, exc_info=True)
 
 
-def _timed_device_solve_ms(num_groups: int, num_types: int) -> float:
+def _timed_device_solve_ms(
+    num_groups: int, num_types: int, device_pods: bool = False
+) -> float:
     """Run one device solve at the given shape (compiling it if cold) and
     return its wall time — the warmup compile pass and the device-compute
     probe are the same call. Fetches through the COMPACTED helper so the
     timed number is the real pipeline's cost (eager payload only), not the
-    dense spill + LP assignment the hot path never transfers."""
+    dense spill + LP assignment the hot path never transfers.
+    device_pods=True feeds the pod tensors as bucket-padded device arrays,
+    routing through (and compiling) the non-donating kernel twin the
+    incremental-encode fast path uses."""
+    import jax
+
     from karpenter_tpu.models import solver as solver_models
+    from karpenter_tpu.ops.pack_kernel import bucket_size, pad_to
 
     vectors, counts, capacity = make_synthetic_problem(num_groups, num_types)
     prices = (0.1 * np.arange(1, num_types + 1, dtype=np.float32))
+    if device_pods:
+        bucket = bucket_size(num_groups)
+        vectors = jax.device_put(pad_to(vectors, bucket))
+        counts = jax.device_put(pad_to(counts, bucket))
     start = time.perf_counter()
     solver_models.fetch_plan(
         solver_models.cost_solve_dispatch(

@@ -78,6 +78,22 @@ class PodGroups:
         return int(self.counts.sum())
 
 
+_CPU_INDEX = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
+_MEM_INDEX = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_MEMORY]
+
+
+def group_sort_key(vector: np.ndarray):
+    """THE FFD group ordering (desc cpu, then desc memory, then the full
+    vector for determinism) — shared by group_pods and the incremental
+    encoder's sorted view (models/cluster_state.py) so the two paths produce
+    bit-identical group tensors."""
+    return (
+        -vector[_CPU_INDEX],
+        -vector[_MEM_INDEX],
+        tuple(-x for x in vector.tolist()),
+    )
+
+
 def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
     # One dict holding (vector, members) per distinct request shape: this
     # loop runs once per pod of a 50k batch, so it carries exactly one dict
@@ -98,16 +114,10 @@ def group_pods(pods: Sequence[PodSpec]) -> PodGroups:
             groups[cached[1]] = (cached[0], [pod])
         else:
             entry[1].append(pod)
-    cpu = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_CPU]
-    mem = wellknown.RESOURCE_DIM_INDEX[wellknown.RESOURCE_MEMORY]
-    # Desc by cpu, then memory, then the full vector for determinism.
+    # Desc by cpu, then memory, then the full vector for determinism
+    # (group_sort_key — shared with the incremental encoder).
     entries = sorted(
-        groups.values(),
-        key=lambda entry: (
-            -entry[0][cpu],
-            -entry[0][mem],
-            tuple(-x for x in entry[0].tolist()),
-        ),
+        groups.values(), key=lambda entry: group_sort_key(entry[0])
     )
     return PodGroups(
         vectors=np.stack([vec for vec, _ in entries])

@@ -1,0 +1,86 @@
+"""Masked scatter / gather kernels for the incremental cluster encode.
+
+The device-resident cluster tensors (models/cluster_state.py) are slot
+arrays: a row per pod-group or node, holes where slots were freed. Between
+sweeps the host accumulates which slots changed; a flush applies ALL of a
+sweep's churn in one jitted masked-scatter dispatch per array — O(delta)
+device work, never O(cluster). Compaction and the per-sweep sorted view are
+gathers over a host-computed permutation.
+
+Shape discipline: delta sizes and permutation lengths are bucketed to
+powers of two (ops.pack_kernel.bucket_size) so repeat flushes hit the jit
+cache; padding indices point one past the array (``mode="drop"`` scatters
+discard them, gather fills read back zeros), so padded lanes are inert.
+
+Donation: NONE of these kernels donates. The slot arrays are long-lived
+generations that lagging consumers may still hold a handle to (the epoch
+protocol detects staleness — it must be able to do so by *reading* the old
+generation, not by segfaulting on a donated buffer). The per-sweep sorted
+gather outputs are fresh temporaries and MAY be donated downstream by the
+solve kernels (models/solver), which is exactly where PR 6's donation rules
+put the boundary: donation lives only on top-level dispatch kernels, and
+incremental buffers are never what they donate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.ops.pack_kernel import bucket_size, pad_to
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def _scatter_rows(dst, idx, rows):
+    # Out-of-range padding indices are dropped, not clamped: a clamped index
+    # would silently overwrite the last live row.
+    return dst.at[idx].set(rows, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def _scatter_vals(dst, idx, vals):
+    return dst.at[idx].set(vals, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def _gather_rows(src, perm):
+    # Padding indices read back as zeros — a padded lane is an empty group
+    # (count 0) / an invalid node row, inert in every downstream kernel.
+    return jnp.take(src, perm, axis=0, mode="fill", fill_value=0)
+
+
+def pad_indices(idx: np.ndarray, sentinel: int, minimum: int = 8) -> np.ndarray:
+    """Bucket-pad an int32 index vector with an out-of-range sentinel so the
+    jitted scatters/gathers compile once per bucket, not once per delta
+    size."""
+    idx = np.asarray(idx, dtype=np.int32)  # vet: host-array(callers pass host-built delta indices)
+    return pad_to(idx, bucket_size(len(idx), minimum=minimum), value=sentinel)
+
+
+def scatter_rows(dst, idx: np.ndarray, rows: np.ndarray):
+    """dst[idx] = rows on device, O(len(idx)); idx pre-padded via
+    pad_indices, rows padded to match (padded rows are dropped)."""
+    rows = pad_to(np.asarray(rows), len(idx))  # vet: host-array(delta rows are host mirror copies)
+    return _scatter_rows(dst, idx, rows)
+
+
+def scatter_vals(dst, idx: np.ndarray, vals: np.ndarray):
+    vals = pad_to(np.asarray(vals), len(idx))  # vet: host-array(delta values are host mirror copies)
+    return _scatter_vals(dst, idx, vals)
+
+
+def gather_rows(src, perm: np.ndarray):
+    """src[perm] on device — the compaction / sorted-view gather. perm is
+    bucket-padded (pad_indices) with sentinel = src.shape[0]; padded rows
+    read back as zeros."""
+    return _gather_rows(src, perm)
+
+
+def device_slots(array: np.ndarray):
+    """Move a freshly (re)built slot mirror onto the device — one transfer,
+    used only on rebuild, compaction, and capacity growth (all epoch
+    bumps). Steady-state flushes go through the scatters above."""
+    return jax.device_put(array)

@@ -413,7 +413,19 @@ class Manager:
         self.options = options
         self.log = klog.named("manager")
         self.solver = make_solver(options.solver, options.solver_endpoint)
-        self.provisioning = ProvisioningController(cluster, cloud, self.solver)
+        # The incremental encoder: subscribes to the store's verb-level
+        # watch feed and keeps device-resident cluster tensors synced
+        # O(churn); provisioning, consolidation, and interruption all solve
+        # against it (docs/design/incremental-encode.md).
+        from karpenter_tpu.models.cluster_state import DeviceClusterState
+
+        self.cluster_state = DeviceClusterState(
+            cluster,
+            compaction_threshold=options.encode_compaction_threshold,
+        )
+        self.provisioning = ProvisioningController(
+            cluster, cloud, self.solver, cluster_state=self.cluster_state
+        )
         self.selection = SelectionController(cluster, self.provisioning)
         self.termination = TerminationController(cluster, cloud)
         self.node = NodeController(cluster)
@@ -427,6 +439,7 @@ class Manager:
             self.provisioning,
             self.termination,
             escalate_fraction=options.interruption_escalate_fraction,
+            cluster_state=self.cluster_state,
         )
         self.consolidation = ConsolidationController(
             cluster,
@@ -435,6 +448,7 @@ class Manager:
             self.termination,
             max_disruption=options.consolidation_max_disruption,
             cooldown_seconds=options.consolidation_cooldown,
+            cluster_state=self.cluster_state,
         )
         self.ready = threading.Event()
         # Set once the solver's compile debt is paid (immediately for host

@@ -76,6 +76,15 @@ CONSOLIDATION_SITES = (
     "consolidation.before-delete",
 )
 
+# Incremental-encode commit point (docs/design/incremental-encode.md):
+# - ``encode.mid-apply``  fires inside DeviceClusterState's two-phase pod
+#   sync, after the old contribution was removed and before the new one is
+#   added — a kill here leaves the host bookkeeping torn, which the state
+#   must detect (torn marker) and heal by rebuilding from the snapshot
+#   path; the battletest asserts the rebuilt tensors are bit-identical to a
+#   fresh snapshot encode.
+ENCODE_SITES = ("encode.mid-apply",)
+
 
 class SimulatedCrash(BaseException):
     """The controller process 'died' at a named site. BaseException so no

@@ -64,6 +64,13 @@ class Options:
     # Seconds of quiet after any interruption/termination activity before
     # consolidation acts again — the voluntary path yields to reclamation.
     consolidation_cooldown: float = 60.0
+    # Tombstone-density trigger for the incremental encoder's masked
+    # compaction (models/cluster_state.py): when freed-but-unreused slot
+    # rows exceed this fraction of the high-water mark, live rows are
+    # packed to the front and the device arrays re-uploaded (epoch bump).
+    # Lower = tighter arrays, more re-uploads; 1.0 effectively disables
+    # compaction. See docs/operations.md.
+    encode_compaction_threshold: float = 0.5
 
     def validate(self) -> None:
         errors: List[str] = []
@@ -83,6 +90,11 @@ class Options:
             errors.append(
                 "interruption-escalate-fraction must be in (0, 1], got "
                 f"{self.interruption_escalate_fraction}"
+            )
+        if not 0.0 < self.encode_compaction_threshold <= 1.0:
+            errors.append(
+                "encode-compaction-threshold must be in (0, 1], got "
+                f"{self.encode_compaction_threshold}"
             )
         if self.consolidation_max_disruption < 0:
             errors.append(
@@ -147,6 +159,10 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         "--consolidation-cooldown", type=float,
         default=float(_env("CONSOLIDATION_COOLDOWN", "60")),
     )
+    parser.add_argument(
+        "--encode-compaction-threshold", type=float,
+        default=float(_env("ENCODE_COMPACTION_THRESHOLD", "0.5")),
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -165,6 +181,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         interruption_escalate_fraction=args.interruption_escalate_fraction,
         consolidation_max_disruption=args.consolidation_max_disruption,
         consolidation_cooldown=args.consolidation_cooldown,
+        encode_compaction_threshold=args.encode_compaction_threshold,
     )
     options.validate()
     return options

@@ -298,4 +298,6 @@ class TestCrashpointFacility:
             )
         assert found == set(crashpoints.SITES) | set(
             crashpoints.INTERRUPTION_SITES
-        ) | set(crashpoints.CONSOLIDATION_SITES)
+        ) | set(crashpoints.CONSOLIDATION_SITES) | set(
+            crashpoints.ENCODE_SITES
+        )

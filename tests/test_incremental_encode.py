@@ -1,0 +1,415 @@
+"""Tests for the incremental encode layer (ISSUE 7).
+
+Covers the slot allocator (free-list reuse after delete), tombstone-
+threshold compaction (parity vs a full re-encode), the epoch-mismatch
+staleness protocol, the ``encode.mid-apply`` kill→restart battletest
+(rebuilt state bit-identical to the snapshot encode), the solver's
+encoded-state fast path (including that incremental device buffers are
+never donated), and the controller-facing per-node views.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Constraints, Provisioner
+from karpenter_tpu.api.validation import default_provisioner
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.models.cluster_state import (
+    DeviceClusterState,
+    DevicePodGroups,
+    StaleEncodingError,
+)
+from karpenter_tpu.models.solver import GreedySolver, Solver
+from karpenter_tpu.ops.encode import build_fleet, group_pods
+from karpenter_tpu.utils import crashpoints
+from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+
+def _pod(name, cpu="500m", memory="512Mi", **kwargs):
+    return PodSpec(
+        name=name,
+        requests={"cpu": cpu, "memory": memory},
+        unschedulable=True,
+        **kwargs,
+    )
+
+
+def _pending_snapshot(cluster):
+    return group_pods(
+        [p for p in cluster.list_pods() if p.is_provisionable()]
+    )
+
+
+def _assert_parity(state, cluster):
+    """Delta-maintained tensors must be BIT-IDENTICAL to the snapshot
+    encode, members equal as sets."""
+    got = state.pending_groups()
+    want = _pending_snapshot(cluster)
+    assert np.array_equal(got.vectors, want.vectors)
+    assert np.array_equal(got.counts, want.counts)
+    assert got.vectors.dtype == want.vectors.dtype
+    assert got.counts.dtype == want.counts.dtype
+    # Device copies decode to the same tensors (padding rows are zeros).
+    dev_vec = np.asarray(got.device_vectors)[: got.num_groups]
+    dev_cnt = np.asarray(got.device_counts)[: got.num_groups]
+    assert np.array_equal(dev_vec, want.vectors)
+    assert np.array_equal(dev_cnt, want.counts)
+    for g in range(got.num_groups):
+        assert {p.uid for p in got.members[g]} == {
+            p.uid for p in want.members[g]
+        }
+    return got
+
+
+class TestSlotAllocator:
+    def test_free_list_reuse_after_delete(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        a = [_pod(f"a{i}", cpu="250m") for i in range(3)]
+        b = [_pod(f"b{i}", cpu="750m") for i in range(3)]
+        for p in a + b:
+            cluster.apply_pod(p)
+        state.flush()
+        with state._lock:
+            high_before = state._group_high
+        # Kill every pod of one shape: its slot is freed...
+        for p in b:
+            cluster.delete_pod(p.namespace, p.name)
+        with state._lock:
+            assert len(state._group_free) == 1
+            freed = state._group_free[0]
+            assert not state._group_live[freed]
+        # ...and a NEW distinct shape reuses it instead of growing.
+        cluster.apply_pod(_pod("c0", cpu="1250m"))
+        with state._lock:
+            assert state._group_free == []
+            assert state._group_live[freed]
+            assert state._group_high == high_before
+        _assert_parity(state, cluster)
+
+    def test_node_slot_free_list(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        for i in range(3):
+            cluster.create_node(
+                NodeSpec(name=f"n{i}", capacity={"cpu": 8.0, "memory": 8192.0})
+            )
+        cluster.delete_node("n1")  # no finalizers: removed outright
+        with state._lock:
+            assert len(state._node_free) == 1
+        cluster.create_node(
+            NodeSpec(name="n9", capacity={"cpu": 4.0, "memory": 4096.0})
+        )
+        with state._lock:
+            assert state._node_free == []
+            assert state._node_high == 3
+
+    def test_pod_reapply_with_changed_requests_moves_groups(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        pod = _pod("p0", cpu="250m")
+        cluster.apply_pod(pod)
+        state.flush()
+        changed = _pod("p0", cpu="1000m")
+        changed.uid = pod.uid
+        cluster.apply_pod(changed)
+        got = _assert_parity(state, cluster)
+        assert got.num_pods == 1
+
+
+class TestCompaction:
+    def _churn(self, cluster, state, shapes=24, keep=4):
+        pods = {}
+        for i in range(shapes):
+            p = _pod(f"s{i}", cpu=f"{250 * (i + 1)}m")
+            pods[i] = p
+            cluster.apply_pod(p)
+        state.flush()
+        for i in range(shapes):
+            if i >= keep:
+                cluster.delete_pod(pods[i].namespace, pods[i].name)
+        return pods
+
+    def test_threshold_compaction_parity_vs_full_reencode(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster, compaction_threshold=0.5)
+        self._churn(cluster, state)
+        with state._lock:
+            density = state._density_locked(state._group_high, state._group_live)
+        assert density >= 0.5
+        epoch_before = state.epoch
+        got = _assert_parity(state, cluster)  # flush -> compaction -> parity
+        assert state.compaction_count >= 1
+        assert state.epoch > epoch_before
+        assert got.num_groups == 4
+        with state._lock:
+            assert state._group_high == 4
+            assert state._group_free == []
+        # And the compacted state keeps absorbing deltas correctly.
+        cluster.apply_pod(_pod("post", cpu="9000m"))
+        _assert_parity(state, cluster)
+
+    def test_threshold_one_disables_compaction(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster, compaction_threshold=1.0)
+        self._churn(cluster, state)
+        _assert_parity(state, cluster)
+        assert state.compaction_count == 0
+
+    def test_tombstone_density_reported(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster, compaction_threshold=1.0)
+        self._churn(cluster, state, shapes=20, keep=10)
+        state.flush()
+        group_density, _ = state.tombstone_density()
+        assert group_density == pytest.approx(0.5)
+
+
+class TestEpochProtocol:
+    def test_epoch_mismatch_detected_and_rebuilt(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster, compaction_threshold=0.5)
+        for i in range(24):
+            cluster.apply_pod(_pod(f"s{i}", cpu=f"{250 * (i + 1)}m"))
+        handle = state.pending_groups()
+        assert state.is_current(handle)
+        # Churn past the tombstone threshold: the next flush compacts and
+        # the old handle's epoch is superseded.
+        for i in range(4, 24):
+            cluster.delete_pod("default", f"s{i}")
+        fresh = state.pending_groups()
+        assert state.compaction_count >= 1
+        assert not state.is_current(handle)
+        with pytest.raises(StaleEncodingError):
+            state.assert_current(handle)
+        # The lagging consumer re-encodes; snapshot path agrees.
+        assert state.is_current(fresh) or state.pending_groups() is not None
+        _assert_parity(state, cluster)
+
+    def test_generation_advances_per_flush(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        cluster.apply_pod(_pod("p0"))
+        g1 = state.pending_groups()
+        cluster.apply_pod(_pod("p1"))
+        g2 = state.pending_groups()
+        assert g2.generation > g1.generation
+        assert not state.is_current(g1)
+        assert state.is_current(g2)
+
+
+class TestMidApplyBattletest:
+    """Kill the sync at encode.mid-apply → the torn state detects itself and
+    rebuilds from the snapshot path; a 'restarted' state (fresh object over
+    the surviving cluster) is bit-identical to the snapshot encode."""
+
+    def _crashed_cluster(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        for i in range(10):
+            cluster.apply_pod(_pod(f"p{i}", cpu=f"{250 * (i % 3 + 1)}m"))
+        state.flush()
+        crashpoints.arm("encode.mid-apply")
+        with pytest.raises(SimulatedCrash):
+            cluster.apply_pod(_pod("victim", cpu="2000m"))
+        return cluster, state
+
+    def test_torn_state_self_heals_via_snapshot_rebuild(self):
+        cluster, state = self._crashed_cluster()
+        with state._lock:
+            assert state._torn is not None
+        rebuilds_before = state.rebuild_count
+        _assert_parity(state, cluster)  # flush rebuilds, then parity holds
+        assert state.rebuild_count == rebuilds_before + 1
+        with state._lock:
+            assert state._torn is None
+
+    def test_restart_rebuilds_bit_identical_to_snapshot(self):
+        cluster, _dead = self._crashed_cluster()
+        # "Restart": a fresh controller process builds a fresh state over
+        # the surviving store — exactly the snapshot path.
+        reborn = DeviceClusterState(cluster)
+        _assert_parity(reborn, cluster)
+        assert reborn.rebuild_count == 1
+
+    def test_store_survives_the_crash(self):
+        cluster, _state = self._crashed_cluster()
+        # The crash punched through the watch callback, but the STORE had
+        # already committed the write — the pod is durably there (the same
+        # guarantee a real apiserver write gives a crashing controller).
+        assert cluster.try_get_pod("default", "victim") is not None
+
+
+class TestSolverFastPath:
+    def _encoded(self, num_pods=30):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        cloud = FakeCloudProvider()
+        for i in range(num_pods):
+            cluster.apply_pod(_pod(f"p{i}", cpu=f"{250 * (i % 4 + 1)}m"))
+        pods = [p for p in cluster.list_pods() if p.is_provisionable()]
+        constraints = Constraints()
+        types = cloud.get_instance_types(constraints)
+        encoded = state.encode_schedule(pods, types, constraints, [])
+        return cluster, state, pods, types, constraints, encoded
+
+    def test_encode_schedule_covers_exact_batch(self):
+        _, _, _, _, _, encoded = self._encoded()
+        assert encoded is not None
+        groups, fleet = encoded
+        assert isinstance(groups, DevicePodGroups)
+        assert fleet.num_types > 0
+
+    def test_encode_schedule_rejects_partial_batch(self):
+        cluster, state, pods, types, constraints, _ = self._encoded()
+        assert (
+            state.encode_schedule(pods[:-1], types, constraints, []) is None
+        )
+        foreign = _pod("foreign")
+        assert (
+            state.encode_schedule(pods[:-1] + [foreign], types, constraints, [])
+            is None
+        )
+
+    def test_encode_problems_passes_encoded_pair_through(self):
+        _, _, pods, types, constraints, encoded = self._encoded()
+        out = Solver._encode_problems([encoded, (pods, types, constraints, [])])
+        assert out[0][0] is encoded[0]
+        assert out[0][1] is encoded[1]
+        # The snapshot-encoded twin produces identical tensors.
+        assert np.array_equal(out[0][0].vectors, out[1][0].vectors)
+        assert np.array_equal(out[0][0].counts, out[1][0].counts)
+
+    def test_solve_over_encoded_state_matches_snapshot_solve(self):
+        cluster, state, pods, types, constraints, encoded = self._encoded()
+        groups, fleet = encoded
+        snap_groups = group_pods(pods)
+        snap_fleet = build_fleet(
+            types, constraints, pods, pods_need=snap_groups.vectors.max(axis=0)
+        )
+        solver = GreedySolver()
+        ours = solver.solve_encoded(groups, fleet)
+        want = solver.solve_encoded(snap_groups, snap_fleet)
+        assert ours.node_count == want.node_count
+        assert len(ours.unschedulable) == len(want.unschedulable)
+
+    def test_device_buffers_survive_a_solve(self):
+        """Incremental tensors are never donated: the handle stays readable
+        (and re-solvable) after a cost solve dispatched its device arrays."""
+        pytest.importorskip("jax")
+        from karpenter_tpu.models import solver as solver_mod
+
+        cluster, state, pods, types, constraints, encoded = self._encoded()
+        groups, fleet = encoded
+        handle = solver_mod.cost_solve_dispatch(
+            groups.device_vectors,
+            groups.device_counts,
+            fleet.capacity,
+            fleet.total,
+            fleet.prices,
+            lp_steps=10,
+            count=False,
+        )
+        solver_mod.fetch_plan(handle)
+        # Both device arrays are still alive and bit-identical to the host
+        # mirrors — a donating dispatch would have invalidated them.
+        padded = np.asarray(groups.device_vectors)[: groups.num_groups]
+        assert np.array_equal(padded, groups.vectors)
+        again = solver_mod.cost_solve_dispatch(
+            groups.device_vectors,
+            groups.device_counts,
+            fleet.capacity,
+            fleet.total,
+            fleet.prices,
+            lp_steps=10,
+            count=False,
+        )
+        solver_mod.fetch_plan(again)
+
+    def test_fleet_cache_hits_and_invalidates(self):
+        cluster, state, pods, types, constraints, encoded = self._encoded()
+        need = encoded[0].vectors.max(axis=0)
+        first = state.encode_fleet(types, constraints, [], need)
+        assert state.encode_fleet(types, constraints, [], need) is first
+        # Any catalog content drift (here: a price move) misses the cache.
+        import dataclasses
+
+        types[0].offerings[0] = dataclasses.replace(
+            types[0].offerings[0], price=types[0].offerings[0].price + 0.01
+        )
+        assert state.encode_fleet(types, constraints, [], need) is not first
+
+
+class TestNodeViews:
+    def test_pods_on_node_and_used_track_bind_unbind(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        node = NodeSpec(name="n1", capacity={"cpu": 64.0, "memory": 65536.0})
+        cluster.create_node(node)
+        pods = [_pod(f"p{i}", cpu="500m", memory="256Mi") for i in range(4)]
+        for p in pods:
+            cluster.apply_pod(p)
+            cluster.bind_pod(p, node)
+        assert len(state.pods_on_node("n1")) == 4
+        used = state.node_used("n1")
+        expect = sum(
+            (p.dense_vector[0] for p in pods), np.zeros_like(used)
+        ).astype(np.float64)
+        assert np.array_equal(used, expect)
+        # Displacement (interruption/consolidation drain) moves the pod
+        # back to pending AND out of the node's used vector.
+        cluster.reschedule_pod(pods[0].namespace, pods[0].name, override_pdb=True)
+        assert len(state.pods_on_node("n1")) == 3
+        assert state.pending_count() == 1
+        # Terminal pods stay listed (parity with list_pods) but stop
+        # counting toward used.
+        pods[1].phase = "Succeeded"
+        cluster.apply_pod(pods[1])
+        assert len(state.pods_on_node("n1")) == 3
+        used = state.node_used("n1")
+        assert used is not None and used[0] == pytest.approx(1000.0)
+
+    def test_views_match_cluster_listing(self):
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        node = NodeSpec(name="n1", capacity={"cpu": 8.0, "memory": 8192.0})
+        cluster.create_node(node)
+        p = _pod("p0")
+        cluster.apply_pod(p)
+        cluster.bind_pod(p, node)
+        assert {q.uid for q in state.pods_on_node("n1")} == {
+            q.uid for q in cluster.list_pods(node_name="n1")
+        }
+
+
+class TestRuntimeWiring:
+    def test_manager_constructs_and_propagates_state(self):
+        from karpenter_tpu.runtime import Manager
+        from karpenter_tpu.utils.options import Options
+
+        cluster = Cluster()
+        cloud = FakeCloudProvider()
+        options = Options(cluster_name="t", solver="greedy")
+        manager = Manager(cluster, cloud, options)
+        assert manager.cluster_state is not None
+        assert manager.consolidation.cluster_state is manager.cluster_state
+        assert manager.interruption.cluster_state is manager.cluster_state
+        assert manager.provisioning.cluster_state is manager.cluster_state
+        provisioner = Provisioner(name="default")
+        default_provisioner(provisioner)
+        cluster.apply_provisioner(provisioner)
+        manager.provisioning.apply(provisioner)
+        worker = manager.provisioning.worker("default")
+        assert worker.cluster_state is manager.cluster_state
+
+    def test_rebuild_reasons_counted(self):
+        from karpenter_tpu.models.cluster_state import ENCODE_REBUILDS_TOTAL
+
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        before = ENCODE_REBUILDS_TOTAL.get("initial")
+        state.flush()
+        assert ENCODE_REBUILDS_TOTAL.get("initial") == before + 1

@@ -85,6 +85,51 @@ CASES = [
         """,
     ),
     (
+        # Watch-callback dispatch under the store lock: the Cluster's
+        # notify-outside-the-lock invariant, pinned by the checker rather
+        # than by convention (ISSUE 7 satellite).
+        "blocking-under-lock",
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._watchers = []
+
+            def _notify(self, obj):
+                for callback in list(self._watchers):
+                    callback(obj)
+
+            def apply(self, obj):
+                with self._lock:
+                    self._store = obj
+                    self._notify(obj)
+        """,
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._watchers = []
+
+            def _notify(self, obj):
+                for callback in list(self._watchers):
+                    callback(obj)
+
+            def apply(self, obj):
+                with self._lock:
+                    self._store = obj
+                self._notify(obj)
+
+            def wake(self):
+                with self._lock:
+                    self._cv.notify_all()
+        """,
+    ),
+    (
         "crash-safety",
         """
         from karpenter_tpu.utils.crashpoints import crashpoint

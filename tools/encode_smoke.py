@@ -1,0 +1,246 @@
+"""encode-smoke: the incremental-encode parity + O(delta) budget guard.
+
+A churn loop over the incremental encoder (models/cluster_state) asserting,
+cheap enough for every `make smoke`:
+
+1. **Delta-vs-snapshot parity every N events.** After every parity window
+   the delta-maintained group tensors (host AND device copies) must be
+   BIT-IDENTICAL to a fresh ``group_pods`` snapshot encode, and the
+   per-node views must match ``cluster.list_pods(node_name=...)``.
+
+2. **The O(delta) timing budget.** The steady-state per-sweep encode
+   (flush + sorted view) must beat the full snapshot encode of the same
+   backlog by a wide margin — relative, so CI box speed can't flake it —
+   plus a generous absolute ceiling that catches an accidental O(cluster)
+   regression outright.
+
+3. **Compaction + crash convergence.** A churn-down past the tombstone
+   threshold must compact (epoch bump) and keep parity, and a kill at
+   ``encode.mid-apply`` must leave a state that detects the tear and
+   rebuilds bit-identical from the snapshot path.
+
+Run: timeout -k 10 120 python tools/encode_smoke.py   (or `make encode-smoke`)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_PODS = 8_000
+SWEEPS = 30
+CHURN = 80  # events per sweep (half delete, half apply)
+PARITY_EVERY = 5  # sweeps between full parity audits
+# delta p50 * RELATIVE_MARGIN must stay under one full snapshot encode of
+# the same backlog; the absolute ceiling is the tripwire for an O(cluster)
+# regression that a slow snapshot would otherwise mask.
+RELATIVE_MARGIN = 4.0
+ABSOLUTE_CEILING_MS = 25.0
+
+
+class _Harness:
+    """The smoke's cluster + state + pod ledger."""
+
+    def __init__(self):
+        from karpenter_tpu.controllers.cluster import Cluster
+        from karpenter_tpu.models.cluster_state import DeviceClusterState
+
+        self.cluster = Cluster()
+        self.state = DeviceClusterState(self.cluster)
+        self.live = []
+        self._seq = 0
+
+    def add_pod(self, shape_index):
+        from karpenter_tpu.api.pods import PodSpec
+
+        pod = PodSpec(
+            name=f"e{self._seq}",
+            requests={
+                "cpu": f"{250 * (shape_index % 12 + 1)}m",
+                "memory": f"{256 * (shape_index % 7 + 1)}Mi",
+            },
+            unschedulable=True,
+        )
+        self._seq += 1
+        self.cluster.apply_pod(pod)
+        self.live.append(pod)
+        return pod
+
+    def delete_oldest(self, count):
+        for pod in self.live[:count]:
+            self.cluster.delete_pod(pod.namespace, pod.name)
+        del self.live[:count]
+
+    def assert_parity(self, where):
+        import numpy as np
+
+        from karpenter_tpu.ops.encode import group_pods
+
+        got = self.state.pending_groups()
+        want = group_pods(
+            [p for p in self.cluster.list_pods() if p.is_provisionable()]
+        )
+        assert np.array_equal(got.vectors, want.vectors), where
+        assert np.array_equal(got.counts, want.counts), where
+        dev = np.asarray(got.device_vectors)[: got.num_groups]
+        assert np.array_equal(dev, want.vectors), f"{where}: device copy"
+        cnt = np.asarray(got.device_counts)[: got.num_groups]
+        assert np.array_equal(cnt, want.counts), f"{where}: device counts"
+
+    def snapshot_encode_ms(self, reps=3):
+        import numpy as np
+
+        from karpenter_tpu.ops.encode import group_pods
+
+        samples = []
+        for _ in range(reps):
+            start = time.perf_counter()
+            group_pods(
+                [p for p in self.cluster.list_pods() if p.is_provisionable()]
+            )
+            samples.append((time.perf_counter() - start) * 1e3)
+        return float(np.median(samples))
+
+
+def _churn_loop(harness):
+    """Timed steady-state sweeps; returns the delta p50 in ms."""
+    import numpy as np
+
+    delta_samples = []
+    for sweep in range(SWEEPS):
+        harness.delete_oldest(CHURN // 2)
+        for _ in range(CHURN - CHURN // 2):
+            harness.add_pod(len(harness.live))
+        start = time.perf_counter()
+        harness.state.pending_groups()
+        delta_samples.append((time.perf_counter() - start) * 1e3)
+        if (sweep + 1) % PARITY_EVERY == 0:
+            harness.assert_parity(f"sweep {sweep + 1}")
+    return float(np.median(delta_samples))
+
+
+def _assert_budget(delta_ms, snapshot_ms):
+    print(
+        f"churn loop: {SWEEPS * CHURN} events / {SWEEPS} sweeps, delta p50 "
+        f"{delta_ms:.3f}ms vs snapshot {snapshot_ms:.3f}ms "
+        f"({snapshot_ms / max(delta_ms, 1e-9):.1f}x)"
+    )
+    assert delta_ms * RELATIVE_MARGIN < snapshot_ms, (
+        f"O(delta) budget blown: delta p50 {delta_ms:.3f}ms x "
+        f"{RELATIVE_MARGIN} >= snapshot {snapshot_ms:.3f}ms — per-sweep "
+        f"encode is scaling with the cluster again"
+    )
+    assert delta_ms < ABSOLUTE_CEILING_MS, (
+        f"delta p50 {delta_ms:.3f}ms exceeds the {ABSOLUTE_CEILING_MS}ms "
+        f"absolute ceiling"
+    )
+
+
+def _check_node_views(harness):
+    """Binds tracked exactly: pods_on_node / node_used vs the store walk."""
+    import numpy as np
+
+    from karpenter_tpu.cloudprovider import NodeSpec
+
+    node = NodeSpec(name="smoke-n1", capacity={"cpu": 64.0, "memory": 65536.0})
+    harness.cluster.create_node(node)
+    for pod in harness.live[:50]:
+        harness.cluster.bind_pod(pod, node)
+    listed = harness.cluster.list_pods(node_name="smoke-n1")
+    assert {p.uid for p in harness.state.pods_on_node("smoke-n1")} == {
+        p.uid for p in listed
+    }
+    used = harness.state.node_used("smoke-n1")
+    expect = np.zeros_like(used)
+    for pod in listed:
+        expect += pod.dense_vector[0].astype(np.float64)
+    assert np.array_equal(used, expect), "node_used diverged from pod walk"
+    harness.assert_parity("post-bind")
+
+
+def _check_compaction(harness):
+    """Kill WHOLE shapes so their slots actually free (tombstones), then
+    assert the threshold compaction ran (epoch bump) and parity held."""
+    keep_shapes = set(list({p.dense_vector[1] for p in harness.live})[:6])
+    epoch_before = harness.state.epoch
+    for pod in [p for p in harness.live if p.dense_vector[1] not in keep_shapes]:
+        harness.cluster.delete_pod(pod.namespace, pod.name)
+    harness.live = [p for p in harness.live if p.dense_vector[1] in keep_shapes]
+    harness.state.pending_groups()
+    print(
+        f"churn-down: epoch {epoch_before}->{harness.state.epoch}, "
+        f"compactions {harness.state.compaction_count}, "
+        f"shapes left {len(keep_shapes)}"
+    )
+    assert harness.state.compaction_count >= 1, (
+        "tombstone density crossed the threshold but no compaction ran"
+    )
+    assert harness.state.epoch > epoch_before, "compaction must bump the epoch"
+    harness.assert_parity("post-churn-down")
+
+
+def _check_crash_convergence(harness):
+    """Kill at encode.mid-apply: the torn state detects itself and rebuilds
+    bit-identical; a fresh state over the surviving store does too."""
+    import numpy as np
+
+    from karpenter_tpu.models.cluster_state import DeviceClusterState
+    from karpenter_tpu.utils import crashpoints
+
+    state = harness.state
+    rebuilds_before = state.rebuild_count
+    crashpoints.arm("encode.mid-apply")
+    crashed = False
+    try:
+        harness.add_pod(7)
+    except crashpoints.SimulatedCrash:
+        # The store committed the pod before the sync tore — exactly the
+        # surviving state a restarted controller would observe.
+        crashed = True
+    crashpoints.disarm_all()
+    assert crashed, "armed encode.mid-apply never fired"
+    harness.assert_parity("post-crash self-heal")
+    assert state.rebuild_count == rebuilds_before + 1, (
+        "torn state did not rebuild from the snapshot path"
+    )
+    restarted = DeviceClusterState(harness.cluster, subscribe=False)
+    got = restarted.pending_groups()
+    want = state.pending_groups()
+    assert np.array_equal(got.vectors, want.vectors)
+    assert np.array_equal(got.counts, want.counts)
+    print(
+        f"crash convergence OK (rebuilds {state.rebuild_count}); "
+        f"encode-smoke PASS"
+    )
+
+
+def main() -> int:
+    from karpenter_tpu.utils import backend_health
+
+    backend_health.pin_cpu()  # CPU backend by design — no probe needed
+
+    from karpenter_tpu.ops.pack_kernel import suppress_donation_advisory
+
+    suppress_donation_advisory()
+
+    harness = _Harness()
+    for i in range(NUM_PODS):
+        harness.add_pod(i)
+    # Warm: initial rebuild + one churn sweep compiles the scatter buckets.
+    harness.state.pending_groups()
+    harness.add_pod(0)
+    harness.state.pending_groups()
+    harness.assert_parity("warm")
+
+    snapshot_ms = harness.snapshot_encode_ms()
+    delta_ms = _churn_loop(harness)
+    _assert_budget(delta_ms, snapshot_ms)
+    _check_node_views(harness)
+    _check_compaction(harness)
+    _check_crash_convergence(harness)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

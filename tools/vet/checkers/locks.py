@@ -12,11 +12,14 @@ lock-discipline
     waiver is the documentation.
 
 blocking-under-lock
-    No ``with <lock>:`` body may call sleep, subprocess, socket/HTTP, or
-    JAX dispatch: a convoy on a hot-path lock is this runtime's analogue of
-    holding a mutex across cgo. Lock expressions are recognized by their
-    terminal name (``_lock``, ``_rv_lock``, ``_cv``, ...); ``cv.wait`` is
-    exempt — releasing the lock is what a condition variable is for.
+    No ``with <lock>:`` body may call sleep, subprocess, socket/HTTP, JAX
+    dispatch, or watch-callback fan-out (``*._notify``): a convoy on a
+    hot-path lock is this runtime's analogue of holding a mutex across
+    cgo, and callback dispatch under the store lock additionally invites
+    lock-order inversions against consumer locks. Lock expressions are
+    recognized by their terminal name (``_lock``, ``_rv_lock``, ``_cv``,
+    ...); ``cv.wait`` is exempt — releasing the lock is what a condition
+    variable is for.
 """
 
 from __future__ import annotations
@@ -53,6 +56,15 @@ BLOCKING_PREFIXES = (
 )
 BLOCKING_ATTRS = {"sleep", "urlopen", "block_until_ready", "check_output", "check_call"}
 BLOCKING_NAMES = {"sleep", "urlopen"}
+# Watch-callback dispatch: Cluster._notify fans out to arbitrary consumer
+# callbacks (reconcile enqueues, the incremental-encode sync), each taking
+# its own locks — firing it under the store lock convoys every verb behind
+# the slowest consumer and invites lock-order inversions. The store's
+# notify-outside-the-lock invariant is pinned HERE rather than by
+# convention. (cv.notify/notify_all are NOT in this set — waking a
+# condition's waiters under its lock is what conditions are for; the
+# `_notify_locked` helpers keep that spelling.)
+DISPATCH_ATTRS = {"_notify"}
 
 # file or file::qualname prefix -> justification (shared by both checkers).
 ALLOWED: dict = {
@@ -312,7 +324,9 @@ def _blocking_callee(call: ast.Call):
                 return dotted
         if dotted in BLOCKING_NAMES:
             return dotted
-    if isinstance(call.func, ast.Attribute) and call.func.attr in BLOCKING_ATTRS:
+    if isinstance(call.func, ast.Attribute) and call.func.attr in (
+        BLOCKING_ATTRS | DISPATCH_ATTRS
+    ):
         return dotted or f"<expr>.{call.func.attr}"
     return None
 
