@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -83,6 +83,19 @@ fetch-smoke:
 encode-smoke:
 	timeout -k 10 120 python tools/encode_smoke.py
 
+# The chaos capstone (tools/chaos_smoke.py): a sustained API fault storm
+# (>=10% injected faults across every verb + watch tears/duplicates/
+# reorders/drop-410s through ChaosTransport) racing a 6-node spot-
+# interruption storm over the REAL threaded Manager, with the controller
+# process killed at rotating crashpoints and rebuilt mid-storm. Asserts
+# convergence, every pod bound to a live node, zero PDB violations
+# (server-side watch oracle), zero leaked instances after the GC grace, no
+# dead sweep threads, and informer-cache + DeviceClusterState coherence.
+# Hard 180s timeout: a retry path that re-grows an unbounded wait fails
+# fast instead of wedging a driver run.
+chaos-smoke:
+	timeout -k 10 180 python tools/chaos_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -93,6 +106,7 @@ smoke:
 	$(MAKE) consolidation-smoke || rc=1; \
 	$(MAKE) fetch-smoke || rc=1; \
 	$(MAKE) encode-smoke || rc=1; \
+	$(MAKE) chaos-smoke || rc=1; \
 	exit $$rc
 
 proto:

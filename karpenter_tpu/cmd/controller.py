@@ -26,14 +26,22 @@ def build_cluster(options) -> Cluster:
     """Select the cluster-store backend (ref: cmd/controller/main.go:61-99 —
     the reference always reconciles a live apiserver; --cluster-store wires
     the same here, with the in-memory store for standalone/dev runs)."""
-    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient, RetryPolicy
     from karpenter_tpu.kubeapi.client import HttpTransport
 
     transport = HttpTransport.for_store(options.cluster_store)
     if transport is None:
         return Cluster()
+    transport.watch_idle_s = options.kube_watch_idle_timeout
     client = KubeClient(
-        transport, qps=options.kube_client_qps, burst=options.kube_client_burst
+        transport,
+        qps=options.kube_client_qps,
+        burst=options.kube_client_burst,
+        retry=RetryPolicy(
+            max_attempts=options.kube_retry_max_attempts,
+            backoff_base_s=options.kube_retry_backoff_base,
+            backoff_cap_s=options.kube_retry_backoff_cap,
+        ),
     )
     return ApiServerCluster(client).start()
 

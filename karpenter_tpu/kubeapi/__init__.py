@@ -11,7 +11,20 @@ stays the envtest analogue for tests; production selects the backend with
 --kube-api-server (cmd/controller.py).
 """
 
-from karpenter_tpu.kubeapi.client import ApiError, KubeClient, Transport
+from karpenter_tpu.kubeapi.client import (
+    ApiError,
+    KubeClient,
+    RetryPolicy,
+    Transport,
+    TransportError,
+)
 from karpenter_tpu.kubeapi.cluster import ApiServerCluster
 
-__all__ = ["ApiError", "ApiServerCluster", "KubeClient", "Transport"]
+__all__ = [
+    "ApiError",
+    "ApiServerCluster",
+    "KubeClient",
+    "RetryPolicy",
+    "Transport",
+    "TransportError",
+]

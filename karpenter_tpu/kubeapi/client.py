@@ -8,40 +8,126 @@ over a pluggable Transport:
   and the cluster CA (in-cluster serviceaccount files by default).
 - tests inject a direct-call transport into the fake apiserver (no sockets),
   and exercise the HTTP path separately.
+- `ChaosTransport` (kubeapi/chaos.py) wraps either and injects faults at
+  named faultpoint sites — the substrate `make chaos-smoke` storms with.
 
 Only the verbs the controllers use exist: get/list/create/update/patch/
 delete, the binding and eviction subresources, and line-delimited watch
 streams.
+
+Every request crosses ONE retry envelope (`KubeClient._request_enveloped`,
+pinned by the vet transport-discipline checker): per-verb deadlines, capped
+exponential backoff with jitter through the Clock abstraction, Retry-After
+honored on 429. Idempotency rationale per verb (docs/design/chaos.md):
+
+- GET/LIST/DELETE/PATCH/PUT are retried freely — re-executing any of them
+  converges (DELETE answers 404, PATCH re-merges, PUT either lands or
+  answers a 409 CAS conflict the caller already handles).
+- POST (create/binding/eviction) is retried too, but its safety leans on
+  the strict-409 semantics the write paths already carry: a retried create
+  whose first attempt committed answers 409, which callers treat as
+  already-exists (node adoption, _create_or_update's GET+PUT, bind_pod's
+  bound-to-whom check) — nothing double-creates.
+
+Network faults surface as a typed `TransportError` (retryable) instead of
+raw urllib/socket exceptions, so callers — and the envelope — can tell a
+connection reset from an apiserver verdict (`ApiError`).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import random
 import ssl
 import threading
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Callable, Iterator, Optional, Tuple
+from typing import Callable, Dict, Iterator, Optional, Tuple
 
+from karpenter_tpu.utils.backoff import capped_backoff_s
 from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
+from karpenter_tpu.utils.metrics import REGISTRY
 
 SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# Control-plane client health: a rising retry rate is the first symptom of
+# apiserver degradation (docs/operations.md, "API degradation" runbook).
+KUBE_API_RETRY_TOTAL = REGISTRY.counter(
+    "kube_api_retry_total",
+    "Kube API request retries by verb and fault reason",
+    ["verb", "reason"],
+)
+KUBE_API_REQUEST_DURATION = REGISTRY.histogram(
+    "kube_api_request_duration_seconds",
+    "Kube API request latency per attempt (failed attempts included)",
+    ["verb"],
+)
+
 
 class ApiError(Exception):
+    """The apiserver answered with a non-2xx verdict."""
+
     def __init__(self, status: int, message: str = ""):
         super().__init__(f"apiserver {status}: {message}")
         self.status = status
         self.message = message
 
 
+class TransportError(Exception):
+    """A network-layer fault: the request may or may not have reached the
+    server (a timeout can follow a committed write). `retryable` says the
+    fault is transient; `reason` labels the retry metric
+    (timeout | reset | network | idle-timeout)."""
+
+    def __init__(self, message: str, retryable: bool = True, reason: str = "network"):
+        super().__init__(message)
+        self.retryable = retryable
+        self.reason = reason
+
+
+def _as_transport_error(error: Exception) -> TransportError:
+    """Classify a raw urllib/socket/http.client fault. URLError wraps its
+    cause in .reason; unwrap so a connection reset inside a URLError still
+    labels as a reset."""
+    cause = error
+    if isinstance(error, urllib.error.URLError) and isinstance(
+        error.reason, Exception
+    ):
+        cause = error.reason
+    if isinstance(cause, TimeoutError):  # socket.timeout is an alias
+        reason = "timeout"
+    elif isinstance(cause, (ConnectionResetError, ConnectionAbortedError,
+                            BrokenPipeError, http.client.RemoteDisconnected)):
+        reason = "reset"
+    else:
+        reason = "network"
+    return TransportError(f"{type(cause).__name__}: {cause}", reason=reason)
+
+
+def _status_code(obj: dict) -> int:
+    """The integer .code of an in-band Status object, 0 when unparsable."""
+    try:
+        return int(obj.get("code", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 class Transport:
     """request() returns (status, parsed-JSON body); stream() yields parsed
-    JSON objects from a line-delimited watch response until closed."""
+    JSON objects from a line-delimited watch response until closed.
+    Network-layer faults raise TransportError; HTTP-layer error Statuses on
+    a stream open raise ApiError. `timeout_s` is the per-request deadline
+    the retry envelope selects per verb (socketless transports ignore it)."""
 
     def request(
-        self, method: str, path: str, query: str = "", body: Optional[dict] = None
+        self,
+        method: str,
+        path: str,
+        query: str = "",
+        body: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
     ) -> Tuple[int, dict]:
         raise NotImplementedError
 
@@ -60,10 +146,19 @@ class HttpTransport(Transport):
         ca_file: Optional[str] = None,
         insecure: bool = False,
         timeout_s: float = 30.0,
+        watch_idle_s: float = 300.0,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout_s = timeout_s
+        # Watch read-deadline: an apiserver that stops sending bytes without
+        # closing the connection would otherwise hang the watch pump forever
+        # (the stream used to open with timeout=None). Each blocking read is
+        # bounded by this; a quiet-too-long stream tears with a retryable
+        # idle-timeout TransportError and the pump reconnects from its last
+        # rv. Must exceed the server's bookmark cadence (~1/min) by a wide
+        # margin so healthy-idle watches don't churn.
+        self.watch_idle_s = watch_idle_s
         if insecure:
             self.ssl_context: Optional[ssl.SSLContext] = ssl._create_unverified_context()
         elif ca_file:
@@ -117,35 +212,80 @@ class HttpTransport(Transport):
             request, timeout=timeout, context=self.ssl_context
         )
 
-    def request(self, method, path, query="", body=None):
+    def request(self, method, path, query="", body=None, timeout_s=None):
         url = self.base_url + path + (f"?{query}" if query else "")
         try:
-            with self._request(method, url, body, self.timeout_s) as response:
+            with self._request(
+                method, url, body, timeout_s or self.timeout_s
+            ) as response:
                 payload = response.read()
                 return response.status, json.loads(payload) if payload else {}
         except urllib.error.HTTPError as error:
             detail = error.read().decode(errors="replace")
             try:
-                return error.code, json.loads(detail)
+                parsed = json.loads(detail)
             except (ValueError, json.JSONDecodeError):
-                return error.code, {"message": detail}
+                parsed = {"message": detail}
+            # Surface the throttle header where the apiserver used it instead
+            # of (or in addition to) Status.details — the retry envelope reads
+            # details.retryAfterSeconds.
+            retry_after = error.headers.get("Retry-After") if error.headers else None
+            if retry_after and isinstance(parsed, dict):
+                try:
+                    parsed.setdefault("details", {}).setdefault(
+                        "retryAfterSeconds", float(retry_after)
+                    )
+                except (TypeError, ValueError):
+                    pass
+            return error.code, parsed
+        except (urllib.error.URLError, http.client.HTTPException, OSError) as error:
+            # Raw network faults (connection reset/refused, socket timeout,
+            # torn keep-alive) become a typed retryable TransportError — a
+            # bare URLError escaping into a controller thread was the
+            # pre-chaos failure mode (ISSUE 10 satellite).
+            raise _as_transport_error(error) from error
 
     def stream(self, path, query=""):
         url = self.base_url + path + (f"?{query}" if query else "")
         try:
-            response = self._request("GET", url, None, timeout=None)
+            # Read-deadline, not a request deadline: timeout bounds each
+            # blocking socket read, so a stalled-but-open stream tears after
+            # watch_idle_s instead of hanging the pump forever.
+            response = self._request("GET", url, None, timeout=self.watch_idle_s)
         except urllib.error.HTTPError as error:
             # A watch opened with an expired resourceVersion answers 410 Gone
             # at the HTTP layer; surface it so the reflector can re-LIST.
             detail = error.read().decode(errors="replace")
             raise ApiError(error.code, detail) from None
+        except (urllib.error.URLError, http.client.HTTPException, OSError) as error:
+            raise _as_transport_error(error) from error
         try:
-            for line in response:
+            for line in self._stream_lines(response):
                 line = line.strip()
                 if line:
                     yield json.loads(line)
         finally:
             response.close()
+
+    @staticmethod
+    def _stream_lines(response):
+        """Iterate the response, mapping mid-stream socket faults (incl. the
+        idle-timeout read deadline) to TransportError so the watch pump's
+        reconnect path — not a raw socket.timeout — sees them."""
+        while True:
+            try:
+                line = response.readline()
+            except (TimeoutError, OSError, http.client.HTTPException) as error:
+                mapped = _as_transport_error(error)
+                if mapped.reason == "timeout":
+                    raise TransportError(
+                        "watch stream idle past the read deadline",
+                        reason="idle-timeout",
+                    ) from error
+                raise mapped from error
+            if not line:
+                return
+            yield line
 
 
 class RateLimiter:
@@ -178,8 +318,82 @@ class RateLimiter:
             self.clock.sleep(needed)
 
 
+# Per-verb request deadlines (the envelope passes these to the transport).
+# LIST gets the long deadline — a 50k-pod collection takes real time to
+# serialize; point reads and writes should fail fast and retry instead.
+DEFAULT_VERB_TIMEOUTS_S: Dict[str, float] = {
+    "GET": 15.0,
+    "LIST": 120.0,
+    "POST": 30.0,
+    "PUT": 30.0,
+    "PATCH": 30.0,
+    "DELETE": 30.0,
+}
+
+# Statuses the envelope retries with backoff (besides 429-with-Retry-After):
+# transient server-side trouble, per client-go's default retry set.
+RETRYABLE_STATUSES = frozenset({500, 502, 503, 504})
+
+
+class RetryPolicy:
+    """The envelope's tuning knobs (Options --kube-retry-* flags): attempt
+    budget, capped exponential backoff with 0.5x-1.5x jitter, a cap on how
+    long a server-sent Retry-After can park the client, and the per-verb
+    deadline table."""
+
+    def __init__(
+        self,
+        max_attempts: int = 5,
+        backoff_base_s: float = 0.1,
+        backoff_cap_s: float = 5.0,
+        retry_after_cap_s: float = 30.0,
+        timeouts_s: Optional[Dict[str, float]] = None,
+        jitter: Optional[random.Random] = None,
+    ):
+        self.max_attempts = max(1, max_attempts)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.retry_after_cap_s = retry_after_cap_s
+        self.timeouts_s = dict(DEFAULT_VERB_TIMEOUTS_S)
+        if timeouts_s:
+            self.timeouts_s.update(timeouts_s)
+        self._jitter = jitter or random.Random()
+
+    def timeout_for(self, verb: str) -> float:
+        return self.timeouts_s.get(verb, 30.0)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Capped exponential with jitter: attempt 1 -> ~base, doubling to
+        the cap. Jitter de-synchronizes a fleet of controllers retrying the
+        same outage (client-go's DefaultBackoff shape)."""
+        base = capped_backoff_s(self.backoff_base_s, self.backoff_cap_s, attempt)
+        return base * (0.5 + self._jitter.random())
+
+    def retry_after_s(self, payload: dict) -> Optional[float]:
+        """The server-directed delay of a 429, from Status
+        details.retryAfterSeconds (where the apiserver mirrors the
+        Retry-After header). None when absent — a 429 WITHOUT it is a
+        semantic rejection (the eviction subresource's PDB verdict), which
+        must surface immediately, not spin in the envelope."""
+        details = payload.get("details") if isinstance(payload, dict) else None
+        value = (details or {}).get("retryAfterSeconds")
+        if value is None:
+            return None
+        try:
+            return min(float(value), self.retry_after_cap_s)
+        except (TypeError, ValueError):
+            return None
+
+
 class KubeClient:
-    """Typed-path helpers over a Transport. Raises ApiError for non-2xx."""
+    """Typed-path helpers over a Transport. Raises ApiError for non-2xx
+    verdicts and TransportError for network faults that outlived the retry
+    budget."""
+
+    # Watch reconnect backoff: base doubles per consecutive failed
+    # connection (no event received), capped; reset by any delivered event.
+    WATCH_BACKOFF_BASE_S = 0.2
+    WATCH_BACKOFF_CAP_S = 5.0
 
     def __init__(
         self,
@@ -187,16 +401,70 @@ class KubeClient:
         qps: float = 200.0,
         burst: int = 300,
         clock: Optional[Clock] = None,
+        retry: Optional[RetryPolicy] = None,
     ):
         self.transport = transport
-        self.limiter = RateLimiter(qps, burst, clock)
+        self.clock = clock or SYSTEM_CLOCK
+        self.limiter = RateLimiter(qps, burst, self.clock)
+        self.retry = retry or RetryPolicy()
 
-    def _call(self, method, path, query="", body=None) -> dict:
-        self.limiter.wait()
-        status, payload = self.transport.request(method, path, query, body)
+    def _call(self, verb, path, query="", body=None) -> dict:
+        status, payload = self._request_enveloped(verb, path, query, body)
         if status >= 300:
             raise ApiError(status, str(payload.get("message", payload)))
         return payload
+
+    def _request_enveloped(
+        self, verb: str, path: str, query: str, body: Optional[dict]
+    ) -> Tuple[int, dict]:
+        """THE retry envelope — the only transport.request caller in the
+        tree (vet: transport-discipline). Loops attempts under the rate
+        limiter; each failed attempt costs a backoff sleep through the
+        Clock. See the module docstring for the per-verb idempotency
+        rationale that makes uniform retry safe."""
+        method = "GET" if verb == "LIST" else verb
+        label = verb.lower()
+        timeout_s = self.retry.timeout_for(verb)
+        attempt = 0
+        while True:
+            attempt += 1
+            self.limiter.wait()
+            began = self.clock.monotonic()
+            try:
+                status, payload = self.transport.request(
+                    method, path, query, body, timeout_s=timeout_s
+                )
+            except TransportError as error:
+                KUBE_API_REQUEST_DURATION.observe(
+                    self.clock.monotonic() - began, label
+                )
+                if not error.retryable or attempt >= self.retry.max_attempts:
+                    raise
+                KUBE_API_RETRY_TOTAL.inc(label, error.reason)
+                self.clock.sleep(self.retry.backoff_s(attempt))
+                continue
+            KUBE_API_REQUEST_DURATION.observe(self.clock.monotonic() - began, label)
+            delay = self._status_retry_delay(status, payload, attempt)
+            if delay is None:
+                return status, payload
+            KUBE_API_RETRY_TOTAL.inc(
+                label, "throttled" if status == 429 else "server-error"
+            )
+            self.clock.sleep(delay)
+
+    def _status_retry_delay(
+        self, status: int, payload: dict, attempt: int
+    ) -> Optional[float]:
+        """Backoff before retrying `status`, or None to surface it now."""
+        if attempt >= self.retry.max_attempts:
+            return None
+        if status == 429:
+            # Honor Retry-After; a 429 without one is a semantic verdict
+            # (PDB eviction rejection), not a throttle — never retried here.
+            return self.retry.retry_after_s(payload)
+        if status in RETRYABLE_STATUSES:
+            return self.retry.backoff_s(attempt)
+        return None
 
     # --- generic resource verbs -------------------------------------------
 
@@ -204,13 +472,13 @@ class KubeClient:
         return self._call("GET", path)
 
     def list(self, path: str) -> list:
-        return self._call("GET", path).get("items", [])
+        return self._call("LIST", path).get("items", [])
 
     def list_with_rv(self, path: str) -> Tuple[list, str]:
         """LIST returning (items, collection resourceVersion). The collection
         rv is what the first watch must resume from — resuming from '' (or
         from an item rv) loses events in the list-to-watch window."""
-        payload = self._call("GET", path)
+        payload = self._call("LIST", path)
         rv = (payload.get("metadata") or {}).get("resourceVersion", "")
         return payload.get("items", []), rv
 
@@ -240,6 +508,36 @@ class KubeClient:
 
     # --- watch -------------------------------------------------------------
 
+    def _watch_backoff_s(self, failures: int) -> float:
+        return capped_backoff_s(
+            self.WATCH_BACKOFF_BASE_S, self.WATCH_BACKOFF_CAP_S, failures
+        )
+
+    def _consume_stream(self, path, query, on_event, stop, progress):
+        """One watch connection: deliver events until the stream ends.
+        Returns (expired, stopped). `progress` ({"rv", "delivered"}) is
+        mutated in place so a mid-stream tear keeps the resume point and
+        backoff credit of the events already applied."""
+        expired = False
+        for event in self.transport.stream(path, query):
+            if stop.is_set():
+                return False, True
+            progress["delivered"] = True
+            event_type = event.get("type", "")
+            obj = event.get("object") or {}
+            if event_type == "ERROR":
+                # k8s signals watch errors in-band as a Status object.
+                expired = _status_code(obj) == 410
+                break
+            new_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if new_rv:
+                progress["rv"] = new_rv
+            if event_type != "BOOKMARK":
+                # Bookmarks only advance rv (shrinking the 410 window on
+                # idle kinds); everything else is delivered.
+                on_event(event_type, obj)
+        return expired, False
+
     def watch(
         self,
         path: str,
@@ -251,7 +549,10 @@ class KubeClient:
         """Consume watch events ({type, object} lines) until stop is set —
         the reflector loop of a client-go informer:
 
-        - reconnect from the last seen resourceVersion on stream drops;
+        - reconnect from the last seen resourceVersion on stream drops,
+          with capped exponential backoff per consecutive dead connection
+          (a torn socket and a persistently erroring server must not be
+          hot-looped; any delivered event resets the backoff);
         - on 410 Gone (an in-stream ERROR Status event or an HTTP 410 on
           reconnect — what the apiserver sends once etcd compaction has
           discarded the resumption point), call `relist` to rebuild state
@@ -260,50 +561,46 @@ class KubeClient:
           accepting the gap rather than hot-looping on 410 forever.
         """
         rv = resource_version
+        failures = 0
         while not stop.is_set():
-            # Bookmarks keep rv fresh on idle kinds, shrinking the 410 window.
             query = "watch=true&allowWatchBookmarks=true" + (
                 f"&resourceVersion={rv}" if rv else ""
             )
             expired = False
+            progress = {"rv": rv, "delivered": False}
             try:
-                for event in self.transport.stream(path, query):
-                    if stop.is_set():
-                        return
-                    event_type = event.get("type", "")
-                    obj = event.get("object") or {}
-                    if event_type == "ERROR":
-                        # k8s signals watch errors in-band as a Status object.
-                        try:
-                            code = int(obj.get("code", 0) or 0)
-                        except (TypeError, ValueError):
-                            code = 0
-                        expired = code == 410
-                        break
-                    if event_type == "BOOKMARK":
-                        new_rv = (obj.get("metadata") or {}).get("resourceVersion")
-                        if new_rv:
-                            rv = new_rv
-                        continue
-                    new_rv = (obj.get("metadata") or {}).get("resourceVersion")
-                    if new_rv:
-                        rv = new_rv
-                    on_event(event_type, obj)
+                expired, stopped = self._consume_stream(
+                    path, query, on_event, stop, progress
+                )
+                if stopped:
+                    return
             except ApiError as error:
                 expired = error.status == 410
+            except TransportError as error:
+                # Socket-layer tear (reset, idle deadline, refused reconnect)
+                # — retryable by definition, but distinctly counted so a
+                # flapping network shows up in the watch retry series.
+                KUBE_API_RETRY_TOTAL.inc("watch", error.reason)
             except Exception:  # noqa: BLE001 — watch drop: back off, re-watch
-                pass
+                KUBE_API_RETRY_TOTAL.inc("watch", "stream-error")
+            rv = progress["rv"]
+            if progress["delivered"]:
+                failures = 0
             if expired:
                 if relist is not None:
                     try:
                         rv = relist()
+                        failures = 0
+                        continue
                     except Exception:  # noqa: BLE001 — apiserver flake: retry
-                        if stop.wait(timeout=0.5):
+                        failures += 1
+                        if stop.wait(timeout=self._watch_backoff_s(failures)):
                             return
                 else:
                     rv = ""
-            elif stop.wait(timeout=0.2):
+            else:
                 # Non-410 stream end (incl. a non-410 ERROR Status): back off
-                # before reconnecting from the last rv, so a persistently
-                # erroring server isn't hot-looped.
-                return
+                # before reconnecting from the last rv.
+                failures += 1
+                if stop.wait(timeout=self._watch_backoff_s(failures)):
+                    return

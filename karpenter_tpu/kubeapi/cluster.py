@@ -357,15 +357,27 @@ class ApiServerCluster(Cluster):
     def bind_pod(self, pod: PodSpec, node: NodeSpec) -> None:
         # The actual Binding RPC the reference issues per pod
         # (provisioner.go:239-247 → coreV1Client.Pods(...).Bind).
-        self.api.create(
-            _pod_path(pod.namespace, pod.name) + "/binding",
-            {
-                "apiVersion": "v1",
-                "kind": "Binding",
-                "metadata": {"name": pod.name, "namespace": pod.namespace},
-                "target": {"kind": "Node", "name": node.name},
-            },
-        )
+        try:
+            self.api.create(
+                _pod_path(pod.namespace, pod.name) + "/binding",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Binding",
+                    "metadata": {"name": pod.name, "namespace": pod.namespace},
+                    "target": {"kind": "Node", "name": node.name},
+                },
+            )
+        except ApiError as error:
+            if error.status != 409:
+                raise
+            # 409 "already bound": either the retry envelope re-POSTed a
+            # Binding whose first attempt committed (response lost to a
+            # timeout), or a rival bound the pod first. Ask the server WHOSE
+            # bind won — ours is a success, anyone else's stays a conflict.
+            live = self.api.try_get(_pod_path(pod.namespace, pod.name))
+            bound_to = ((live or {}).get("spec") or {}).get("nodeName")
+            if bound_to != node.name:
+                raise
         super().bind_pod(pod, node)
 
     def delete_pod(
@@ -469,7 +481,19 @@ class ApiServerCluster(Cluster):
         # come back as ApiError 409 from the create); the local cache update
         # is an upsert so a watch event racing our own write can't trip the
         # in-memory duplicate check.
-        created = self.api.create(NODES, convert.node_to_kube(node))
+        try:
+            created = self.api.create(NODES, convert.node_to_kube(node))
+        except ApiError as error:
+            if error.status != 409:
+                raise
+            # Verify the conflict before letting it become the adoption
+            # signal upstream: a 409 for a node a GET cannot find is either
+            # a conflict-storm artifact or a delete racing our create —
+            # adopting a ghost would bind pods to a node that doesn't
+            # exist. Retry the create once; a REAL AlreadyExists re-raises.
+            if self.api.try_get(f"{NODES}/{node.name}") is not None:
+                raise
+            created = self.api.create(NODES, convert.node_to_kube(node))
         self._record_rv("node", created)
         return super().apply_node(node)
 

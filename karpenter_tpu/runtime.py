@@ -54,6 +54,15 @@ RECONCILE_TOTAL = REGISTRY.counter(
 RECONCILE_DURATION = REGISTRY.histogram(
     "reconcile_time_seconds", "Reconcile latency per loop", ["controller"]
 )
+# Degradation oracle for the chaos storms (docs/design/chaos.md): a sweep
+# that fails keeps its loop thread alive and re-enters after backoff — this
+# series is how operators see the degradation (by exception class), and how
+# `make chaos-smoke` proves the loops absorbed it.
+SWEEP_FAILURES_TOTAL = REGISTRY.counter(
+    "sweep_failures_total",
+    "Failed reconcile sweeps by loop and exception class",
+    ["controller", "reason"],
+)
 
 
 class ReconcileLoop:
@@ -86,6 +95,12 @@ class ReconcileLoop:
         # residual cost of the 128-thread pod storm). chunk=1 loops keep
         # per-key notifies: their reconciles block on RPCs, where per-key
         # parallelism is the point.
+        # Per-key consecutive-failure streaks for the error backoff. A key
+        # CAN be reconciled by two workers at once (a watch-event enqueue
+        # during an in-flight reconcile re-queues it, and a second worker
+        # may pop it before the first finishes), so the read-modify-write
+        # must hold the cv lock or increments race.
+        self._err_streak: dict = {}  # vet: guarded-by(self._cv)
         self._waiting = 0  # vet: guarded-by(self._cv)
         self._pops = 0  # vet: guarded-by(self._cv) — chunk pops ever (start()'s grabbed-work escape)
         self._heap: list = []  # vet: guarded-by(self._cv) — (due_time, seq, key)
@@ -230,6 +245,25 @@ class ReconcileLoop:
         WORKQUEUE_DEPTH.set(len(self._queued), self.name)
         return keys
 
+    # Error-requeue backoff: a key whose reconcile keeps failing (an API
+    # outage, a poisoned object) re-enters at 2^n seconds up to the cap —
+    # the loop thread stays alive and the key keeps probing, but a
+    # persistent fault can't hot-loop the controller against a degraded
+    # apiserver. Any success resets the streak; a watch event pulls the
+    # key forward early (enqueue with delay 0 supersedes a backoff entry).
+    ERROR_BACKOFF_BASE_S = 1.0
+    ERROR_BACKOFF_CAP_S = 30.0
+
+    def _error_backoff_s(self, key) -> float:
+        from karpenter_tpu.utils.backoff import capped_backoff_s
+
+        with self._cv:
+            streak = self._err_streak.get(key, 0) + 1
+            self._err_streak[key] = streak
+        return capped_backoff_s(
+            self.ERROR_BACKOFF_BASE_S, self.ERROR_BACKOFF_CAP_S, streak
+        )
+
     def _reconcile_chunk(self, keys: list) -> None:
         """Reconcile a popped chunk; metrics are recorded once per chunk
         (per-key durations, batched) so high-concurrency pools don't convoy
@@ -244,10 +278,13 @@ class ReconcileLoop:
             try:
                 result = self.reconcile(key)
                 outcomes["requeue" if result is not None else "success"] += 1
-            except Exception:  # noqa: BLE001 — must not kill the loop
+                with self._cv:
+                    self._err_streak.pop(key, None)
+            except Exception as error:  # noqa: BLE001 — must not kill the loop
                 self.log.exception("reconcile %r failed", key)
-                result = 1.0
+                result = self._error_backoff_s(key)
                 outcomes["error"] += 1
+                SWEEP_FAILURES_TOTAL.inc(self.name, type(error).__name__)
             durations.append(_time.perf_counter() - began)
             if result is not None:
                 requeues.append((key, float(result)))
@@ -571,8 +608,12 @@ class Manager:
                 if worker.batch_ready():
                     try:
                         worker.provision()
-                    except Exception:  # noqa: BLE001
+                    except Exception as error:  # noqa: BLE001
                         self.log.exception("provisioning pass failed")
+                        # The batch loop's own degradation signal: a failed
+                        # provision pass (API storm mid-bind, launch fault)
+                        # leaves the batch queued and the loop alive.
+                        SWEEP_FAILURES_TOTAL.inc("batch", type(error).__name__)
 
     def _requeue_loop(self) -> None:
         """5-minute provisioner refresh to pick up instance-type drift

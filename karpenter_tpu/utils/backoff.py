@@ -1,0 +1,16 @@
+"""The one capped-exponential-backoff formula.
+
+Three delay ladders share this shape — the kube retry envelope
+(RetryPolicy.backoff_s, which layers jitter on top), the watch reconnect
+backoff (KubeClient._watch_backoff_s), and the reconcile-loop error requeue
+(ReconcileLoop._error_backoff_s) — so the formula lives once; a policy
+change (e.g. extending jitter to the other ladders) edits one place.
+"""
+
+from __future__ import annotations
+
+
+def capped_backoff_s(base_s: float, cap_s: float, attempt: int) -> float:
+    """min(cap, base * 2^(attempt-1)) — attempt is 1-based; values below 1
+    clamp to the base."""
+    return min(cap_s, base_s * (2 ** max(0, attempt - 1)))

@@ -30,6 +30,19 @@ class Options:
     health_probe_port: int = 8081  # ref: manager.go:52-57
     kube_client_qps: float = 200.0  # ref: options.go:33
     kube_client_burst: int = 300  # ref: options.go:34
+    # Kube API retry envelope (kubeapi/client.py RetryPolicy; see
+    # docs/design/chaos.md for the policy table and docs/operations.md for
+    # when to tune these): attempt budget per request, then the capped
+    # exponential backoff between attempts. Raise the cap when riding out
+    # long apiserver brownouts; lower attempts to fail fast into the
+    # reconcile loops' own backoff.
+    kube_retry_max_attempts: int = 5
+    kube_retry_backoff_base: float = 0.1
+    kube_retry_backoff_cap: float = 5.0
+    # Watch read-deadline: a watch stream quiet for this long is torn and
+    # reconnected (an apiserver that stops sending bytes must not hang the
+    # pump forever). Keep well above the server's bookmark cadence.
+    kube_watch_idle_timeout: float = 300.0
     solver: str = "cost"  # cost | ffd | greedy | native | remote
     solver_endpoint: str = ""  # remote: host:port of the solver sidecar
     cloud_provider: str = "fake"
@@ -72,8 +85,30 @@ class Options:
     # compaction. See docs/operations.md.
     encode_compaction_threshold: float = 0.5
 
-    def validate(self) -> None:
+    def _kube_retry_errors(self) -> List[str]:
+        """Retry-envelope flag validation (kubeapi/client.py RetryPolicy)."""
         errors: List[str] = []
+        if self.kube_retry_max_attempts < 1:
+            errors.append(
+                f"kube-retry-max-attempts must be >= 1, got {self.kube_retry_max_attempts}"
+            )
+        if self.kube_retry_backoff_base <= 0:
+            errors.append(
+                f"kube-retry-backoff-base must be > 0, got {self.kube_retry_backoff_base}"
+            )
+        if self.kube_retry_backoff_cap < self.kube_retry_backoff_base:
+            errors.append(
+                "kube-retry-backoff-cap must be >= kube-retry-backoff-base, got "
+                f"{self.kube_retry_backoff_cap}"
+            )
+        if self.kube_watch_idle_timeout <= 0:
+            errors.append(
+                f"kube-watch-idle-timeout must be > 0, got {self.kube_watch_idle_timeout}"
+            )
+        return errors
+
+    def validate(self) -> None:
+        errors: List[str] = self._kube_retry_errors()
         if not self.cluster_name:
             errors.append("CLUSTER_NAME is required")
         if self.metrics_port == self.health_probe_port:
@@ -130,6 +165,22 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     parser.add_argument(
         "--kube-client-burst", type=int, default=int(_env("KUBE_CLIENT_BURST", "300"))
     )
+    parser.add_argument(
+        "--kube-retry-max-attempts", type=int,
+        default=int(_env("KUBE_RETRY_MAX_ATTEMPTS", "5")),
+    )
+    parser.add_argument(
+        "--kube-retry-backoff-base", type=float,
+        default=float(_env("KUBE_RETRY_BACKOFF_BASE", "0.1")),
+    )
+    parser.add_argument(
+        "--kube-retry-backoff-cap", type=float,
+        default=float(_env("KUBE_RETRY_BACKOFF_CAP", "5.0")),
+    )
+    parser.add_argument(
+        "--kube-watch-idle-timeout", type=float,
+        default=float(_env("KUBE_WATCH_IDLE_TIMEOUT", "300")),
+    )
     parser.add_argument("--solver", default=_env("KARPENTER_SOLVER", "cost"))
     parser.add_argument(
         "--solver-endpoint", default=_env("KARPENTER_SOLVER_ENDPOINT", "")
@@ -171,6 +222,10 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         health_probe_port=args.health_probe_port,
         kube_client_qps=args.kube_client_qps,
         kube_client_burst=args.kube_client_burst,
+        kube_retry_max_attempts=args.kube_retry_max_attempts,
+        kube_retry_backoff_base=args.kube_retry_backoff_base,
+        kube_retry_backoff_cap=args.kube_retry_backoff_cap,
+        kube_watch_idle_timeout=args.kube_watch_idle_timeout,
         solver=args.solver,
         solver_endpoint=args.solver_endpoint,
         cloud_provider=args.cloud_provider,

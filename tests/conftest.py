@@ -30,6 +30,18 @@ def _crashpoints_disarmed():
     crashpoints.disarm_all()
 
 
+@pytest.fixture(autouse=True)
+def _faultpoints_disarmed():
+    """Same isolation for chaos faults (tests/test_chaos.py and the parity
+    re-runs arm them): every apiserver-backed Harness routes through
+    ChaosTransport, so a leaked fault would inject into unrelated tests."""
+    from karpenter_tpu.utils import faultpoints
+
+    faultpoints.disarm_all()
+    yield
+    faultpoints.disarm_all()
+
+
 def pytest_collection_modifyitems(config, items):
     """KARPENTER_RANDOM_ORDER=<seed|auto> shuffles test order — the
     reference battletest's randomized-spec analogue (ref Makefile:33-38,

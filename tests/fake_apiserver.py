@@ -19,6 +19,7 @@ import json
 import queue
 import re
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from karpenter_tpu.kubeapi.client import Transport
@@ -429,7 +430,8 @@ class DirectTransport(Transport):
         self.server = server
         self.closed = threading.Event()
 
-    def request(self, method, path, query="", body=None):
+    def request(self, method, path, query="", body=None, timeout_s=None):
+        # Socket-free: the per-verb deadline has nothing to bound here.
         return self.server.handle(method, path, query, body)
 
     def close(self):
@@ -477,6 +479,8 @@ def serve_http(server: FakeApiServer, port: int = 0):
             self.wfile.write(data)
 
         def _watch(self, path, query):
+            from karpenter_tpu.utils import faultpoints
+
             kind = server.kind_for_path(path)
             q = server.subscribe(kind, _query_rv(query))
             try:
@@ -491,6 +495,17 @@ def serve_http(server: FakeApiServer, port: int = 0):
                         continue
                     if event.get("__disconnect__"):
                         return  # drop the connection mid-stream
+                    stall = faultpoints.draw("watch.stall")
+                    if stall is not None:
+                        # Stalled-apiserver fault: hold every byte for
+                        # delay_s WITHOUT closing the socket — the failure
+                        # mode only the HttpTransport read-deadline can
+                        # bound (the client must tear first; its reconnect
+                        # replays the held events from history). Wall-clock
+                        # sleep is the point here: this models the socket
+                        # going quiet in real time.
+                        time.sleep(stall.delay_s)
+                        return
                     line = json.dumps(event).encode() + b"\n"
                     self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                     self.wfile.flush()

@@ -60,11 +60,23 @@ class Harness:
         self.backend = backend or self.DEFAULT_BACKEND
         if self.backend == "apiserver":
             from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+            from karpenter_tpu.kubeapi.chaos import ChaosTransport
             from tests.fake_apiserver import DirectTransport, FakeApiServer
 
+            # Every apiserver-backed harness routes through ChaosTransport:
+            # with nothing armed it is a pure passthrough (one dict read),
+            # and chaos tests — including the parity re-runs — inject
+            # faults by arming utils/faultpoints sites, no re-plumbing.
             self.apiserver = FakeApiServer(clock=self.clock)
             self.cluster = ApiServerCluster(
-                KubeClient(DirectTransport(self.apiserver), qps=1e6, burst=10**6),
+                KubeClient(
+                    ChaosTransport(
+                        DirectTransport(self.apiserver), clock=self.clock
+                    ),
+                    qps=1e6,
+                    burst=10**6,
+                    clock=self.clock,
+                ),
                 clock=self.clock,
             ).start()
             _live_harnesses.append(self)

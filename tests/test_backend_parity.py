@@ -13,6 +13,7 @@ duration and closes the watch pumps the apiserver harnesses start.
 import pytest
 
 from tests import harness as harness_mod
+from tests import test_chaos as chaos
 from tests import test_consolidation as consolidation
 from tests import test_crash_consistency as crash
 from tests import test_interruption as interruption
@@ -146,3 +147,11 @@ class TestConsolidationChurnOnApiserver(
     consolidation.TestConsolidationChurnConvergence
 ):
     pass
+
+
+class TestProvisioningUnderApiFaultsOnApiserver(chaos.TestProvisioningUnderApiFaults):
+    """The chaos satellite's parity half: on this backend every request
+    crosses ChaosTransport, so the armed conflict/timeout/reset storms
+    actually fire — the 409-create → GET → retry-once path and the
+    committed-timeout re-POST must converge with zero leaked instances,
+    indistinguishable (to the controllers) from the quiet in-memory run."""

@@ -1,0 +1,897 @@
+"""Chaos control plane (ISSUE 10): the faultpoint facility, ChaosTransport,
+the typed TransportError mapping, the retry envelope, the watch read
+deadline + reconnect backoff, sweep-loop degradation, and convergence of
+the informer cache + DeviceClusterState under watch-stream faults.
+
+The storm capstone lives in tools/chaos_smoke.py (`make chaos-smoke`); this
+module is the deterministic matrix. Fault isolation (disarm before/after
+every test) lives in tests/conftest.py so the parity suite's apiserver
+re-run of the classes below gets it too.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+import karpenter_tpu
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.kubeapi import (
+    ApiError,
+    ApiServerCluster,
+    KubeClient,
+    RetryPolicy,
+    Transport,
+    TransportError,
+)
+from karpenter_tpu.kubeapi import convert
+from karpenter_tpu.kubeapi.chaos import ChaosTransport
+from karpenter_tpu.kubeapi.client import (
+    HttpTransport,
+    KUBE_API_REQUEST_DURATION,
+    KUBE_API_RETRY_TOTAL,
+)
+from karpenter_tpu.utils import faultpoints
+from karpenter_tpu.utils.clock import FakeClock
+
+from tests import fixtures
+from tests.fake_apiserver import DirectTransport, FakeApiServer, serve_http
+from tests.harness import Harness
+
+
+def fast_retry(**overrides) -> RetryPolicy:
+    """Millisecond backoffs so retry-path tests don't pay wall-clock."""
+    defaults = dict(backoff_base_s=0.001, backoff_cap_s=0.005)
+    defaults.update(overrides)
+    return RetryPolicy(**defaults)
+
+
+def make_client(transport, clock=None, **retry_overrides) -> KubeClient:
+    return KubeClient(
+        transport, qps=1e6, burst=10**6, clock=clock, retry=fast_retry(**retry_overrides)
+    )
+
+
+def wait_until(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# --- the faultpoint facility --------------------------------------------------
+
+
+class TestFaultpointFacility:
+    def test_disarmed_draw_is_none(self):
+        assert faultpoints.draw("api.request.get") is None
+        assert not faultpoints.fires("watch.stall")
+        assert not faultpoints.any_armed()
+
+    def test_count_budget_exhausts(self):
+        fault = faultpoints.arm("api.request.get", "reset", count=2)
+        assert faultpoints.draw("api.request.get") is fault
+        assert faultpoints.draw("api.request.get") is fault
+        assert faultpoints.draw("api.request.get") is None
+        assert fault.fires == 2
+        assert faultpoints.fired("api.request.get") == 2
+        assert faultpoints.total_fired() == 2
+
+    def test_seeded_rates_replay_exactly(self):
+        def roll():
+            faultpoints.disarm_all()
+            faultpoints.seed(42)
+            faultpoints.arm("watch.event", "duplicate", rate=0.3)
+            return [faultpoints.draw("watch.event") is not None for _ in range(64)]
+
+        first, second = roll(), roll()
+        assert first == second
+        assert any(first) and not all(first)  # a fractional rate, not 0/1
+
+    def test_unknown_site_kind_and_rate_rejected(self):
+        with pytest.raises(ValueError):
+            faultpoints.arm("api.request.head", "reset")
+        with pytest.raises(ValueError):
+            faultpoints.arm("api.request.get", "duplicate")  # a watch kind
+        with pytest.raises(ValueError):
+            faultpoints.arm("watch.event", "throttle")  # a request kind
+        with pytest.raises(ValueError):
+            faultpoints.arm("api.request.get", "reset", rate=0.0)
+
+    def test_stacked_faults_fire_in_arm_order(self):
+        first = faultpoints.arm("api.request.get", "latency", count=1, delay_s=1.0)
+        second = faultpoints.arm("api.request.get", "reset")
+        assert faultpoints.draw("api.request.get") is first
+        assert faultpoints.draw("api.request.get") is second
+
+    def test_site_inventory_matches_instrumentation(self):
+        """The crashpoint-inventory-lint analogue: the canonical SITES tuple
+        and the site literals actually threaded through ChaosTransport (and
+        the fake apiserver's stall handler) may not drift apart — a new
+        kube-call site must declare its chaos coverage in both places."""
+        scanned = list((Path(karpenter_tpu.__file__).parent).rglob("*.py")) + [
+            Path(__file__).parent / "fake_apiserver.py"
+        ]
+        pattern = re.compile(r'"((?:api\.request|watch)\.[a-z0-9-]+)"')
+        found = set()
+        for path in scanned:
+            if path.name == "faultpoints.py":
+                continue
+            found |= set(pattern.findall(path.read_text()))
+        assert found == set(faultpoints.SITES)
+
+
+# --- typed TransportError mapping (satellite: no raw URLError escapes) --------
+
+
+class TestTransportErrorMapping:
+    def test_connection_refused_is_typed_and_retryable(self):
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
+        transport = HttpTransport(f"http://127.0.0.1:{port}")
+        with pytest.raises(TransportError) as error:
+            transport.request("GET", "/api/v1/pods")
+        assert error.value.retryable
+
+    def test_connection_reset_mid_list_is_not_a_bare_urlerror(self):
+        """The regression: a server tearing the connection mid-LIST used to
+        escape as urllib.error.URLError into whichever controller thread
+        made the call."""
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+
+        def slam():
+            conn, _ = listener.accept()
+            conn.recv(1024)
+            conn.close()  # headers read, then the connection dies
+
+        killer = threading.Thread(target=slam, daemon=True)
+        killer.start()
+        try:
+            transport = HttpTransport(f"http://127.0.0.1:{port}", timeout_s=2.0)
+            with pytest.raises(TransportError) as error:
+                transport.request("GET", "/api/v1/pods")
+            assert error.value.retryable
+            assert error.value.reason in ("reset", "network")
+        finally:
+            killer.join(timeout=2.0)
+            listener.close()
+
+    def test_socket_timeout_labels_timeout(self):
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+        done = threading.Event()
+
+        def hold():
+            conn, _ = listener.accept()
+            done.wait(timeout=5.0)  # accept, read nothing, answer nothing
+            conn.close()
+
+        holder = threading.Thread(target=hold, daemon=True)
+        holder.start()
+        try:
+            transport = HttpTransport(f"http://127.0.0.1:{port}")
+            with pytest.raises(TransportError) as error:
+                transport.request("GET", "/api/v1/pods", timeout_s=0.2)
+            assert error.value.reason == "timeout"
+        finally:
+            done.set()
+            holder.join(timeout=2.0)
+            listener.close()
+
+    def test_client_absorbs_transient_faults(self):
+        class Flaky(Transport):
+            def __init__(self, inner, failures):
+                self.inner = inner
+                self.failures = failures
+
+            def request(self, method, path, query="", body=None, timeout_s=None):
+                if self.failures:
+                    self.failures -= 1
+                    raise TransportError("flake", reason="reset")
+                return self.inner.request(method, path, query, body)
+
+        server = FakeApiServer()
+        server.seed("pods", convert.pod_to_kube(PodSpec(name="steady")))
+        client = make_client(Flaky(DirectTransport(server), failures=2))
+        before = KUBE_API_RETRY_TOTAL.get("list", "reset")
+        items = client.list("/api/v1/pods")
+        assert [i["metadata"]["name"] for i in items] == ["steady"]
+        assert KUBE_API_RETRY_TOTAL.get("list", "reset") - before == 2
+
+
+# --- the retry envelope over a scripted transport -----------------------------
+
+
+class ScriptedTransport(Transport):
+    """Plays back a list of actions: ("ok", body) | ("status", code, body) |
+    ("raise", exception). Records (method, timeout_s) per attempt."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def request(self, method, path, query="", body=None, timeout_s=None):
+        self.calls.append((method, path, timeout_s))
+        if not self.script:
+            return 200, {}
+        action = self.script.pop(0)
+        if action[0] == "ok":
+            return 200, action[1] if len(action) > 1 else {}
+        if action[0] == "status":
+            return action[1], action[2]
+        raise action[1]
+
+
+class TestRetryEnvelope:
+    def test_retryable_fault_retried_then_succeeds(self):
+        transport = ScriptedTransport([
+            ("raise", TransportError("boom", reason="reset")),
+            ("raise", TransportError("boom", reason="timeout")),
+            ("ok", {"items": []}),
+        ])
+        before = KUBE_API_REQUEST_DURATION.count("get")
+        assert make_client(transport).get("/api/v1/nodes/n1") == {"items": []}
+        assert len(transport.calls) == 3
+        # Every attempt — failed ones included — lands in the histogram.
+        assert KUBE_API_REQUEST_DURATION.count("get") - before == 3
+
+    def test_non_retryable_fault_raises_immediately(self):
+        transport = ScriptedTransport([
+            ("raise", TransportError("denied", retryable=False)),
+        ])
+        with pytest.raises(TransportError):
+            make_client(transport).get("/api/v1/nodes/n1")
+        assert len(transport.calls) == 1
+
+    def test_budget_exhaustion_surfaces_the_fault(self):
+        transport = ScriptedTransport(
+            [("raise", TransportError("down", reason="reset"))] * 10
+        )
+        with pytest.raises(TransportError):
+            make_client(transport, max_attempts=3).get("/x")
+        assert len(transport.calls) == 3
+
+    def test_429_honors_retry_after_through_the_clock(self):
+        clock = FakeClock()
+        throttle = {"kind": "Status", "code": 429,
+                    "details": {"retryAfterSeconds": 7.5}}
+        transport = ScriptedTransport([("status", 429, throttle), ("ok", {})])
+        began = clock.now()
+        make_client(transport, clock=clock).get("/x")
+        assert len(transport.calls) == 2
+        assert clock.now() - began == pytest.approx(7.5)
+
+    def test_429_without_retry_after_is_a_semantic_verdict(self):
+        """The eviction subresource's PDB rejection is a 429 with no
+        Retry-After — it must surface immediately, never spin the envelope."""
+        body = {"kind": "Status", "code": 429,
+                "message": "Cannot evict pod as it would violate the pod's disruption budget."}
+        transport = ScriptedTransport([("status", 429, body)])
+        with pytest.raises(ApiError) as error:
+            make_client(transport).create("/evict", {})
+        assert error.value.status == 429
+        assert len(transport.calls) == 1
+
+    def test_5xx_retried_until_budget_then_surfaces(self):
+        body = {"kind": "Status", "code": 503, "message": "etcd leader lost"}
+        transport = ScriptedTransport([("status", 503, body)] * 10)
+        with pytest.raises(ApiError) as error:
+            make_client(transport, max_attempts=4).get("/x")
+        assert error.value.status == 503
+        assert len(transport.calls) == 4
+
+    def test_409_never_retried_by_the_envelope(self):
+        body = {"kind": "Status", "code": 409, "message": "conflict"}
+        transport = ScriptedTransport([("status", 409, body)])
+        with pytest.raises(ApiError):
+            make_client(transport).update("/x", {})
+        assert len(transport.calls) == 1
+
+    def test_per_verb_timeouts_reach_the_transport(self):
+        transport = ScriptedTransport([])
+        client = make_client(transport, timeouts_s={"LIST": 99.0})
+        client.get("/one")
+        client.list("/many")
+        client.delete("/one")
+        assert [c[2] for c in transport.calls] == [15.0, 99.0, 30.0]
+        assert [c[0] for c in transport.calls] == ["GET", "GET", "DELETE"]
+
+    def test_backoff_is_capped_exponential_with_jitter(self):
+        import random
+
+        policy = RetryPolicy(
+            backoff_base_s=0.1, backoff_cap_s=0.4, jitter=random.Random(7)
+        )
+        for attempt, ceiling in ((1, 0.1), (2, 0.2), (3, 0.4), (9, 0.4)):
+            samples = [policy.backoff_s(attempt) for _ in range(64)]
+            assert all(0.5 * ceiling <= s <= 1.5 * ceiling for s in samples)
+        spread = {round(policy.backoff_s(1), 6) for _ in range(16)}
+        assert len(spread) > 1  # jitter actually jitters
+
+
+# --- ChaosTransport request faults over the fake apiserver --------------------
+
+
+def chaos_backend(clock=None):
+    server = FakeApiServer(clock=clock)
+    client = make_client(
+        ChaosTransport(DirectTransport(server), clock=clock), clock=clock
+    )
+    cluster = ApiServerCluster(client, clock=clock).start()
+    return server, cluster
+
+
+class TestChaosRequestFaults:
+    def test_latency_fault_sleeps_through_the_clock(self):
+        clock = FakeClock()
+        server, cluster = chaos_backend(clock)
+        try:
+            faultpoints.arm("api.request.get", "latency", delay_s=2.0, count=1)
+            began = clock.now()
+            cluster.api.try_get("/api/v1/nodes/nope")
+            assert clock.now() - began == pytest.approx(2.0)
+        finally:
+            cluster.close()
+
+    def test_reset_storm_absorbed_by_the_envelope(self):
+        server, cluster = chaos_backend()
+        server.seed("pods", convert.pod_to_kube(PodSpec(name="p1")))
+        try:
+            faultpoints.arm("api.request.get", "reset", count=3)
+            assert cluster.api.get("/api/v1/namespaces/default/pods/p1")
+            assert faultpoints.fired("api.request.get") == 3
+        finally:
+            cluster.close()
+
+    def test_timeout_after_committed_create_converges(self):
+        """The dangerous timeout half: the POST executed server-side, the
+        response died. The envelope re-POSTs, the real 409 routes through
+        _create_or_update's GET+PUT — exactly once server-side."""
+        server, cluster = chaos_backend()
+        try:
+            faultpoints.arm("api.request.post", "timeout", count=1)
+            cluster.apply_pod(PodSpec(name="committed", unschedulable=True))
+            stored = server.get_object("pods", "default", "committed")
+            assert stored is not None
+            assert cluster.get_pod("default", "committed") is not None
+        finally:
+            cluster.close()
+
+    def test_bind_retry_after_commit_is_idempotent(self):
+        server, cluster = chaos_backend()
+        try:
+            pod = cluster.apply_pod(PodSpec(name="web", unschedulable=True))
+            node = cluster.create_node(NodeSpec(name="n1"))
+            faultpoints.arm("api.request.post", "timeout", count=1)
+            cluster.bind_pod(pod, node)  # first POST commits; retry sees 409
+            assert server.get_object("pods", "default", "web")["spec"]["nodeName"] == "n1"
+            assert cluster.get_pod("default", "web").node_name == "n1"
+        finally:
+            cluster.close()
+
+    def test_bind_conflict_against_a_rival_still_raises(self):
+        server, cluster = chaos_backend()
+        try:
+            pod = cluster.apply_pod(PodSpec(name="web", unschedulable=True))
+            cluster.create_node(NodeSpec(name="rival"))
+            mine = cluster.create_node(NodeSpec(name="mine"))
+            server.handle(
+                "POST", "/api/v1/namespaces/default/pods/web/binding", "",
+                {"target": {"name": "rival"}},
+            )
+            with pytest.raises(ApiError) as error:
+                cluster.bind_pod(pod, mine)
+            assert error.value.status == 409
+        finally:
+            cluster.close()
+
+    def test_throttle_fault_waits_retry_after(self):
+        clock = FakeClock()
+        server, cluster = chaos_backend(clock)
+        server.seed("nodes", {"metadata": {"name": "n1"}})
+        try:
+            before = KUBE_API_RETRY_TOTAL.get("get", "throttled")
+            faultpoints.arm("api.request.get", "throttle", retry_after_s=3.0, count=1)
+            began = clock.now()
+            assert cluster.api.get("/api/v1/nodes/n1")
+            assert clock.now() - began == pytest.approx(3.0)
+            assert KUBE_API_RETRY_TOTAL.get("get", "throttled") - before == 1
+        finally:
+            cluster.close()
+
+    def test_server_error_storm_absorbed(self):
+        server, cluster = chaos_backend()
+        server.seed("nodes", {"metadata": {"name": "n1"}})
+        try:
+            faultpoints.arm("api.request.get", "server-error", count=3)
+            assert cluster.api.get("/api/v1/nodes/n1")
+        finally:
+            cluster.close()
+
+    def test_injected_conflict_takes_the_delete_race_path(self):
+        """An injected 409 for an object a GET cannot find IS the
+        delete-between-409-and-GET race from the client's view: the
+        create-first apply must retry the create once and land it."""
+        server, cluster = chaos_backend()
+        try:
+            faultpoints.arm("api.request.post", "conflict", count=1)
+            cluster.apply_pod(PodSpec(name="raced", unschedulable=True))
+            assert server.get_object("pods", "default", "raced") is not None
+            assert faultpoints.fired("api.request.post") == 1
+        finally:
+            cluster.close()
+
+    def test_spurious_conflict_on_create_node_does_not_adopt_a_ghost(self):
+        server, cluster = chaos_backend()
+        try:
+            faultpoints.arm("api.request.post", "conflict", count=1)
+            cluster.create_node(NodeSpec(name="solid"))
+            assert server.get_object("nodes", "", "solid") is not None
+        finally:
+            cluster.close()
+
+    def test_real_duplicate_node_create_still_conflicts(self):
+        server, cluster = chaos_backend()
+        try:
+            cluster.create_node(NodeSpec(name="n1"))
+            with pytest.raises(ApiError) as error:
+                cluster.create_node(NodeSpec(name="n1"))
+            assert error.value.status == 409
+        finally:
+            cluster.close()
+
+
+class TestDeleteBetween409AndGetRace:
+    def test_rival_deleted_between_conflict_and_get(self):
+        """The genuine race (not injected): the create hits a real rival,
+        which a DELETE removes before our GET — the retried create must
+        land a fresh incarnation."""
+        server = FakeApiServer()
+
+        class DeleteRacer(Transport):
+            def __init__(self, inner):
+                self.inner = inner
+                self.armed = True
+
+            def request(self, method, path, query="", body=None, timeout_s=None):
+                status, payload = self.inner.request(method, path, query, body)
+                if method == "POST" and status == 409 and self.armed:
+                    self.armed = False
+                    server.handle("DELETE", "/api/v1/namespaces/default/pods/raced")
+                return status, payload
+
+            def stream(self, path, query=""):
+                return self.inner.stream(path, query)
+
+            def close(self):
+                self.inner.close()
+
+        rival = convert.pod_to_kube(PodSpec(name="raced"))
+        server.seed("pods", rival)
+        rival_uid = server.get_object("pods", "default", "raced")["metadata"]["uid"]
+        cluster = ApiServerCluster(
+            make_client(DeleteRacer(DirectTransport(server)))
+        ).start()
+        try:
+            cluster.apply_pod(PodSpec(name="raced", unschedulable=True))
+            stored = server.get_object("pods", "default", "raced")
+            assert stored is not None
+            assert stored["metadata"]["uid"] != rival_uid  # a fresh incarnation
+        finally:
+            cluster.close()
+
+
+# --- conflict/fault storms through the controllers (parity-re-run class) ------
+
+
+class TestProvisioningUnderApiFaults:
+    """Runs on BOTH backends (tests/test_backend_parity.py re-runs it
+    against the apiserver store, where every request crosses ChaosTransport).
+    On the in-memory backend the armed faults never fire — the assertions
+    hold vacuously, which is itself the parity statement: controllers cannot
+    tell a chaos-wrapped backend from a quiet one once the storm is absorbed."""
+
+    def make_harness(self) -> Harness:
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        return h
+
+    def storm_provision(self, h: Harness, pods, rounds=25):
+        """Drive apply→select→provision the way the reconcile loops would:
+        every ApiError/TransportError surfaced by a pass is a requeue, not a
+        death sentence."""
+        applied = set()
+        for _ in range(rounds):
+            try:
+                for pod in pods:
+                    if pod.name not in applied:
+                        h.cluster.apply_pod(pod)
+                        applied.add(pod.name)
+                for pod in pods:
+                    live = h.cluster.try_get_pod(pod.namespace, pod.name)
+                    if live is not None and live.is_provisionable():
+                        h.selection.reconcile(pod.namespace, pod.name)
+                for worker in h.provisioning.workers.values():
+                    worker.provision()
+            except (ApiError, TransportError):
+                continue  # the reconcile-loop requeue analogue
+            if all(
+                h.cluster.get_pod(p.namespace, p.name).node_name is not None
+                for p in pods
+            ):
+                return
+        raise AssertionError("storm never converged")
+
+    def assert_bound_once_no_leaks(self, h: Harness, pods):
+        from karpenter_tpu.controllers.instancegc import LAUNCH_GRACE_SECONDS
+
+        for pod in pods:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert live.node_name is not None, f"{pod.name} never bound"
+            assert h.cluster.try_get_node(live.node_name) is not None
+        provider_ids = [n.provider_id for n in h.cluster.list_nodes()]
+        assert len(provider_ids) == len(set(provider_ids))
+        h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+        h.instancegc.reconcile()
+        h.instancegc.reconcile()
+        leaked = set(h.cloud.instances) - {
+            n.provider_id for n in h.cluster.list_nodes()
+        }
+        assert not leaked, f"leaked instances: {sorted(leaked)}"
+
+    def test_provision_converges_under_conflict_storm(self):
+        h = self.make_harness()
+        faultpoints.seed(1234)
+        faultpoints.arm("api.request.post", "conflict", rate=0.4, count=8)
+        pods = fixtures.pods(4)
+        self.storm_provision(h, pods)
+        self.assert_bound_once_no_leaks(h, pods)
+        if h.backend == "apiserver":
+            assert faultpoints.fired("api.request.post") > 0
+
+    def test_provision_converges_under_mixed_fault_storm(self):
+        h = self.make_harness()
+        faultpoints.seed(99)
+        faultpoints.arm("api.request.post", "timeout", rate=0.2, count=4)
+        faultpoints.arm("api.request.post", "reset", rate=0.2, count=4)
+        faultpoints.arm("api.request.get", "server-error", rate=0.1, count=4)
+        faultpoints.arm("api.request.patch", "reset", rate=0.2, count=4)
+        pods = fixtures.pods(4)
+        self.storm_provision(h, pods)
+        self.assert_bound_once_no_leaks(h, pods)
+
+    def test_create_conflict_then_get_then_retry_path(self):
+        """The 409-create → GET → retry-once path (kubeapi/cluster.py) under
+        an injected conflict; on the in-memory backend apply_pod is a plain
+        upsert and the same call converges trivially — parity."""
+        h = self.make_harness()
+        faultpoints.arm("api.request.post", "conflict", count=1)
+        pod = fixtures.pod(name="conflicted")
+        h.cluster.apply_pod(pod)
+        assert h.cluster.get_pod(pod.namespace, pod.name) is not None
+        h.cluster.apply_pod(pod)  # real already-exists: GET+PUT branch
+        assert h.cluster.get_pod(pod.namespace, pod.name) is not None
+
+
+# --- watch-stream chaos: cache + DeviceClusterState convergence ---------------
+
+
+def _pods_match(cluster: ApiServerCluster, server: FakeApiServer) -> bool:
+    want = {
+        name
+        for (_, name) in server._objects.get("pods", {})
+    }
+    have = {p.name for p in cluster.list_pods()}
+    return want == have
+
+
+class TestWatchChaos:
+    def test_duplicate_and_reordered_events_converge(self):
+        server, cluster = chaos_backend()
+        try:
+            faultpoints.seed(7)
+            faultpoints.arm("watch.event", "duplicate", rate=0.3)
+            faultpoints.arm("watch.event", "reorder", rate=0.3)
+            for i in range(40):
+                server.seed("pods", convert.pod_to_kube(
+                    PodSpec(name=f"w{i}", unschedulable=True)
+                ))
+            for i in range(0, 40, 3):
+                server.handle("DELETE", f"/api/v1/namespaces/default/pods/w{i}")
+            assert wait_until(lambda: _pods_match(cluster, server)), (
+                "cache never converged under duplicate/reordered events"
+            )
+            assert faultpoints.fired("watch.event") > 0
+        finally:
+            cluster.close()
+
+    def test_stream_tears_resume_from_rv_without_relist(self):
+        server, cluster = chaos_backend()
+        cluster.api.WATCH_BACKOFF_BASE_S = 0.01
+        try:
+            before = KUBE_API_RETRY_TOTAL.get("watch", "reset")
+            faultpoints.seed(11)
+            faultpoints.arm("watch.event", "tear", rate=0.2, count=4)
+            for i in range(30):
+                server.seed("pods", convert.pod_to_kube(PodSpec(name=f"t{i}")))
+            assert wait_until(lambda: _pods_match(cluster, server))
+            assert wait_until(
+                lambda: KUBE_API_RETRY_TOTAL.get("watch", "reset") > before
+            )
+            assert cluster.resync_count == 0  # rv resume, no 410 re-list
+        finally:
+            cluster.close()
+
+    def test_dropped_event_heals_via_410_relist(self):
+        server, cluster = chaos_backend()
+        cluster.api.WATCH_BACKOFF_BASE_S = 0.01
+        try:
+            faultpoints.arm("watch.event", "drop-410", rate=1.0, count=2)
+            for i in range(20):
+                server.seed("pods", convert.pod_to_kube(PodSpec(name=f"d{i}")))
+            assert wait_until(lambda: _pods_match(cluster, server)), (
+                "re-list never rebuilt the dropped events"
+            )
+            assert wait_until(lambda: cluster.resync_count >= 1)
+        finally:
+            cluster.close()
+
+    def test_watch_open_faults_backed_off_and_recovered(self):
+        server, cluster = chaos_backend()
+        cluster.api.WATCH_BACKOFF_BASE_S = 0.01
+        try:
+            faultpoints.arm("watch.open", "tear", count=8)
+            server.drop_watch_connections()  # force every pump to reconnect
+            server.seed("pods", convert.pod_to_kube(PodSpec(name="reborn")))
+            assert wait_until(
+                lambda: any(p.name == "reborn" for p in cluster.list_pods())
+            )
+        finally:
+            cluster.close()
+
+
+class TestDeviceClusterStateUnderChaos:
+    def assert_parity(self, state, cluster, where):
+        import numpy as np
+
+        from karpenter_tpu.ops.encode import group_pods
+
+        got = state.pending_groups()
+        want = group_pods(
+            [p for p in cluster.list_pods() if p.is_provisionable()]
+        )
+        assert np.array_equal(got.vectors, want.vectors), where
+        assert np.array_equal(got.counts, want.counts), where
+
+    def test_converges_under_duplicate_reorder_and_relist(self):
+        from karpenter_tpu.models.cluster_state import DeviceClusterState
+
+        server, cluster = chaos_backend()
+        state = DeviceClusterState(cluster)
+        try:
+            faultpoints.seed(23)
+            faultpoints.arm("watch.event", "duplicate", rate=0.25)
+            faultpoints.arm("watch.event", "reorder", rate=0.25)
+            faultpoints.arm("watch.event", "drop-410", rate=0.02)
+            for i in range(48):
+                server.seed("pods", convert.pod_to_kube(fixtures.pod(name=f"s{i}")))
+            for i in range(0, 48, 4):
+                server.handle("DELETE", f"/api/v1/namespaces/default/pods/s{i}")
+            assert wait_until(lambda: _pods_match(cluster, server))
+            faultpoints.disarm_all()  # quiesce, then audit
+            self.assert_parity(state, cluster, "post-chaos")
+        finally:
+            cluster.close()
+
+
+class TestChaosOverHttpTransport:
+    def test_faults_inject_over_the_real_wire(self):
+        """ChaosTransport is transport-agnostic: the same armed sites fire
+        over HttpTransport's real sockets, and the envelope absorbs them."""
+        server = FakeApiServer()
+        httpd = serve_http(server)
+        port = httpd.server_address[1]
+        try:
+            server.seed("nodes", {"metadata": {"name": "n1"}})
+            client = make_client(
+                ChaosTransport(HttpTransport(f"http://127.0.0.1:{port}"))
+            )
+            faultpoints.arm("api.request.get", "reset", count=2)
+            before = KUBE_API_RETRY_TOTAL.get("get", "reset")
+            assert client.get("/api/v1/nodes/n1")["metadata"]["name"] == "n1"
+            assert KUBE_API_RETRY_TOTAL.get("get", "reset") - before == 2
+            faultpoints.arm("api.request.post", "conflict", count=1)
+            with pytest.raises(ApiError) as error:
+                client.create(
+                    "/api/v1/namespaces/default/pods",
+                    convert.pod_to_kube(PodSpec(name="wired")),
+                )
+            assert error.value.status == 409
+        finally:
+            httpd.shutdown()
+
+
+# --- the watch read-deadline (satellite: stalled apiserver) -------------------
+
+
+class TestWatchIdleDeadline:
+    def test_stalled_stream_torn_by_read_deadline(self):
+        """An apiserver that stops sending bytes without closing the socket
+        (faultpoint watch.stall) must tear the stream at watch_idle_s — the
+        stream used to open with timeout=None and hang the pump forever."""
+        server = FakeApiServer()
+        httpd = serve_http(server)
+        port = httpd.server_address[1]
+        try:
+            transport = HttpTransport(
+                f"http://127.0.0.1:{port}", watch_idle_s=0.4
+            )
+            faultpoints.arm("watch.stall", "stall", delay_s=8.0, count=1)
+            events = transport.stream("/api/v1/pods", "watch=true")
+            threading.Timer(
+                0.15,
+                lambda: server.seed(
+                    "pods", convert.pod_to_kube(PodSpec(name="held"))
+                ),
+            ).start()
+            began = time.monotonic()
+            with pytest.raises(TransportError) as error:
+                next(events)
+            elapsed = time.monotonic() - began
+            assert error.value.reason == "idle-timeout"
+            assert elapsed < 4.0, "read deadline never fired; waited for the server"
+        finally:
+            httpd.shutdown()
+
+    def test_pump_recovers_after_stall_tear(self):
+        """Pump-level: the torn stream reconnects and replays the held
+        events from history — the stall costs latency, never data."""
+        server = FakeApiServer()
+        httpd = serve_http(server)
+        port = httpd.server_address[1]
+        try:
+            transport = HttpTransport(
+                f"http://127.0.0.1:{port}", watch_idle_s=0.3
+            )
+            client = make_client(transport)
+            client.WATCH_BACKOFF_BASE_S = 0.01
+            _, rv = client.list_with_rv("/api/v1/pods")
+            seen = []
+            stop = threading.Event()
+            pump = threading.Thread(
+                target=client.watch,
+                args=("/api/v1/pods", lambda t, o: seen.append(o), stop),
+                kwargs={"resource_version": rv},
+                daemon=True,
+            )
+            pump.start()
+            time.sleep(0.1)  # let the first stream subscribe
+            faultpoints.arm("watch.stall", "stall", delay_s=6.0, count=1)
+            server.seed("pods", convert.pod_to_kube(PodSpec(name="held")))
+            assert wait_until(
+                lambda: any(
+                    (o.get("metadata") or {}).get("name") == "held" for o in seen
+                ),
+                timeout=5.0,
+            ), "held event never replayed after the stall tear"
+            stop.set()
+            transport_close = getattr(transport, "close", None)
+            if transport_close:
+                transport_close()
+            pump.join(timeout=3.0)
+        finally:
+            httpd.shutdown()
+
+
+# --- sweep-loop degradation ---------------------------------------------------
+
+
+class TestSweepLoopDegradation:
+    def test_error_backoff_escalates_and_resets(self):
+        from karpenter_tpu.runtime import ReconcileLoop, SWEEP_FAILURES_TOTAL
+
+        calls = {"fail": True}
+
+        def reconcile(key):
+            if calls["fail"]:
+                raise ConnectionResetError("api storm")
+            return None
+
+        loop = ReconcileLoop("chaos-test", reconcile)
+        before = SWEEP_FAILURES_TOTAL.get("chaos-test", "ConnectionResetError")
+        loop._reconcile_chunk(["sweep"])
+        assert loop._err_streak["sweep"] == 1
+        loop._reconcile_chunk(["sweep"])
+        loop._reconcile_chunk(["sweep"])
+        assert loop._err_streak["sweep"] == 3
+        assert (
+            SWEEP_FAILURES_TOTAL.get("chaos-test", "ConnectionResetError") - before
+            == 3
+        )
+        # Third failure requeued at base * 2^2; the entry sits in the heap.
+        assert loop._due["sweep"] > 0
+        calls["fail"] = False
+        loop._reconcile_chunk(["sweep"])
+        assert "sweep" not in loop._err_streak  # success resets the streak
+
+    def test_backoff_delay_is_capped(self):
+        from karpenter_tpu.runtime import ReconcileLoop
+
+        loop = ReconcileLoop("chaos-cap", lambda key: None)
+        for _ in range(20):
+            delay = loop._error_backoff_s("k")
+        assert delay == loop.ERROR_BACKOFF_CAP_S
+
+    def test_failing_sweep_keeps_its_loop_thread_alive(self):
+        from karpenter_tpu.runtime import ReconcileLoop
+
+        state = {"failures": 0, "succeeded": threading.Event()}
+
+        def reconcile(key):
+            if state["failures"] < 2:
+                state["failures"] += 1
+                raise TransportError("apiserver down", reason="reset")
+            state["succeeded"].set()
+            return None
+
+        loop = ReconcileLoop("chaos-live", reconcile)
+        loop.ERROR_BACKOFF_BASE_S = 0.02
+        loop.start()
+        try:
+            loop.enqueue("sweep")
+            assert state["succeeded"].wait(timeout=5.0), (
+                "sweep never re-entered after failures"
+            )
+            assert all(t.is_alive() for t in loop._threads), (
+                "a failed sweep killed its loop thread"
+            )
+        finally:
+            loop.stop()
+
+    def test_watch_reconnect_backoff_bounds_a_dead_apiserver(self):
+        """A persistently failing stream must not hot-loop: attempts in a
+        fixed window stay bounded by the exponential backoff."""
+
+        class DeadTransport(Transport):
+            def __init__(self):
+                self.opens = 0
+
+            def request(self, method, path, query="", body=None, timeout_s=None):
+                return 200, {}
+
+            def stream(self, path, query=""):
+                self.opens += 1
+                raise TransportError("down", reason="reset")
+
+        transport = DeadTransport()
+        client = make_client(transport)
+        client.WATCH_BACKOFF_BASE_S = 0.05
+        client.WATCH_BACKOFF_CAP_S = 0.2
+        stop = threading.Event()
+        pump = threading.Thread(
+            target=client.watch,
+            args=("/api/v1/pods", lambda t, o: None, stop),
+            daemon=True,
+        )
+        pump.start()
+        time.sleep(0.7)
+        stop.set()
+        pump.join(timeout=2.0)
+        assert 2 <= transport.opens <= 12, (
+            f"{transport.opens} reconnects in 0.7s — backoff missing or stuck"
+        )

@@ -221,6 +221,34 @@ CASES = [
         """,
     ),
     (
+        # Raw kube RPCs bypassing the retry envelope (ISSUE 10 satellite):
+        # transport.request/stream are owned by KubeClient.
+        "transport-discipline",
+        """
+        def list_pods(client):
+            status, payload = client.transport.request("GET", "/api/v1/pods")
+            for event in client.transport.stream("/api/v1/pods"):
+                pass
+            return status, payload
+        """,
+        """
+        class Wrapper:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def request(self, method, path, query="", body=None, timeout_s=None):
+                '''Forwarding through a WRAPPED transport (named inner, the
+                chaos-wrapper shape) is not an envelope bypass.'''
+                return self.inner.request(method, path, query, body)
+
+        def list_pods(client):
+            return client.list("/api/v1/pods")
+
+        def shut_down(client):
+            client.transport.close()
+        """,
+    ),
+    (
         "fetch-discipline",
         """
         import jax
@@ -478,4 +506,4 @@ def test_production_tree_is_vet_clean():
 
 def test_checker_names_unique():
     names = [checker.name for checker in ALL_CHECKERS]
-    assert len(names) == len(set(names)) == 8
+    assert len(names) == len(set(names)) == 9

@@ -1,7 +1,15 @@
 """Checker registry. Each checker module exports CHECKERS (a tuple of
 framework.Checker); ALL_CHECKERS is the suite `python -m tools.vet` runs."""
 
-from tools.vet.checkers import backend, clocks, crash, fetch, locks, metricsuse
+from tools.vet.checkers import (
+    backend,
+    clocks,
+    crash,
+    fetch,
+    locks,
+    metricsuse,
+    transport,
+)
 
 ALL_CHECKERS = (
     *locks.CHECKERS,
@@ -10,6 +18,7 @@ ALL_CHECKERS = (
     *metricsuse.CHECKERS,
     *backend.CHECKERS,
     *fetch.CHECKERS,
+    *transport.CHECKERS,
 )
 
 CHECKERS_BY_NAME = {checker.name: checker for checker in ALL_CHECKERS}

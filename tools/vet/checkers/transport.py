@@ -1,0 +1,82 @@
+"""transport-discipline: kube API requests go through the retry envelope,
+never raw.
+
+The control plane's whole degradation story (docs/design/chaos.md) rests on
+every apiserver round trip crossing ONE envelope —
+``KubeClient._request_enveloped`` — which owns the per-verb deadlines,
+capped backoff with jitter, Retry-After honoring, and the retry metrics.
+One stray ``transport.request(...)`` call re-grows an unretried, untimed,
+unmetered RPC that a single connection reset turns into a dead controller
+thread. Same for watch streams: ``transport.stream(...)`` is owned by
+``KubeClient.watch``, the reconnect-with-backoff reflector loop.
+
+The rule is syntactic and deliberately conservative: any call whose dotted
+chain ends ``...transport.request(...)`` or ``...transport.stream(...)``
+(``self.transport``, ``cluster.api.transport``, a bare ``transport`` local)
+must sit in an allowlisted scope. Transports forwarding to a WRAPPED
+transport name it ``inner`` (kubeapi/chaos.py) precisely so wrapping never
+reads as an envelope bypass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.vet.framework import (
+    Checker,
+    Finding,
+    Module,
+    scope_allows,
+    walk_with_qualname,
+)
+
+NAME = "transport-discipline"
+
+ALLOWED = {
+    "karpenter_tpu/kubeapi/client.py::KubeClient._request_enveloped":
+        "THE retry envelope",
+    "karpenter_tpu/kubeapi/client.py::KubeClient._consume_stream":
+        "one connection of the reflector loop (KubeClient.watch owns "
+        "reconnect-with-backoff around it)",
+}
+
+VERBS = ("request", "stream")
+
+
+def _is_transport_call(func: ast.AST) -> bool:
+    """True for ``<chain ending in transport>.request/stream``."""
+    if not (isinstance(func, ast.Attribute) and func.attr in VERBS):
+        return False
+    owner = func.value
+    if isinstance(owner, ast.Name):
+        return owner.id == "transport"
+    return isinstance(owner, ast.Attribute) and owner.attr == "transport"
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in modules:
+        for node, qual in walk_with_qualname(module.tree):
+            if not (isinstance(node, ast.Call) and _is_transport_call(node.func)):
+                continue
+            if scope_allows(ALLOWED, module.rel, qual):
+                continue
+            findings.append(
+                Finding(
+                    checker=NAME,
+                    file=module.rel,
+                    line=node.lineno,
+                    key=f"raw-{node.func.attr}:{qual or '<module>'}",
+                    message=(
+                        f"raw transport.{node.func.attr}() outside the retry "
+                        "envelope — route through KubeClient (verbs) or "
+                        "KubeClient.watch (streams) so deadlines, backoff, "
+                        "and kube_api_retry_total cover it"
+                    ),
+                )
+            )
+    return findings
+
+
+CHECKERS = (Checker(NAME, _check),)
