@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -96,6 +96,17 @@ encode-smoke:
 chaos-smoke:
 	timeout -k 10 180 python tools/chaos_smoke.py
 
+# The multichip guard (tools/multichip_smoke.py): the 8-device dryrun —
+# sharded fused solve, bit-identical single-device parity, wedged-chip
+# mesh shrink — completed rc 0 inside a hard budget, with the per-phase
+# JSON tail asserted (an r05-class silent rc:124 becomes a named, phased
+# failure here first). Skips cleanly off-platform (no importable jax).
+# The 540s timeout backstops the smoke's own 480s subprocess budget,
+# which in turn exceeds the dryrun's 420s phase-budget sum — each layer
+# fails with MORE diagnostics than the one above it.
+multichip-smoke:
+	timeout -k 10 540 python tools/multichip_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -107,6 +118,7 @@ smoke:
 	$(MAKE) fetch-smoke || rc=1; \
 	$(MAKE) encode-smoke || rc=1; \
 	$(MAKE) chaos-smoke || rc=1; \
+	$(MAKE) multichip-smoke || rc=1; \
 	exit $$rc
 
 proto:

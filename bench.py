@@ -568,6 +568,71 @@ def _backend_platform() -> str:
         return "unknown"
 
 
+def bench_multichip(groups, fleet, reps: int = 5) -> dict:
+    """The multichip cell: sharded-vs-single fused dispatch at the headline
+    shape, with the mesh shape and per-device memory high-water stamped in.
+
+    The speedup claim is REFUSED when n_devices == 1 — the multichip
+    analogue of PR 6's device_unavailable guard: a single-device run has no
+    mesh, and printing a "sharded speedup" there would record a no-op
+    comparison as a multichip win (the r05 mistake, one layer up)."""
+    import jax
+
+    from karpenter_tpu.models import solver as solver_mod
+    from karpenter_tpu.utils import backend_health
+
+    import __graft_entry__
+
+    n_devices = jax.device_count()
+    cell = {
+        "n_devices": int(n_devices),
+        "wedged_chips": sorted(backend_health.wedged_chips()),
+    }
+    mesh = solver_mod.solve_mesh()
+    if n_devices <= 1 or mesh is None:
+        cell["mesh"] = None
+        cell["vs_single_device"] = None
+        cell["refused"] = (
+            "n_devices == 1: no mesh, no multichip claim"
+            if n_devices <= 1
+            else "sharded solve inactive (KARPENTER_SHARDED_SOLVE=0 or mesh degraded to one chip)"
+        )
+        return cell
+    cell["mesh"] = dict(
+        zip(mesh.axis_names, (int(s) for s in mesh.devices.shape))
+    )
+
+    def dispatch_p50(repetitions: int) -> float:
+        samples = []
+        for _ in range(repetitions):
+            start = time.perf_counter()
+            handle = solver_mod.cost_solve_dispatch(
+                groups.vectors, groups.counts, fleet.capacity, fleet.total,
+                fleet.prices, 300, count=False,
+            )
+            solver_mod.fetch_plan(handle)
+            samples.append((time.perf_counter() - start) * 1e3)
+        return float(np.percentile(samples, 50))
+
+    import os
+
+    dispatch_p50(1)  # warm the sharded bucket
+    cell["sharded_solve_p50_ms"] = round(dispatch_p50(reps), 2)
+    os.environ["KARPENTER_SHARDED_SOLVE"] = "0"
+    try:
+        dispatch_p50(1)  # warm the single-device bucket
+        cell["single_device_solve_p50_ms"] = round(dispatch_p50(reps), 2)
+    finally:
+        del os.environ["KARPENTER_SHARDED_SOLVE"]
+    cell["vs_single_device"] = round(
+        cell["single_device_solve_p50_ms"] / cell["sharded_solve_p50_ms"], 3
+    ) if cell["sharded_solve_p50_ms"] else None
+    cell["memory_high_water_bytes"] = __graft_entry__._device_memory_high_water(
+        jax
+    )
+    return cell
+
+
 def main():
     from karpenter_tpu.ops.pack_kernel import suppress_donation_advisory
 
@@ -881,7 +946,13 @@ def main():
     for label, (n_pods, n_types) in {
         "s1_100k_400": (100_000, 400),
         "s2_200k_800": (200_000, 800),
+        # Beyond one device's comfort: the 500k x 800 cell is the mesh
+        # story's reason to exist (ISSUE 11) — the [G, T] score tensor at
+        # this scale is what the sharded solve splits over ICI. Fewer reps:
+        # each leg is seconds, and p50-of-3 is stable at this size.
+        "s3_500k_800": (500_000, 800),
     }.items():
+        solve_reps, base_reps = (3, 2) if n_pods >= 500_000 else (5, 3)
         s_pods, s_catalog, s_market = make_workload(
             num_pods=n_pods, num_types=n_types
         )
@@ -892,12 +963,12 @@ def main():
         )
         solver.solve_encoded(s_groups, s_fleet)  # warm (new type bucket)
         s_lat = []
-        for _ in range(5):
+        for _ in range(solve_reps):
             start = time.perf_counter()
             s_ours = solver.solve_encoded(s_groups, s_fleet)
             s_lat.append((time.perf_counter() - start) * 1e3)
         s_base = []
-        for _ in range(3):
+        for _ in range(base_reps):
             start = time.perf_counter()
             s_greedy = baseline_solver.solve_encoded(s_groups, s_fleet)
             s_base.append((time.perf_counter() - start) * 1e3)
@@ -943,6 +1014,17 @@ def main():
                 s_speedup < 1.0 and stretch_cell["cost_ratio"] < 1.0
             )
         stretch[label] = stretch_cell
+    # The 500k workload is ~10x the headline's heap; release it before the
+    # storm pipelines measure against their own allocations.
+    del s_pods, s_catalog, s_market, s_groups, s_fleet, s_ours, s_greedy
+    import gc
+
+    gc.collect()
+
+    # Multichip: sharded-vs-single at the headline shape, mesh shape and
+    # per-device memory high-water stamped; the speedup claim is refused
+    # outright on a single-device runtime (no mesh, no multichip claim).
+    multichip = bench_multichip(groups, fleet)
 
     # Watch->selection->batch->solve->bind pipeline under a 10k-pod storm,
     # per selection-concurrency setting (justifies Options.selection_concurrency).
@@ -1016,6 +1098,7 @@ def main():
                 "bind_10k_ms": round(bench_bind(), 1),
                 "configs": configs,
                 "stretch": stretch,
+                "multichip": multichip,
                 "pod_storm_10k": pod_storm,
                 "pod_storm_50k": pod_storm_50k,
                 # Steady-state churn + consolidation convergence (fake

@@ -216,7 +216,7 @@ class NativeSolver(Solver):
 
 def _cost_fused_body(
     vectors, counts, capacity, total, valid, prices, *, lp_steps: int,
-    constrain=None, replicate=None,
+    constrain=None, compact=None,
 ):
     """All three CostSolver candidates as ONE XLA computation: greedy-FFD
     rounds, cost-greedy rounds, and the LP relaxation. Fusing them means a
@@ -242,12 +242,14 @@ def _cost_fused_body(
     `constrain` shards the LP's [G, T] tensors over a device mesh on the
     multi-chip path (see _sharded_fused_kernel); the sequential pack rounds
     stay replicated — they are lax.while_loop state machines with no
-    parallelizable [G, T] bulk. `replicate`, also supplied only by the
-    sharded kernel, pins the compaction's inputs to a replicated layout:
-    the prefix-sum + scatter compaction is a sequential post-pass, and
-    letting GSPMD partition it produces corrupted COO entries (observed:
+    parallelizable [G, T] bulk. `compact`, also supplied only by the
+    sharded kernel, swaps the compaction for the shard-local one
+    (ops/pack_kernel.compact_plan_sharded): each device compacts its own
+    G block and only the compacted COO segments ride the collective. The
+    hook replaces PR 6's force-replicate pin — letting GSPMD partition the
+    plain prefix-sum + scatter produced corrupted COO entries (observed:
     shard-strided indices and a shard-multiplied nnz on an 8-way CPU
-    mesh)."""
+    mesh); shard_map's manual partitioning sidesteps that entirely."""
     valid_prices = jnp.where(valid, prices, jnp.inf)
     # [T, T'] dominance + masked min as a VMEM-resident pallas kernel on TPU
     # (ops/pallas_kernels.py), XLA formulation elsewhere.
@@ -284,14 +286,10 @@ def _cost_fused_body(
         + rounds_ints(rounds_cost)
         + [feasible_any.astype(jnp.int32).ravel()]
     )
-    compact_ffd, compact_cost, compact_feasible = rounds_ffd, rounds_cost, feasible_any
-    if replicate is not None:
-        compact_ffd = jax.tree_util.tree_map(replicate, compact_ffd)
-        compact_cost = jax.tree_util.tree_map(replicate, compact_cost)
-        compact_feasible = replicate(compact_feasible)
-    compact = compact_plan(compact_ffd, compact_cost, compact_feasible)
+    compact_fn = compact_plan if compact is None else compact
+    compacted = compact_fn(rounds_ffd, rounds_cost, feasible_any)
     objective = lp.objective.reshape(1).astype(jnp.float32)
-    return compact, objective, dense_ints, lp.assignment.ravel()
+    return compacted, objective, dense_ints, lp.assignment.ravel()
 
 
 def unpack_dense(ints: np.ndarray, num_groups: int) -> Tuple:
@@ -338,6 +336,7 @@ class FusedHandle(NamedTuple):
     lp: object  # [G*T] float32 — deferred LP assignment
     num_groups: int  # padded G
     num_types: int  # padded T
+    shards: int = 1  # mesh device count of a sharded dispatch (compact layout)
 
     @property
     def eager(self):
@@ -351,11 +350,11 @@ _cost_fused_kernel = functools.partial(
     # never be donated.
     jax.jit(
         _cost_fused_body,
-        static_argnames=("lp_steps", "constrain", "replicate"),
+        static_argnames=("lp_steps", "constrain", "compact"),
         donate_argnums=(0, 1),
     ),
     constrain=None,
-    replicate=None,
+    compact=None,
 )
 
 _cost_fused_kernel_nodonate = functools.partial(
@@ -366,10 +365,10 @@ _cost_fused_kernel_nodonate = functools.partial(
     # (docs/design/incremental-encode.md), so those solves route here.
     jax.jit(
         _cost_fused_body,
-        static_argnames=("lp_steps", "constrain", "replicate"),
+        static_argnames=("lp_steps", "constrain", "compact"),
     ),
     constrain=None,
-    replicate=None,
+    compact=None,
 )
 
 
@@ -410,13 +409,25 @@ def fetch_plans(handles: Sequence[FusedHandle]) -> List["FetchedPlan"]:
     eager payload (a batch shares one round trip), then host-side decode.
     A plan that overflowed the COO entry budget falls back to its dense
     spill — correctness never depends on the budget."""
-    from karpenter_tpu.ops.pack_kernel import decompact_plan
+    from karpenter_tpu.ops.pack_kernel import decompact_plan_sharded
 
-    eager = _to_host([handle.eager for handle in handles])
+    try:
+        eager = _to_host([handle.eager for handle in handles])
+    except Exception as error:  # noqa: BLE001 — quarantine, then re-raise
+        # The dispatch is async, so a chip that dies DURING execution
+        # surfaces here, not at cost_solve_dispatch — without this hook the
+        # mesh would never shrink and every subsequent solve would re-fail
+        # on the dead chip. This solve still fails (the caller's fallback
+        # ladder handles it); the quarantine makes the NEXT one re-lower
+        # on the survivors. (An in-C hang is out of in-process reach —
+        # that detection belongs to the killable probe + the runbook alert
+        # on backend_wedged_chips; see docs/design/sharded-solve.md.)
+        _quarantine_after_fetch_failure(handles, error)
+        raise
     plans: List[FetchedPlan] = []
     for handle, (compact, objective) in zip(handles, eager):
-        rounds_ffd, rounds_cost, feasible_any, ok = decompact_plan(
-            np.asarray(compact), handle.num_groups
+        rounds_ffd, rounds_cost, feasible_any, ok = decompact_plan_sharded(
+            np.asarray(compact), handle.num_groups, handle.shards
         )
         if not ok:  # pragma: no cover — entry budget sized to never trip
             rounds_ffd, rounds_cost, feasible_any = unpack_dense(
@@ -438,6 +449,29 @@ def fetch_plan(handle: FusedHandle) -> "FetchedPlan":
     return fetch_plans([handle])[0]
 
 
+def _quarantine_after_fetch_failure(
+    handles: Sequence[FusedHandle], error: BaseException
+) -> None:
+    """A device->host fetch of sharded solve outputs failed: run the
+    wedged-chip quarantine over the whole device set (the probe marks only
+    non-responders, so passing every id is safe) so the next dispatch
+    shrinks the mesh. No-op for purely single-device handles — a dead
+    single device is the whole-device verdict's territory."""
+    if not any(handle.shards > 1 for handle in handles):
+        return
+    try:
+        from karpenter_tpu.utils import backend_health
+
+        backend_health.quarantine_mesh(
+            [int(d.id) for d in jax.devices()], error
+        )
+    except Exception:  # noqa: BLE001 — diagnosis must not mask the fetch error
+        klog.named("solver").warning(
+            "wedged-chip quarantine after fetch failure itself failed",
+            exc_info=True,
+        )
+
+
 _SHARDED_KERNEL_CACHE: Dict[Tuple, Tuple] = {}
 
 
@@ -446,13 +480,20 @@ def _sharded_fused_kernel(mesh=None):
     _cost_fused_kernel, but every [G, T] LP tensor carries a
     with_sharding_constraint over the ("groups", "types") mesh so GSPMD
     partitions the softmax/einsum/Adam bulk across chips over ICI, while the
-    sequential pack rounds replicate. Returns (kernel, (g_mult, t_mult)):
-    callers must pad G/T to those multiples on top of the bucket ladder.
+    sequential pack rounds replicate. Plan compaction runs SHARD-LOCAL
+    (ops/pack_kernel.compact_plan_sharded): each device compacts its own G
+    block and only the compacted COO segments — not the dense [MR, G] round
+    state — ride the collective at the tail. Returns
+    (kernel, (g_mult, t_mult), shards): callers must pad G/T to those
+    multiples on top of the bucket ladder (g_mult is the TOTAL device count
+    so the compaction blocks split evenly over every chip) and decode the
+    compact payload with the `shards`-segment layout.
 
     One executable, one dispatch, one fetch — the multi-chip path keeps the
     single-round-trip property of the single-chip path (SURVEY.md §2.7:
     "sharded across TPU devices over ICI when the problem exceeds one chip")."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from karpenter_tpu.ops.pack_kernel import compact_plan_sharded
     from karpenter_tpu.parallel.mesh import GROUPS_AXIS, TYPES_AXIS, make_mesh
 
     mesh = mesh or make_mesh()
@@ -462,27 +503,35 @@ def _sharded_fused_kernel(mesh=None):
         return cached
     gt_sharding = NamedSharding(mesh, P(GROUPS_AXIS, TYPES_AXIS))
     replicated = NamedSharding(mesh, P())
+    shards = int(mesh.devices.size)
 
     def constrain(x):
         return jax.lax.with_sharding_constraint(x, gt_sharding)
 
-    def replicate(x):
-        return jax.lax.with_sharding_constraint(x, replicated)
-
+    # Eager leaves + dense spill replicate so every process of a multi-host
+    # slice can fetch them without touching non-addressable shards
+    # (parallel/spmd.py); the deferred [G*T] LP assignment STAYS SHARDED on
+    # a single-host mesh — it is fetched rarely (only when the realization
+    # pass runs), and replicating it would all-gather the one bulk tensor
+    # the mesh exists to split. Multi-host keeps it replicated: rank 0 must
+    # be able to fetch the whole array from addressable shards.
+    lp_sharding = (
+        replicated
+        if jax.process_count() > 1
+        else NamedSharding(mesh, P((GROUPS_AXIS, TYPES_AXIS)))
+    )
     kernel = functools.partial(
         jax.jit(
             _cost_fused_body,
-            static_argnames=("lp_steps", "constrain", "replicate"),
-            # Replicated outputs: every process (and every device) holds the
-            # full result, so rank 0 of a multi-host slice can fetch it
-            # without touching non-addressable shards (parallel/spmd.py).
-            out_shardings=NamedSharding(mesh, P()),
+            static_argnames=("lp_steps", "constrain", "compact"),
+            out_shardings=(replicated, replicated, replicated, lp_sharding),
         ),
         constrain=constrain,
-        replicate=replicate,
+        compact=functools.partial(compact_plan_sharded, mesh=mesh),
     )
     groups_size, types_size = mesh.devices.shape
-    cached = (kernel, (int(groups_size), int(types_size)))
+    del groups_size  # g_mult is the total device count, not the groups axis
+    cached = (kernel, (shards, int(types_size)), shards)
     _SHARDED_KERNEL_CACHE[key] = cached
     return cached
 
@@ -491,7 +540,13 @@ def sharded_solve_active() -> bool:
     """True iff solve_mesh() would return a mesh — THE sharded-solve
     predicate, mesh-free so gates can call it per solve. Shared by
     solve_mesh and host_solve_enabled so the dispatch gate can never drift
-    from the actual mesh policy."""
+    from the actual mesh policy. A chip quarantined by BackendHealth
+    (report_chip_wedged / quarantine_mesh) shrinks the usable set but the
+    dispatch STAYS on the mesh machinery even at one survivor: a 1-device
+    mesh pins the kernel to the healthy chip, whereas the plain
+    single-device path would run on jax's default device — which may be
+    the wedged chip itself. Only a fully dead device set leaves the mesh
+    (and falls to the whole-device DEGRADED verdict's CPU pin)."""
     import os
 
     if os.environ.get("KARPENTER_SHARDED_SOLVE", "").lower() in (
@@ -500,13 +555,22 @@ def sharded_solve_active() -> bool:
         "off",
     ):
         return False
-    return _multi_device()
+    if not _multi_device():
+        return False
+    from karpenter_tpu.utils import backend_health
+
+    if not backend_health.mesh_degraded():
+        return True
+    return _device_count() - len(backend_health.wedged_chips()) >= 1
 
 
 def solve_mesh():
-    """The production mesh policy: shard the fused solve when the runtime has
-    more than one accelerator (KARPENTER_SHARDED_SOLVE=0 forces the
-    single-device path). Returns a Mesh or None."""
+    """The production mesh policy: shard the fused solve when the runtime
+    has more than one accelerator (KARPENTER_SHARDED_SOLVE=0 forces the
+    single-device path). Wedged chips are excluded by make_mesh, so a
+    quarantined chip shrinks the mesh and the next dispatch re-lowers on
+    the survivors — down to a 1-device mesh pinned to the last healthy
+    chip (see sharded_solve_active). Returns a Mesh or None."""
     if not sharded_solve_active():
         return None
     from karpenter_tpu.parallel.mesh import make_mesh
@@ -515,16 +579,25 @@ def solve_mesh():
 
 
 _MULTI_DEVICE: Optional[bool] = None
+_DEVICE_COUNT: Optional[int] = None
+
+
+def _device_count() -> int:
+    """Cached jax.device_count() — the device topology is fixed for the
+    process lifetime, and probing it per solve would pay (on first call) a
+    backend initialization inside the very gate whose host path exists to
+    avoid touching the device. (Chip HEALTH is not cached here — wedged
+    chips come from BackendHealth per call.)"""
+    global _DEVICE_COUNT
+    if _DEVICE_COUNT is None:
+        _DEVICE_COUNT = jax.device_count()
+    return _DEVICE_COUNT
 
 
 def _multi_device() -> bool:
-    """Cached jax.device_count() > 1 — the device topology is fixed for the
-    process lifetime, and probing it per solve would pay (on first call) a
-    backend initialization inside the very gate whose host path exists to
-    avoid touching the device."""
     global _MULTI_DEVICE
     if _MULTI_DEVICE is None:
-        _MULTI_DEVICE = jax.device_count() > 1
+        _MULTI_DEVICE = _device_count() > 1
     return _MULTI_DEVICE
 
 
@@ -1436,19 +1509,11 @@ def cost_solve_dispatch(
             # sorted gather): same math, NO donation — the handle stays
             # readable after the solve.
             out = _cost_fused_kernel_nodonate(*padded, lp_steps=lp_steps)
+        shards = 1
     else:
-        kernel, (g_mult, t_mult) = _sharded_fused_kernel(mesh)
-        padded = pad_kernel_args(
-            vectors, counts, capacity, total, prices, g_mult=g_mult, t_mult=t_mult
+        out, padded, shards = _dispatch_sharded(
+            vectors, counts, capacity, total, prices, lp_steps, mesh
         )
-        if jax.process_count() > 1:
-            # Multi-host slice: every process must dispatch the same program
-            # (SPMD) — replicate this solve to the followers first.
-            from karpenter_tpu.parallel import spmd
-
-            out = spmd.lead_dispatch(kernel, padded, lp_steps)
-        else:
-            out = kernel(*padded, lp_steps=lp_steps)
     compact, objective, dense_ints, lp_flat = out
     return FusedHandle(
         compact=compact,
@@ -1457,7 +1522,61 @@ def cost_solve_dispatch(
         lp=lp_flat,
         num_groups=int(padded[0].shape[0]),
         num_types=int(padded[2].shape[0]),
+        shards=shards,
     )
+
+
+def _dispatch_sharded(vectors, counts, capacity, total, prices, lp_steps, mesh):
+    """Dispatch the mesh-sharded fused kernel, surviving a wedged chip:
+    a dispatch-time failure quarantines the mesh through BackendHealth
+    (per-chip killable probes mark the non-responders wedged), re-lowers on
+    the shrunk mesh, and retries ONCE — the multi-chip analogue of the
+    DEGRADED CPU pin, except the solve stays on the surviving chips
+    (docs/design/sharded-solve.md). With no wedged chip found, or nothing
+    left to shrink to, the original error propagates."""
+
+    def attempt(mesh):
+        kernel, (g_mult, t_mult), shards = _sharded_fused_kernel(mesh)
+        padded = pad_kernel_args(
+            vectors, counts, capacity, total, prices, g_mult=g_mult, t_mult=t_mult
+        )
+        if jax.process_count() > 1:
+            # Multi-host slice: every process must dispatch the same program
+            # (SPMD) — replicate this solve to the followers first.
+            from karpenter_tpu.parallel import spmd
+
+            out = spmd.lead_dispatch(kernel, padded, lp_steps, mesh=mesh)
+        else:
+            out = kernel(*padded, lp_steps=lp_steps)
+        return out, padded, shards
+
+    try:
+        return attempt(mesh)
+    except Exception as error:  # noqa: BLE001 — classified below
+        from karpenter_tpu.parallel import spmd
+        from karpenter_tpu.utils import backend_health
+
+        if isinstance(error, spmd.SpmdUnsupportedError):
+            # A backend-capability error, not a dead chip: probing the mesh
+            # would waste the quarantine budget and mislabel healthy chips.
+            raise
+        wedged = backend_health.quarantine_mesh(
+            [int(d.id) for d in mesh.devices.flat], error
+        )
+        if not wedged:
+            raise
+        retry_mesh = solve_mesh()
+        if retry_mesh is None or jax.process_count() > 1:
+            # No healthy chip left (or a multi-host slice, where a
+            # one-sided shrink would desynchronize the collective order):
+            # surface the failure to the caller's fallback ladder.
+            raise
+        klog.named("solver").warning(
+            "sharded dispatch failed (%s); retrying on shrunk %d-device mesh",
+            error,
+            retry_mesh.devices.size,
+        )
+        return attempt(retry_mesh)
 
 
 def _collect_candidates(fetched, num_groups: int, host_candidates, mix_plan):

@@ -418,6 +418,171 @@ def decompact_plan(
     return plans[0], plans[1], feasible_any, ok
 
 
+# --- shard-local plan compaction ---------------------------------------------
+#
+# On a multi-chip mesh the dense [MR, G] round state used to be force-
+# replicated before compaction (PR 6 pinned it: letting GSPMD partition the
+# prefix-sum + scatter produced shard-strided indices and a shard-multiplied
+# nnz). Shard-local compaction takes manual control instead: shard_map splits
+# the G axis into one contiguous block per device, each device runs the SAME
+# prefix-sum compaction over its own block with a block-local entry budget,
+# and the only collective at the compaction step is the all-gather of the
+# already-compacted segments — a few KB ride the ICI instead of the whole
+# [MR, G] tensor. Decode (decompact_plan_sharded) scatters each shard's
+# entries at its block offset, so the rebuilt dense arrays are bit-identical
+# to the dense path, exactly like the single-device layout.
+
+
+def shard_entry_budget(num_groups: int, shards: int) -> int:
+    """Per-shard COO entry budget: the single-device budget formula applied
+    to the shard's own group block, so the entries-per-group headroom (~8)
+    is the same at every shard count. A shard whose block draws more than
+    its budget signals overflow via nnz and the caller falls back to the
+    dense spill — correctness never depends on the budget."""
+    return entry_budget(num_groups // shards)
+
+
+def compact_words_sharded(num_groups: int, shards: int) -> int:
+    """int32 word count of compact_plan_sharded's payload (shards=1 is
+    exactly the single-device compact_words layout)."""
+    if shards <= 1:
+        return compact_words(num_groups)
+    mr = max_rounds(num_groups)
+    budget = shard_entry_budget(num_groups, shards)
+    per_candidate = mr + mr + 1 + num_groups + 1 + shards * (1 + 2 * budget)
+    return 2 * per_candidate + num_groups
+
+
+def _compact_entry_block(fill_block: jnp.ndarray, budget: int):
+    """The prefix-sum COO compaction of one [MR, G_block] fill matrix into
+    [nnz, entry_idx[budget], entry_fill[budget]] — the shared core of the
+    single-device and shard-local layouts (indices are block-local)."""
+    flat = fill_block.reshape(-1)
+    mask = flat != 0
+    nnz = mask.sum().astype(jnp.int32)
+    position = jnp.cumsum(mask) - 1
+    dest = jnp.where(mask, position, budget)
+    entry_idx = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[dest]
+        .set(jnp.arange(flat.shape[0], dtype=jnp.int32), mode="drop")
+    )
+    entry_fill = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[dest]
+        .set(flat.astype(jnp.int32), mode="drop")
+    )
+    return jnp.concatenate([nnz.reshape(1), entry_idx, entry_fill])
+
+
+def _compact_rounds_sharded(rounds: PackRounds, mesh):
+    """Shard-local compaction of one PackRounds over `mesh`: the replicated
+    header segments (round_type/repl/num_rounds/unschedulable/overflow) plus
+    one [nnz, idx, fill] segment per device, produced by shard_map over the
+    G axis split across BOTH mesh axes (block order = mesh device order)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    num_groups = rounds.round_fill.shape[1]
+    shards = mesh.devices.size
+    budget = shard_entry_budget(num_groups, shards)
+    axes = tuple(mesh.axis_names)
+
+    segments = shard_map(
+        functools.partial(_compact_entry_block, budget=budget),
+        mesh=mesh,
+        in_specs=P(None, axes),
+        out_specs=P(axes),
+        # The block computation is deterministic from its slice; replication
+        # checking can't see that through the scatter, so it is disabled.
+        check_rep=False,
+    )(rounds.round_fill)
+    return [
+        rounds.round_type.astype(jnp.int32),
+        rounds.round_repl.astype(jnp.int32),
+        rounds.num_rounds.reshape(1).astype(jnp.int32),
+        rounds.unschedulable.astype(jnp.int32),
+        rounds.overflow.astype(jnp.int32).reshape(1),
+        segments,
+    ]
+
+
+def compact_plan_sharded(
+    rounds_ffd: PackRounds, rounds_cost: PackRounds, feasible_any, *, mesh
+):
+    """compact_plan's multi-chip twin: both candidate plans plus the
+    feasibility vector as one flat int32 array, with the COO entry lists
+    compacted shard-locally (see the section comment). The G axis must be
+    padded to a multiple of mesh.devices.size (models/solver.pad_kernel_args
+    handles it via g_mult)."""
+    if mesh.devices.size <= 1:
+        return compact_plan(rounds_ffd, rounds_cost, feasible_any)
+    return jnp.concatenate(
+        _compact_rounds_sharded(rounds_ffd, mesh)
+        + _compact_rounds_sharded(rounds_cost, mesh)
+        + [feasible_any.astype(jnp.int32)]
+    )
+
+
+def decompact_plan_sharded(
+    words: np.ndarray, num_groups: int, shards: int
+) -> Tuple[PackRounds, PackRounds, np.ndarray, bool]:
+    """Host-side inverse of compact_plan_sharded: scatter each shard's
+    block-local entries at its block offset. shards=1 delegates to the
+    single-device decoder (identical layout). ok=False when any shard of
+    either plan overflowed its entry budget — the caller must fetch the
+    dense spill instead."""
+    if shards <= 1:
+        return decompact_plan(words, num_groups)
+    mr = max_rounds(num_groups)
+    budget = shard_entry_budget(num_groups, shards)
+    group_block = num_groups // shards
+    cursor = 0
+
+    def take(n):
+        nonlocal cursor
+        out = words[cursor : cursor + n]
+        cursor += n
+        return out
+
+    plans = []
+    ok = True
+    for _ in range(2):
+        round_type = take(mr)
+        round_repl = take(mr)
+        num_rounds = take(1)[0]
+        unschedulable = take(num_groups)
+        overflow = bool(take(1)[0])
+        fill = np.zeros((mr * num_groups,), np.int32)
+        plan_ok = True
+        for shard in range(shards):
+            nnz = int(take(1)[0])
+            entry_idx = take(budget)
+            entry_fill = take(budget)
+            if nnz > budget:
+                plan_ok = False
+                continue
+            rows = entry_idx[:nnz] // group_block
+            cols = shard * group_block + entry_idx[:nnz] % group_block
+            fill[rows * num_groups + cols] = entry_fill[:nnz]
+        if not plan_ok:
+            ok = False
+            plans.append(None)
+            continue
+        plans.append(
+            PackRounds(
+                round_type=round_type,
+                round_fill=fill.reshape(mr, num_groups),
+                round_repl=round_repl,
+                num_rounds=num_rounds,
+                unschedulable=unschedulable,
+                overflow=overflow,
+            )
+        )
+    feasible_any = take(num_groups).astype(bool)
+    return plans[0], plans[1], feasible_any, ok
+
+
 # --- device-resident encode reuse --------------------------------------------
 
 # Content-keyed cache of device handles for padded encode arrays (fleet

@@ -36,7 +36,25 @@ def _factor(n: int) -> Tuple[int, int]:
 
 
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
-    devices = list(devices if devices is not None else jax.devices())
+    """The solver mesh over the HEALTHY device set: chips quarantined by
+    BackendHealth (utils/backend_health.report_chip_wedged — the "1 of N
+    chips wedged" verdict) are excluded, so the mesh shrinks and the next
+    kernel lowering spans only the survivors instead of the process falling
+    back to CPU. An explicit `devices` argument bypasses the filter (tests
+    and the dryrun build meshes over exact device sets)."""
+    if devices is None:
+        from karpenter_tpu.utils import backend_health
+
+        wedged = backend_health.wedged_chips()
+        devices = [d for d in jax.devices() if int(d.id) not in wedged]
+        if not devices:
+            # Every chip quarantined: the caller's gate (solve_mesh) should
+            # have routed away already; fail loudly rather than build an
+            # empty mesh.
+            raise RuntimeError(
+                f"no healthy devices left (wedged: {sorted(wedged)})"
+            )
+    devices = list(devices)
     groups_size, types_size = _factor(len(devices))
     grid = np.array(devices).reshape(groups_size, types_size)
     return Mesh(grid, (GROUPS_AXIS, TYPES_AXIS))

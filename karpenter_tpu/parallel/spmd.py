@@ -5,21 +5,33 @@ global mesh must be dispatched by EVERY process, or the first collective
 deadlocks. Only rank 0 receives solve RPCs (the chart pins the Service to
 pod 0), so each solve is replicated to the slice through this module:
 
-  rank 0   lead_dispatch(): broadcast a fixed-shape header
-           [op, G, T, lp_steps], then the padded operand arrays, then run
-           the mesh-sharded fused kernel — the same call every follower
-           makes.
+  rank 0   SpmdDispatcher.lead_dispatch(): broadcast a fixed-shape header
+           [op, G, T, lp_steps], then the mesh device-mask, then the padded
+           operand arrays, then run the mesh-sharded fused kernel — the
+           same call every follower makes.
   rank >0  follower_loop(): block on the next header broadcast, rebuild the
-           operand shapes from it, receive the arrays, run the SAME kernel,
-           and wait for the next header. An OP_STOP header exits the loop
-           (lead_stop() on clean shutdown; a dead coordinator surfaces as a
-           collective error, which also exits).
+           mesh from the device-mask and the operand shapes from the
+           header, receive the arrays, run the SAME kernel, and wait for
+           the next header. An OP_STOP header exits the loop (lead_stop()
+           on clean shutdown; a dead coordinator surfaces as a collective
+           error, which also exits).
+
+The device-mask leg keeps a DEGRADED mesh coherent across the slice: when
+BackendHealth quarantines a wedged chip on the lead, the mask names the
+surviving devices and every follower lowers the kernel over the identical
+shrunk mesh — a one-sided shrink would desynchronize collective order.
 
 Broadcasts ride jax.experimental.multihost_utils.broadcast_one_to_all —
 XLA collectives over ICI/DCN, the same fabric as the solve itself; there is
-no side-channel RPC layer to operate. Solves are serialized under a lock on
-rank 0 because collectives must be issued in the same order on every
-process.
+no side-channel RPC layer to operate. Solves are serialized under the
+dispatcher's lock on rank 0 because collectives must be issued in the same
+order on every process.
+
+Not every jaxlib can host this: XLA:CPU (as shipped in some builds) rejects
+multi-process computations outright. That surfaces as an XlaRuntimeError at
+the FIRST broadcast — detect it (collectives_unsupported) and fail fast
+with a named error instead of letting a half-initialized slice hang; the
+spmd test skips on the same signature.
 
 Ref: SURVEY.md §5 — "a distributed communication backend (XLA collectives
 over ICI/DCN) that scales to multi-host the way the reference's NCCL/MPI
@@ -30,6 +42,7 @@ EC2 calls; this framework's scale axis is one solve spanning many hosts.
 from __future__ import annotations
 
 import threading
+from typing import Optional
 
 import numpy as np
 
@@ -40,13 +53,35 @@ log = klog.named("parallel.spmd")
 OP_STOP = 0
 OP_SOLVE = 1
 
-_LEAD_LOCK = threading.Lock()
+# The backend-capability signature: jaxlib's CPU client raises this when a
+# multi-process program reaches it. Shared with tests/test_spmd.py so the
+# skip reason and the runtime error can never drift apart.
+COLLECTIVES_UNSUPPORTED_MSG = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
+
+
+class SpmdUnsupportedError(RuntimeError):
+    """The runtime cannot host multi-process collectives (see
+    COLLECTIVES_UNSUPPORTED_MSG) — raised instead of deadlocking the slice."""
+
+
+def collectives_unsupported(error: BaseException) -> bool:
+    return COLLECTIVES_UNSUPPORTED_MSG in str(error)
 
 
 def _broadcast(value):
     from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(value)
+    try:
+        return multihost_utils.broadcast_one_to_all(value)
+    except Exception as error:  # noqa: BLE001 — classify, then re-raise
+        if collectives_unsupported(error):
+            raise SpmdUnsupportedError(
+                "multi-process dispatch needs cross-process collectives, "
+                f"which this jaxlib build lacks: {error}"
+            ) from error
+        raise
 
 
 def is_multiprocess() -> bool:
@@ -80,35 +115,124 @@ def _broadcast_operands(padded):
     return vectors, counts, capacity, total, valid.astype(bool), prices
 
 
-def lead_dispatch(kernel, padded, lp_steps: int):
-    """Rank 0: replicate one solve to every process, then dispatch it.
-    Returns the kernel's outputs, ALREADY device-complete (unlike the
-    single-host path's async dispatch): the lock must cover execution so a
-    concurrent second solve can't desynchronize collective order, which
-    means multi-host solves serialize and the batch path's one-fetch
-    amortization degrades to per-solve round trips — acceptable, since a
-    pod slice's solve throughput dwarfs any realistic schedule rate."""
-    g_pad = int(padded[0].shape[0])
-    t_pad = int(padded[2].shape[0])
-    with _LEAD_LOCK:
-        _broadcast(np.array([OP_SOLVE, g_pad, t_pad, lp_steps], np.int32))
-        operands = _broadcast_operands(padded)
-        out = kernel(*operands, lp_steps=lp_steps)
-        # Hold the lock until device completion: the follower blocks on ITS
-        # kernel before the next header, so a second lead dispatch racing
-        # ahead would desynchronize the collective order.
-        import jax
+def _device_mask(mesh) -> np.ndarray:
+    """[device_count] uint8 membership mask of the mesh's devices — the
+    fixed-shape leg that replicates a (possibly shrunk) mesh to followers."""
+    import jax
 
-        jax.block_until_ready(out)
-    return out
+    mask = np.zeros(jax.device_count(), np.uint8)
+    for device in mesh.devices.flat:
+        mask[int(device.id)] = 1
+    return mask
+
+
+def _mesh_from_mask(mask: np.ndarray):
+    import jax
+
+    from karpenter_tpu.parallel.mesh import make_mesh
+
+    by_id = {int(d.id): d for d in jax.devices()}
+    return make_mesh([by_id[i] for i in np.nonzero(mask)[0]])
+
+
+class SpmdDispatcher:
+    """Rank 0's dispatch serializer. Collectives must be issued in the same
+    order on every process, so every lead-side broadcast round — dispatch
+    and stop alike — runs under one lock, held through device completion
+    (the follower blocks on ITS kernel before the next header, so a second
+    lead dispatch racing ahead would desynchronize the collective order).
+    That lock-across-dispatch is the documented blocking-under-lock
+    allowance (tools/vet/checkers/locks.py ALLOWED)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stopped = False  # vet: guarded-by(self._lock) — no dispatch after stop
+        self._dispatched = 0  # vet: guarded-by(self._lock) — solves replicated so far
+
+    def lead_dispatch(self, kernel, padded, lp_steps: int, mesh=None):
+        """Rank 0: replicate one solve to every process, then dispatch it.
+        Returns the kernel's outputs, ALREADY device-complete (unlike the
+        single-host path's async dispatch) — multi-host solves serialize
+        and the batch path's one-fetch amortization degrades to per-solve
+        round trips; acceptable, since a pod slice's solve throughput
+        dwarfs any realistic schedule rate."""
+        g_pad = int(padded[0].shape[0])
+        t_pad = int(padded[2].shape[0])
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("SPMD dispatcher already stopped")
+            _broadcast(np.array([OP_SOLVE, g_pad, t_pad, lp_steps], np.int32))
+            if mesh is not None:
+                _broadcast(_device_mask(mesh))
+            else:  # pragma: no cover — every production caller passes a mesh
+                import jax
+
+                _broadcast(np.ones(jax.device_count(), np.uint8))
+            operands = _broadcast_operands(padded)
+            out = kernel(*operands, lp_steps=lp_steps)
+            self._dispatched += 1
+            # Hold the lock until device completion: see the class docstring.
+            import jax
+
+            jax.block_until_ready(out)
+        return out
+
+    def lead_stop(self) -> None:
+        """Rank 0, clean shutdown: release every follower from its header
+        wait. Idempotent — a second stop must not issue a second collective
+        no follower is waiting for."""
+        if not is_multiprocess():
+            return
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            _broadcast(np.zeros(4, np.int32))
+
+
+DISPATCHER = SpmdDispatcher()
+
+
+def lead_dispatch(kernel, padded, lp_steps: int, mesh=None):
+    return DISPATCHER.lead_dispatch(kernel, padded, lp_steps, mesh=mesh)
 
 
 def lead_stop() -> None:
-    """Rank 0, clean shutdown: release every follower from its header wait."""
-    if not is_multiprocess():
-        return
-    with _LEAD_LOCK:
+    DISPATCHER.lead_stop()
+
+
+def follower_step(dims: int):
+    """One follower protocol round: header, device-mask, operands, kernel.
+    Returns the kernel's (device-complete) outputs, or None on OP_STOP.
+    Split from follower_loop so the loopback test (tests/test_spmd.py
+    TestSpmdCpuMesh) can drive the REAL follower code through an injected
+    transport on the single-process virtual mesh."""
+    import jax
+
+    from karpenter_tpu.models.solver import _sharded_fused_kernel
+
+    header = np.asarray(  # vet: host-array(4-int SPMD header, deliberate fetch)
         _broadcast(np.zeros(4, np.int32))
+    )
+    op, g_pad, t_pad, lp_steps = (int(x) for x in header)
+    if op == OP_STOP:
+        return None
+    mask = np.asarray(  # vet: host-array(device-mask leg, deliberate fetch)
+        _broadcast(np.zeros(jax.device_count(), np.uint8))
+    )
+    padded = (
+        np.zeros((g_pad, dims), np.float32),
+        np.zeros(g_pad, np.int32),
+        np.zeros((t_pad, dims), np.float32),
+        np.zeros((t_pad, dims), np.float32),
+        np.zeros(t_pad, bool),
+        np.zeros(t_pad, np.float32),
+    )
+    operands = _broadcast_operands(padded)
+    kernel, _, _ = _sharded_fused_kernel(_mesh_from_mask(mask))
+    out = kernel(*operands, lp_steps=lp_steps)
+    jax.block_until_ready(out)
+    return out
 
 
 def follower_loop() -> None:
@@ -121,29 +245,11 @@ def follower_loop() -> None:
     # Probe before the first trace, exactly like the lead's dispatch path —
     # the traced program must be identical on every process.
     pallas_kernels.ensure_probed()
-    from karpenter_tpu.models.solver import _sharded_fused_kernel
 
-    dims = wellknown.NUM_RESOURCE_DIMS
     log.info(
         "SPMD follower %d/%d up (%d global devices)",
         jax.process_index(), jax.process_count(), jax.device_count(),
     )
-    while True:
-        header = np.asarray(  # vet: host-array(4-int SPMD header, deliberate fetch)
-            _broadcast(np.zeros(4, np.int32))
-        )
-        op, g_pad, t_pad, lp_steps = (int(x) for x in header)
-        if op == OP_STOP:
-            log.info("SPMD follower %d stopping", jax.process_index())
-            return
-        padded = (
-            np.zeros((g_pad, dims), np.float32),
-            np.zeros(g_pad, np.int32),
-            np.zeros((t_pad, dims), np.float32),
-            np.zeros((t_pad, dims), np.float32),
-            np.zeros(t_pad, bool),
-            np.zeros(t_pad, np.float32),
-        )
-        operands = _broadcast_operands(padded)
-        kernel, _ = _sharded_fused_kernel()
-        jax.block_until_ready(kernel(*operands, lp_steps=lp_steps))
+    while follower_step(wellknown.NUM_RESOURCE_DIMS) is not None:
+        pass
+    log.info("SPMD follower %d stopping", jax.process_index())

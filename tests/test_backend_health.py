@@ -394,3 +394,151 @@ class TestDegradedRouting:
 
         assert process_backend.state() == UNKNOWN
         assert solver_models.host_solve_enabled(150_000) is False
+
+
+# --- per-chip (mesh) health ---------------------------------------------------
+
+
+@pytest.fixture
+def clean_mesh_health():
+    """Every test leaves the process-wide quarantine set empty."""
+    bh_mod.clear_wedged_chips()
+    yield bh_mod.MESH
+    bh_mod.clear_wedged_chips()
+
+
+class TestMeshHealth:
+    def test_report_and_clear(self, clean_mesh_health):
+        mesh_health = clean_mesh_health
+        assert not bh_mod.mesh_degraded()
+        bh_mod.report_chip_wedged(3, "test wedge")
+        assert bh_mod.mesh_degraded()
+        assert bh_mod.wedged_chips() == {3: "test wedge"}
+        mesh_health.clear(3)
+        assert not bh_mod.mesh_degraded()
+
+    def test_gauge_tracks_quarantine_size(self, clean_mesh_health):
+        bh_mod.report_chip_wedged(1, "a")
+        bh_mod.report_chip_wedged(2, "b")
+        assert bh_mod.WEDGED_CHIPS.get() == 2.0
+        bh_mod.clear_wedged_chips()
+        assert bh_mod.WEDGED_CHIPS.get() == 0.0
+
+    def test_wedged_chip_shrinks_solve_mesh(self, clean_mesh_health, monkeypatch):
+        from karpenter_tpu.models import solver as solver_models
+        from karpenter_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.delenv("KARPENTER_SHARDED_SOLVE", raising=False)
+        full = solver_models.solve_mesh()
+        assert full is not None and full.devices.size == 8
+        bh_mod.report_chip_wedged(7, "test wedge")
+        shrunk = solver_models.solve_mesh()
+        assert shrunk is not None and shrunk.devices.size == 7
+        assert 7 not in {int(d.id) for d in shrunk.devices.flat}
+        # make_mesh with an explicit device list bypasses the filter (the
+        # dryrun and tests build exact meshes).
+        import jax
+
+        explicit = make_mesh(jax.devices())
+        assert explicit.devices.size == 8
+
+    def test_all_but_one_wedged_pins_the_survivor(
+        self, clean_mesh_health, monkeypatch
+    ):
+        from karpenter_tpu.models import solver as solver_models
+
+        monkeypatch.delenv("KARPENTER_SHARDED_SOLVE", raising=False)
+        for device_id in range(7):
+            bh_mod.report_chip_wedged(device_id, "test wedge")
+        # One healthy chip: a 1-device mesh PINNED to the survivor — the
+        # plain single-device path would run on jax's default device,
+        # which here is wedged chip 0. And no CPU fallback either.
+        assert solver_models.sharded_solve_active()
+        survivor_mesh = solver_models.solve_mesh()
+        assert survivor_mesh is not None and survivor_mesh.devices.size == 1
+        assert int(next(iter(survivor_mesh.devices.flat)).id) == 7
+        assert not bh_mod.BACKEND.degraded()
+
+    def test_all_wedged_make_mesh_fails_loudly(self, clean_mesh_health):
+        from karpenter_tpu.parallel.mesh import make_mesh
+
+        for device_id in range(8):
+            bh_mod.report_chip_wedged(device_id, "test wedge")
+        with pytest.raises(RuntimeError, match="no healthy devices"):
+            make_mesh()
+
+
+class TestChipProbe:
+    def test_partial_output_names_the_survivors(self, monkeypatch):
+        # Chips 0 and 1 answer, then the probe wedges: the parent's
+        # timeout kill must still learn who answered.
+        monkeypatch.setenv(
+            "KARPENTER_CHIP_PROBE_CODE",
+            "import time\n"
+            "print('CHIP_OK 0', flush=True)\n"
+            "print('CHIP_OK 1', flush=True)\n"
+            "time.sleep(600)\n",
+        )
+        ok_ids, result = bh_mod.run_chip_probe(3.0)
+        assert ok_ids == [0, 1]
+        assert not result.ok
+        assert "hung" in result.reason
+
+    def test_clean_probe_reports_every_chip(self, monkeypatch):
+        monkeypatch.setenv(
+            "KARPENTER_CHIP_PROBE_CODE",
+            "\n".join(f"print('CHIP_OK {i}')" for i in range(4)),
+        )
+        ok_ids, result = bh_mod.run_chip_probe(30.0)
+        assert ok_ids == [0, 1, 2, 3]
+        assert result.ok
+
+    def test_quarantine_marks_only_non_responders(
+        self, clean_mesh_health, monkeypatch
+    ):
+        monkeypatch.setenv(
+            "KARPENTER_CHIP_PROBE_CODE",
+            "import time\n"
+            "print('CHIP_OK 0', flush=True)\n"
+            "print('CHIP_OK 1', flush=True)\n"
+            "print('CHIP_OK 2', flush=True)\n"
+            "time.sleep(600)\n",
+        )
+        monkeypatch.setenv("KARPENTER_PROBE_TIMEOUT_S", "3")
+        newly = bh_mod.quarantine_mesh([0, 1, 2, 3], RuntimeError("boom"))
+        assert newly == [3]
+        assert set(bh_mod.wedged_chips()) == {3}
+
+    def test_quarantine_with_all_chips_answering_reports_nothing(
+        self, clean_mesh_health, monkeypatch
+    ):
+        monkeypatch.setenv(
+            "KARPENTER_CHIP_PROBE_CODE",
+            "\n".join(f"print('CHIP_OK {i}')" for i in range(4)),
+        )
+        newly = bh_mod.quarantine_mesh([0, 1, 2, 3], RuntimeError("boom"))
+        assert newly == []
+        assert not bh_mod.mesh_degraded()
+
+
+class TestShrunkMeshSolve:
+    def test_production_solve_relowers_on_shrunk_mesh(
+        self, clean_mesh_health, monkeypatch
+    ):
+        """The full degraded-mesh story at a small shape: chip 7 wedged,
+        the flagship CostSolver re-lowers the fused kernel over the
+        7-device mesh and the plan still packs every pod."""
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.models.solver import CostSolver
+        from tests.fixtures import pods, size_ladder
+
+        monkeypatch.delenv("KARPENTER_SHARDED_SOLVE", raising=False)
+        monkeypatch.setenv("KARPENTER_HOST_SOLVE", "0")
+        bh_mod.report_chip_wedged(7, "test wedge")
+        batch = pods(96, cpu="500m", memory="1Gi")
+        result = CostSolver(lp_steps=8).solve(batch, size_ladder(8), Constraints())
+        assert not result.unschedulable
+        packed = sum(
+            sum(len(node) for node in p.pods_per_node) for p in result.packings
+        )
+        assert packed == len(batch)

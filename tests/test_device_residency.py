@@ -64,7 +64,12 @@ class TestCompaction:
         handle = S.cost_solve_dispatch(
             vectors, counts, capacity, capacity.copy(), prices, 8, count=False
         )
-        assert S.fetch_bytes(handle.eager) == PK.compact_bytes(handle.num_groups)
+        # On the suite's 8-device mesh the dispatch routes sharded, so the
+        # eager payload follows the per-shard segment layout; shards=1 is
+        # the single-device layout — the shape math covers both.
+        assert S.fetch_bytes(handle.eager) == 4 * PK.compact_words_sharded(
+            handle.num_groups, handle.shards
+        ) + 4
         # The acceptance bar: 50k pods / 400 types = a 16-group bucket.
         assert PK.compact_bytes(16) <= 4096
 

@@ -192,3 +192,254 @@ class TestGraftEntry:
         import __graft_entry__
 
         __graft_entry__.dryrun_multichip(8)
+
+
+class TestShardLocalCompaction:
+    """The shard-local COO compaction (ops/pack_kernel.compact_plan_sharded):
+    per-device entry segments must decode bit-identically to the dense round
+    state — the compaction changes the collective traffic, never a bit of
+    the plan."""
+
+    def _rounds(self, seed, num_groups):
+        from karpenter_tpu.ops.pack_kernel import PackRounds, max_rounds
+
+        rng = np.random.default_rng(seed)
+        mr = max_rounds(num_groups)
+        fill = np.zeros((mr, num_groups), np.int32)
+        entries = rng.integers(0, mr * num_groups, 3 * num_groups)
+        fill.ravel()[entries] = rng.integers(1, 50, len(entries)).astype(np.int32)
+        return PackRounds(
+            round_type=rng.integers(0, 16, mr).astype(np.int32),
+            round_fill=fill,
+            round_repl=rng.integers(1, 9, mr).astype(np.int32),
+            num_rounds=np.int32(rng.integers(1, mr)),
+            unschedulable=rng.integers(0, 3, num_groups).astype(np.int32),
+            overflow=np.bool_(False),
+        )
+
+    def test_sharded_roundtrip_matches_dense(self):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.pack_kernel import (
+            compact_plan_sharded,
+            compact_words_sharded,
+            decompact_plan_sharded,
+        )
+        from karpenter_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        num_groups = 32  # divisible by the 8-device mesh
+        rounds_ffd = self._rounds(1, num_groups)
+        rounds_cost = self._rounds(2, num_groups)
+        feasible = np.ones(num_groups, bool)
+        feasible[3] = False
+
+        device_rounds_ffd = jax.tree_util.tree_map(jnp.asarray, rounds_ffd)
+        device_rounds_cost = jax.tree_util.tree_map(jnp.asarray, rounds_cost)
+        words = np.asarray(
+            jax.jit(
+                lambda a, b, f: compact_plan_sharded(a, b, f, mesh=mesh)
+            )(device_rounds_ffd, device_rounds_cost, jnp.asarray(feasible))
+        )
+        assert words.shape[0] == compact_words_sharded(num_groups, 8)
+        out_ffd, out_cost, out_feasible, ok = decompact_plan_sharded(
+            words, num_groups, 8
+        )
+        assert ok
+        np.testing.assert_array_equal(out_feasible, feasible)
+        for decoded, original in ((out_ffd, rounds_ffd), (out_cost, rounds_cost)):
+            for field in (
+                "round_type", "round_fill", "round_repl",
+                "num_rounds", "unschedulable",
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(decoded, field)),
+                    np.asarray(getattr(original, field)),
+                    err_msg=field,
+                )
+
+    def test_single_shard_layout_is_the_dense_layout(self):
+        """A 1-device 'mesh' (the shrunk-to-one case) must produce exactly
+        the single-device compact layout, decodable by either decoder."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops.pack_kernel import (
+            compact_plan,
+            compact_words,
+            compact_words_sharded,
+            decompact_plan,
+            decompact_plan_sharded,
+        )
+
+        num_groups = 16
+        assert compact_words_sharded(num_groups, 1) == compact_words(num_groups)
+        rounds_ffd = self._rounds(5, num_groups)
+        rounds_cost = self._rounds(6, num_groups)
+        feasible = np.ones(num_groups, bool)
+        dense_words = np.asarray(
+            jax.jit(compact_plan)(
+                jax.tree_util.tree_map(jnp.asarray, rounds_ffd),
+                jax.tree_util.tree_map(jnp.asarray, rounds_cost),
+                jnp.asarray(feasible),
+            )
+        )
+        via_sharded = decompact_plan_sharded(dense_words, num_groups, 1)
+        via_dense = decompact_plan(dense_words, num_groups)
+        for decoded, reference in zip(via_sharded[:2], via_dense[:2]):
+            np.testing.assert_array_equal(
+                np.asarray(decoded.round_fill), np.asarray(reference.round_fill)
+            )
+        assert via_sharded[3] and via_dense[3]
+
+    def test_shard_overflow_signals_not_corrupts(self):
+        """A shard whose block draws more entries than its budget must
+        flip ok=False (dense-spill fallback), never emit wrong entries."""
+        from karpenter_tpu.ops.pack_kernel import (
+            compact_plan_sharded,
+            decompact_plan_sharded,
+            max_rounds,
+            shard_entry_budget,
+        )
+        from karpenter_tpu.parallel.mesh import make_mesh
+        import jax.numpy as jnp
+
+        mesh = make_mesh()
+        num_groups = 32
+        mr = max_rounds(num_groups)
+        budget = shard_entry_budget(num_groups, 8)
+        rounds = self._rounds(3, num_groups)
+        # Saturate shard 0's block (columns 0-3) far past its budget.
+        fill = np.asarray(rounds.round_fill).copy()
+        fill[:, :4] = 7
+        assert (fill[:, :4] != 0).sum() > budget
+        rounds = rounds._replace(round_fill=fill)
+        words = np.asarray(
+            jax.jit(
+                lambda a, b, f: compact_plan_sharded(a, b, f, mesh=mesh)
+            )(
+                jax.tree_util.tree_map(jnp.asarray, rounds),
+                jax.tree_util.tree_map(jnp.asarray, self._rounds(4, num_groups)),
+                jnp.asarray(np.ones(num_groups, bool)),
+            )
+        )
+        _, _, _, ok = decompact_plan_sharded(words, num_groups, 8)
+        assert not ok
+
+
+class TestShardedDispatchRetry:
+    def test_wedged_dispatch_quarantines_and_retries_on_shrunk_mesh(
+        self, monkeypatch
+    ):
+        """A dispatch-time failure on the full mesh: the quarantine probe
+        names the dead chip, the retry re-lowers on the survivors."""
+        from karpenter_tpu.models import solver as solver_mod
+        from karpenter_tpu.utils import backend_health
+
+        backend_health.clear_wedged_chips()
+        monkeypatch.delenv("KARPENTER_SHARDED_SOLVE", raising=False)
+
+        real_kernel_builder = solver_mod._sharded_fused_kernel
+        calls = []
+
+        def failing_once(mesh=None):
+            kernel, mults, shards = real_kernel_builder(mesh)
+            if not calls:
+                def exploding_kernel(*args, **kwargs):
+                    raise RuntimeError("simulated chip wedge")
+
+                calls.append(mesh)
+                return exploding_kernel, mults, shards
+            calls.append(mesh)
+            return kernel, mults, shards
+
+        monkeypatch.setattr(solver_mod, "_sharded_fused_kernel", failing_once)
+        monkeypatch.setattr(
+            backend_health,
+            "quarantine_mesh",
+            lambda device_ids, error: (
+                backend_health.report_chip_wedged(7, f"test: {error}"),
+                [7],
+            )[1],
+        )
+        try:
+            import __graft_entry__
+
+            vectors, counts, capacity, total, valid, prices = (
+                __graft_entry__._example_problem(num_groups=8, num_types=16)
+            )
+            mesh = solver_mod.solve_mesh()
+            assert mesh is not None and mesh.devices.size == 8
+            out, padded, shards = solver_mod._dispatch_sharded(
+                vectors, counts, capacity, total, prices, 4, mesh
+            )
+            assert shards == 7
+            assert calls[-1].devices.size == 7
+            assert 7 not in {int(d.id) for d in calls[-1].devices.flat}
+        finally:
+            backend_health.clear_wedged_chips()
+
+    def test_no_wedged_chip_reraises(self, monkeypatch):
+        from karpenter_tpu.models import solver as solver_mod
+        from karpenter_tpu.utils import backend_health
+
+        backend_health.clear_wedged_chips()
+        monkeypatch.delenv("KARPENTER_SHARDED_SOLVE", raising=False)
+
+        def always_fails(mesh=None):
+            def exploding_kernel(*args, **kwargs):
+                raise RuntimeError("not a chip problem")
+
+            return exploding_kernel, (8, 4), 8
+
+        monkeypatch.setattr(solver_mod, "_sharded_fused_kernel", always_fails)
+        monkeypatch.setattr(
+            backend_health, "quarantine_mesh", lambda device_ids, error: []
+        )
+        import pytest as _pytest
+
+        import __graft_entry__
+
+        vectors, counts, capacity, total, valid, prices = (
+            __graft_entry__._example_problem(num_groups=8, num_types=16)
+        )
+        mesh = solver_mod.solve_mesh()
+        with _pytest.raises(RuntimeError, match="not a chip problem"):
+            solver_mod._dispatch_sharded(
+                vectors, counts, capacity, total, prices, 4, mesh
+            )
+
+    def test_fetch_failure_quarantines_sharded_handles(self, monkeypatch):
+        """Execution failures surface at the FETCH (dispatch is async):
+        a failed fetch of sharded outputs must run the quarantine so the
+        next dispatch shrinks the mesh — and still re-raise."""
+        from karpenter_tpu.models import solver as solver_mod
+        from karpenter_tpu.utils import backend_health
+
+        quarantined = []
+        monkeypatch.setattr(
+            backend_health,
+            "quarantine_mesh",
+            lambda device_ids, error: quarantined.append(list(device_ids)) or [],
+        )
+
+        def exploding_to_host(tree):
+            raise RuntimeError("chip died mid-execution")
+
+        monkeypatch.setattr(solver_mod, "_to_host", exploding_to_host)
+        handle = solver_mod.FusedHandle(
+            compact=None, objective=None, dense=None, lp=None,
+            num_groups=8, num_types=16, shards=8,
+        )
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="chip died"):
+            solver_mod.fetch_plans([handle])
+        assert quarantined and len(quarantined[0]) == 8
+
+        # Single-device handles are the whole-device verdict's territory:
+        # no quarantine.
+        quarantined.clear()
+        single = handle._replace(shards=1)
+        with _pytest.raises(RuntimeError, match="chip died"):
+            solver_mod.fetch_plans([single])
+        assert not quarantined

@@ -1,9 +1,19 @@
-"""Real multi-process SPMD integration: N OS processes (2 in the normal
-tier, 4 in the battletest tier) join one jax.distributed runtime (CPU +
-gloo collectives) and the PRODUCTION CostSolver path replicates solves
-from rank 0 to the follower loops — the local stand-in for a multi-host
-TPU pod slice. Covers parallel/spmd.py, parallel/multihost.py, and the
-multi-process branch of models/solver.cost_solve_dispatch end to end."""
+"""SPMD dispatch coverage in two tiers.
+
+1. Real multi-process integration: N OS processes (2 in the normal tier, 4
+   in the battletest tier) join one jax.distributed runtime and the
+   PRODUCTION CostSolver path replicates solves from rank 0 to the follower
+   loops — the local stand-in for a multi-host TPU pod slice. Requires a
+   jaxlib whose backend implements cross-process collectives; where it
+   doesn't (XLA:CPU in some builds rejects multi-process programs
+   outright), the test SKIPS with the backend's own error as the reason —
+   a deadlock-shaped failure would say nothing.
+2. A single-process CPU-mesh variant that runs in EVERY tier-1 pass on the
+   conftest's 8-device virtual mesh: the lead/follower protocol
+   (header + device-mask + operand broadcast, shape rebuild, kernel
+   mirroring) exercised through an injected loopback transport, so the
+   mesh/sharding logic is covered on every run, not only on multi-chip
+   hardware."""
 
 import os
 import socket
@@ -11,7 +21,10 @@ import subprocess
 import sys
 import textwrap
 
+import numpy as np
 import pytest
+
+from karpenter_tpu.parallel import spmd
 
 _RANK_PROGRAM = textwrap.dedent(
     """
@@ -142,6 +155,19 @@ class TestSpmdMultiProcess:
                 "SPMD processes deadlocked (collective mismatch?):\n"
                 + "\n---\n".join(o[-2000:] for o in outputs)
             )
+        if any(
+            spmd.COLLECTIVES_UNSUPPORTED_MSG in out for out in outputs
+        ):
+            # The runtime came up (jax.distributed joined, device counts
+            # checked) but this jaxlib's backend rejects multi-process
+            # programs — the environment cannot host the test. The
+            # single-process protocol coverage lives in TestSpmdCpuMesh,
+            # which runs in every tier-1 pass.
+            pytest.skip(
+                "jaxlib backend lacks cross-process collectives "
+                f"({spmd.COLLECTIVES_UNSUPPORTED_MSG!r}); "
+                "protocol covered by TestSpmdCpuMesh"
+            )
         for rank, (proc, out) in enumerate(zip(procs, outputs)):
             assert proc.returncode == 0, (
                 f"rank {rank} failed (rc={proc.returncode}):\n{out[-3000:]}"
@@ -149,3 +175,105 @@ class TestSpmdMultiProcess:
         assert "lead done" in outputs[0]
         for follower_output in outputs[1:]:
             assert "follower done" in follower_output
+
+
+class TestSpmdCpuMesh:
+    """Tier-1 SPMD protocol coverage on the conftest's single-process
+    8-device virtual mesh: the REAL lead and follower code paths wired
+    back-to-back through an injected loopback transport. What multi-chip
+    hardware would exercise over ICI/DCN — header broadcast, device-mask
+    mesh replication (including a DEGRADED shrunk mesh), operand shape
+    rebuild, identical kernel dispatch — runs here on every tier-1 pass."""
+
+    def _example(self, mesh):
+        import __graft_entry__
+        from karpenter_tpu.models.solver import (
+            _sharded_fused_kernel,
+            pad_kernel_args,
+        )
+
+        kernel, (g_mult, t_mult), shards = _sharded_fused_kernel(mesh)
+        vectors, counts, capacity, total, valid, prices = (
+            __graft_entry__._example_problem(num_groups=8, num_types=16)
+        )
+        padded = pad_kernel_args(
+            vectors, counts, capacity, total, prices,
+            g_mult=g_mult, t_mult=t_mult,
+        )
+        return kernel, padded, shards
+
+    def test_lead_follower_loopback(self, monkeypatch):
+        from karpenter_tpu.api import wellknown
+        from karpenter_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        kernel, padded, shards = self._example(mesh)
+        assert shards == 8
+
+        wire = []
+        monkeypatch.setattr(
+            spmd, "_broadcast", lambda value: (wire.append(value), value)[1]
+        )
+        dispatcher = spmd.SpmdDispatcher()
+        lead_out = dispatcher.lead_dispatch(kernel, padded, 6, mesh=mesh)
+
+        # Replay the recorded wire as the follower: same header, same mask,
+        # same operands must rebuild the same mesh and dispatch the same
+        # kernel to a bit-identical compact payload.
+        replay = list(wire)
+        monkeypatch.setattr(spmd, "_broadcast", lambda _: replay.pop(0))
+        follower_out = spmd.follower_step(wellknown.NUM_RESOURCE_DIMS)
+        assert follower_out is not None
+        np.testing.assert_array_equal(
+            np.asarray(lead_out[0]), np.asarray(follower_out[0])
+        )
+        assert not replay, "follower consumed a different number of legs"
+
+    def test_device_mask_replicates_shrunk_mesh(self, monkeypatch):
+        import jax
+
+        from karpenter_tpu.parallel.mesh import make_mesh
+
+        # A lead whose mesh lost chip 7 must hand followers a mask that
+        # rebuilds the identical 7-device mesh.
+        devices = jax.devices()[:7]
+        mesh = make_mesh(devices)
+        mask = spmd._device_mask(mesh)
+        assert mask.tolist() == [1] * 7 + [0]
+        rebuilt = spmd._mesh_from_mask(mask)
+        assert rebuilt.devices.size == 7
+        assert [d.id for d in rebuilt.devices.flat] == [
+            d.id for d in mesh.devices.flat
+        ]
+
+    def test_stop_header_ends_follower(self, monkeypatch):
+        from karpenter_tpu.api import wellknown
+
+        monkeypatch.setattr(
+            spmd, "_broadcast", lambda _: np.zeros(4, np.int32)
+        )
+        assert spmd.follower_step(wellknown.NUM_RESOURCE_DIMS) is None
+
+    def test_lead_stop_idempotent(self, monkeypatch):
+        sent = []
+        monkeypatch.setattr(spmd, "is_multiprocess", lambda: True)
+        monkeypatch.setattr(
+            spmd, "_broadcast", lambda value: (sent.append(value), value)[1]
+        )
+        dispatcher = spmd.SpmdDispatcher()
+        dispatcher.lead_stop()
+        dispatcher.lead_stop()
+        assert len(sent) == 1, "second stop must not issue a collective"
+        padded = (np.zeros((1, 8), np.float32),) * 6
+        with pytest.raises(RuntimeError, match="stopped"):
+            dispatcher.lead_dispatch(None, padded, 1)
+
+    def test_unsupported_backend_classified(self):
+        class FakeXlaError(Exception):
+            pass
+
+        error = FakeXlaError(
+            "INVALID_ARGUMENT: " + spmd.COLLECTIVES_UNSUPPORTED_MSG + "."
+        )
+        assert spmd.collectives_unsupported(error)
+        assert not spmd.collectives_unsupported(ValueError("other"))

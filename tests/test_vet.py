@@ -274,6 +274,76 @@ CASES = [
             return plan, total
         """,
     ),
+    (
+        # The SPMD dispatcher shape (parallel/spmd.py, ISSUE 11 satellite):
+        # collective-order state guarded by the dispatch lock. Touching the
+        # stop flag lock-free is exactly the race that would let a dispatch
+        # slip out after lead_stop's final collective.
+        "lock-discipline",
+        """
+        import threading
+
+        class Dispatcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stopped = False  # vet: guarded-by(self._lock)
+                self._dispatched = 0  # vet: guarded-by(self._lock)
+
+            def lead_stop(self):
+                self._stopped = True
+        """,
+        """
+        import threading
+
+        class Dispatcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stopped = False  # vet: guarded-by(self._lock)
+                self._dispatched = 0  # vet: guarded-by(self._lock)
+
+            def lead_dispatch(self):
+                with self._lock:
+                    if self._stopped:
+                        raise RuntimeError("stopped")
+                    self._dispatched += 1
+
+            def lead_stop(self):
+                with self._lock:
+                    self._stopped = True
+        """,
+    ),
+    (
+        # Blocking collective completion under a lock WITHOUT the documented
+        # spmd allowance must trip; ordinary lock-protected bookkeeping
+        # around the (unlocked) blocking call must not.
+        "blocking-under-lock",
+        """
+        import threading
+        import jax
+
+        class Dispatcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def dispatch(self, out):
+                with self._lock:
+                    jax.block_until_ready(out)
+        """,
+        """
+        import threading
+        import jax
+
+        class Dispatcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._dispatched = 0
+
+            def dispatch(self, out):
+                with self._lock:
+                    self._dispatched += 1
+                jax.block_until_ready(out)
+        """,
+    ),
 ]
 
 

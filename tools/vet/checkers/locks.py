@@ -68,12 +68,12 @@ DISPATCH_ATTRS = {"_notify"}
 
 # file or file::qualname prefix -> justification (shared by both checkers).
 ALLOWED: dict = {
-    # Documented at the site: the multi-host lead MUST hold _LEAD_LOCK
-    # across jax.block_until_ready — a second dispatch racing ahead would
-    # desynchronize collective order across processes. Serializing solves
-    # is the accepted cost; the lock covering the blocking call is the
-    # mechanism, not an accident.
-    "karpenter_tpu/parallel/spmd.py::lead_dispatch": "collective order requires lock across device completion",
+    # Documented at the site: the multi-host lead MUST hold the dispatcher
+    # lock across jax.block_until_ready — a second dispatch racing ahead
+    # would desynchronize collective order across processes. Serializing
+    # solves is the accepted cost; the lock covering the blocking call is
+    # the mechanism, not an accident.
+    "karpenter_tpu/parallel/spmd.py::SpmdDispatcher.lead_dispatch": "collective order requires lock across device completion",
 }
 
 
