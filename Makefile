@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -107,6 +107,14 @@ chaos-smoke:
 multichip-smoke:
 	timeout -k 10 540 python tools/multichip_smoke.py
 
+# The constraint-compiler guard (tools/constraints_smoke.py): kernel/mirror
+# bit-parity on randomized instances, compiled-vs-greedy placement parity on
+# the seed spread scenarios, the anti-affinity scenario the greedy pass
+# cannot express, and the [L, G, T] dispatch-shape budget (one kernel call
+# for all relaxation levels; bench.py owns the tight on-device 2x claim).
+constraints-smoke:
+	timeout -k 10 180 python tools/constraints_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -119,6 +127,7 @@ smoke:
 	$(MAKE) encode-smoke || rc=1; \
 	$(MAKE) chaos-smoke || rc=1; \
 	$(MAKE) multichip-smoke || rc=1; \
+	$(MAKE) constraints-smoke || rc=1; \
 	exit $$rc
 
 proto:

@@ -559,6 +559,107 @@ def _config_lp_bound(groups, fleet, greedy_cost):
         return {}
 
 
+def bench_constraint_axis(groups, fleet, reps: int = 5, num_levels: int = 4) -> dict:
+    """The constraint axis of the sweep (ISSUE 12): zonal-spread and
+    anti-affinity variants of the headline config, each solved as ONE
+    [L, G, T] dispatch at L=4, against the unconstrained single-level cost
+    solve on the same tensors. The budget claim: constrained p50 within 2x
+    the unconstrained p50 — the whole point of compiling the relaxation
+    ladder into the kernel is that four levels cost one dispatch, not four.
+    `budget_asserted` is False on a CPU-fallback run (same refusal rule as
+    vs_baseline: no device claims off-device)."""
+    import jax
+
+    from karpenter_tpu.models.solver import pad_kernel_args
+    from karpenter_tpu.ops.pack_kernel import (
+        NODE_CAP_NONE,
+        pack_kernel,
+        pack_kernel_levels,
+    )
+
+    vectors, counts, capacity, total, valid, prices = pad_kernel_args(
+        groups.vectors, groups.counts, fleet.capacity, fleet.total, fleet.prices
+    )
+    g, t = vectors.shape[0], capacity.shape[0]
+
+    def timed(fn):
+        jax.block_until_ready(fn())  # compile + warm
+        lat = []
+        for _ in range(reps):
+            start = time.perf_counter()
+            jax.block_until_ready(fn())
+            lat.append((time.perf_counter() - start) * 1e3)
+        return float(np.percentile(lat, 50))
+
+    base_p50 = timed(
+        lambda: pack_kernel(
+            vectors, counts, capacity, total, valid, prices,
+            quirk=False, mode="cost",
+        )
+    )
+
+    # Zonal-spread variant: every group expands over 3 zone domains
+    # (sub-group counts water-filled), cross-domain co-residence forbidden,
+    # level 0 restricted to 2 of 3 domains (a preferred-zone term).
+    zones = 3
+    zv = np.repeat(vectors, zones, axis=0)
+    zcounts = np.zeros((num_levels, g * zones), np.int32)
+    for gi in range(g):
+        share = int(counts[gi]) // zones
+        rem = int(counts[gi]) - share * zones
+        for z in range(zones):
+            zcounts[:, gi * zones + z] = share + (1 if z < rem else 0)
+    zallow = np.ones((num_levels, g * zones, t), bool)
+    for gi in range(g):
+        # Level 0 forbids domain 2; its share water-fills into domains 0/1
+        # so the restricted level still assigns the full batch (a level that
+        # assigns fewer pods loses the on-device shortfall comparison and
+        # could never be chosen — it would bench a degenerate level).
+        zallow[0, gi * zones + 2, :] = False
+        total = int(counts[gi])
+        zcounts[0, gi * zones + 0] = total - total // 2
+        zcounts[0, gi * zones + 1] = total // 2
+        zcounts[0, gi * zones + 2] = 0
+    domain = np.arange(g * zones) % zones
+    zconflict = domain[:, None] != domain[None, :]
+    zcap = np.full(g * zones, NODE_CAP_NONE, np.int32)
+    zpen = np.zeros((num_levels, g * zones, t), np.float32)
+    zonal_p50 = timed(
+        lambda: pack_kernel_levels(
+            zv, zcounts, capacity, total, valid, prices,
+            zallow, zpen, zconflict, zcap, mode="cost",
+        )
+    )
+
+    # Anti-affinity variant: the two largest groups are one-per-node
+    # (hostname self-anti-affinity) and mutually exclusive.
+    acounts = np.tile(counts, (num_levels, 1))
+    aallow = np.ones((num_levels, g, t), bool)
+    acap = np.full(g, NODE_CAP_NONE, np.int32)
+    acap[:2] = 1
+    aconflict = np.zeros((g, g), bool)
+    aconflict[0, 1] = aconflict[1, 0] = True
+    apen = np.zeros((num_levels, g, t), np.float32)
+    anti_p50 = timed(
+        lambda: pack_kernel_levels(
+            vectors, acounts, capacity, total, valid, prices,
+            aallow, apen, aconflict, acap, mode="cost",
+        )
+    )
+
+    zonal_ratio = round(zonal_p50 / base_p50, 2) if base_p50 else 0.0
+    anti_ratio = round(anti_p50 / base_p50, 2) if base_p50 else 0.0
+    return {
+        "levels": num_levels,
+        "unconstrained_p50_ms": round(base_p50, 2),
+        "zonal_spread_p50_ms": round(zonal_p50, 2),
+        "anti_affinity_p50_ms": round(anti_p50, 2),
+        "zonal_spread_ratio": zonal_ratio,
+        "anti_affinity_ratio": anti_ratio,
+        "within_2x_budget": max(zonal_ratio, anti_ratio) <= 2.0,
+    }
+
+
 def _backend_platform() -> str:
     import jax
 
@@ -1025,6 +1126,7 @@ def main():
     # per-device memory high-water stamped; the speedup claim is refused
     # outright on a single-device runtime (no mesh, no multichip claim).
     multichip = bench_multichip(groups, fleet)
+    constraint_axis = bench_constraint_axis(groups, fleet)
 
     # Watch->selection->batch->solve->bind pipeline under a 10k-pod storm,
     # per selection-concurrency setting (justifies Options.selection_concurrency).
@@ -1099,6 +1201,15 @@ def main():
                 "configs": configs,
                 "stretch": stretch,
                 "multichip": multichip,
+                # Constraint axis (ISSUE 12): the [L, G, T] dispatch on
+                # zonal-spread / anti-affinity variants of the headline
+                # config vs the unconstrained solve; the 2x-budget claim is
+                # a device claim, refused on CPU fallback (same rule as
+                # vs_baseline).
+                "constraint_axis": {
+                    **constraint_axis,
+                    "budget_asserted": not device_unavailable,
+                },
                 "pod_storm_10k": pod_storm,
                 "pod_storm_50k": pod_storm_50k,
                 # Steady-state churn + consolidation convergence (fake

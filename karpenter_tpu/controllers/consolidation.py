@@ -263,7 +263,14 @@ class ConsolidationController:
         if utilization >= UNDERUTILIZED_FRACTION:
             return None
         constrained = any(
-            p.node_selector or p.required_terms or p.topology_spread
+            p.node_selector
+            or p.required_terms
+            or p.topology_spread
+            # Pod (anti-)affinity is admitted by selection now (the
+            # constraint compiler lowers it); the counterfactual re-solve
+            # here does not, so such pods mark the candidate constrained.
+            or p.pod_affinity_terms
+            or p.pod_anti_affinity_terms
             for p in replaceable
         )
         return Candidate(

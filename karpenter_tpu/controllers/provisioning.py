@@ -133,11 +133,15 @@ class ProvisionerWorker:
         cloud: CloudProvider,
         solver: Optional[Solver] = None,
         cluster_state=None,
+        level_recorder=None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
         self.cloud = cloud
         self.solver = solver or GreedySolver()
+        # Reports each constrained solve's kernel-chosen relaxation level
+        # back to selection's bookkeeping cache (selection.Preferences).
+        self.level_recorder = level_recorder
         # Incremental encoder (models/cluster_state.DeviceClusterState):
         # when its delta-maintained tensors cover a schedule's batch, the
         # spec->tensor encode is skipped and the solve runs against the
@@ -234,19 +238,21 @@ class ProvisionerWorker:
 
     # --- the provisioning pass (ref: provisioner.go:102-135) ----------------
 
-    def provision(self) -> ProvisionStats:
-        stats = ProvisionStats()
-        batch = self._drain()
-        # Re-fetch to drop pods bound/terminated since batching, but keep
-        # scheduling the BATCH copy — it may carry relaxed preferences the
-        # stored spec deliberately doesn't ("Do not mutate the pod in case
-        # the scheduler relaxed constraints", ref: provisioner.go:169-185).
+    def _live_batch(self, batch: List[PodSpec]) -> List[PodSpec]:
+        """Re-fetch to drop pods bound/terminated since batching, but keep
+        scheduling the BATCH copy ("Do not mutate the pod in case the
+        scheduler relaxed constraints", ref: provisioner.go:169-185)."""
         pods = []
         for pod in batch:
             live = self.cluster.try_get_pod(pod.namespace, pod.name)
             if live is None or not live.is_provisionable():
                 continue
             pods.append(pod)
+        return pods
+
+    def provision(self) -> ProvisionStats:
+        stats = ProvisionStats()
+        pods = self._live_batch(self._drain())
         if not pods:
             return stats
 
@@ -259,24 +265,32 @@ class ProvisionerWorker:
             "provision.schedule", provisioner=self.provisioner.name, pods=len(pods)
         ):
             schedules = self.scheduler.solve(self.provisioner, pods)
-        # All schedules solve as ONE batch: device-backed solvers share a
+        # Constrained schedules (relaxation ladder, topology spread, pod
+        # (anti-)affinity) route through the compiler's [L, G, T] dispatch;
+        # everything else stays on the plain solver boundary. All plain
+        # schedules solve as ONE batch: device-backed solvers share a
         # single device->host round trip across them, and the sidecar's
         # streaming RPC does the same across the wire (the reference loops
         # Pack per schedule — provisioner.go:102-135). On the pipelined path
         # the batch additionally OVERLAPS with bind: schedule N's nodes
         # launch and bind while schedules N+1.. are still solving on the
         # device (solve_many_pipelined).
-        problems = [
-            self._encode_problem(schedule, daemons) for schedule in schedules
-        ]
-        for schedule, result in self._solve_results(schedules, problems):
-            if stats.launch_errors:
+        plain = [s for s in schedules if not s.needs_compiler]
+        constrained = [s for s in schedules if s.needs_compiler]
+        problems = [self._encode_problem(schedule, daemons) for schedule in plain]
+        for schedule, result in self._all_results(
+            plain, problems, constrained, daemons
+        ):
+            if stats.launch_errors and not schedule.needs_compiler:
                 # An earlier schedule's launch failed (e.g. ICE): its pools
                 # are now in the unavailable-offerings blackout, but this
                 # schedule was solved against the pre-failure batch snapshot.
                 # Re-solve it against fresh instance types so the within-pass
                 # capacity feedback of the sequential loop is preserved
                 # (ref: aws/instancetypes.go:174-183 blackout semantics).
+                # Constrained schedules skip the re-solve: their dispatch
+                # already ran after every plain launch of the pass, and a
+                # late ICE heals through the next sweep's fresh compile.
                 fresh_types = self.cloud.get_instance_types(schedule.constraints)
                 with SOLVE_DURATION.measure(), TRACER.span(
                     "provision.resolve", pods=len(schedule.pods)
@@ -317,6 +331,43 @@ class ProvisionerWorker:
     def _problem_pods(problem) -> int:
         # A pre-encoded problem is a (PodGroups, InstanceFleet) pair.
         return problem[0].num_pods if len(problem) == 2 else len(problem[0])
+
+    def _all_results(self, plain, problems, constrained, daemons):
+        """(schedule, result) pairs for the whole pass: the plain batch via
+        the pipelined solver boundary, then each constrained schedule via
+        the compiler's [L, G, T] dispatch (constraints/solve) — one kernel
+        call per schedule solving every relaxation level, replacing the
+        legacy relax-retry loop AND the Topology.inject pre-pass."""
+        yield from self._solve_results(plain, problems)
+        if not constrained:
+            return
+        from karpenter_tpu.constraints.solve import solve_constrained
+
+        epoch = None
+        if self.cluster_state is not None:
+            try:
+                # (epoch, generation): generation moves on every delta
+                # flush, so the envelope cache invalidates on ordinary
+                # pod/node churn, not just full re-uploads; None while
+                # deltas are pending (compile reads the live store).
+                epoch = self.cluster_state.compile_tag()
+            except Exception:  # noqa: BLE001 — cache tag only, never fatal
+                epoch = None
+        for schedule in constrained:
+            instance_types = self.cloud.get_instance_types(schedule.constraints)
+            with SOLVE_DURATION.measure(), TRACER.span(
+                "provision.solve.constrained",
+                pods=len(schedule.pods),
+                levels=schedule.ladder.num_levels if schedule.ladder else 1,
+            ):
+                result, decision = solve_constrained(
+                    self.solver, schedule, instance_types, daemons,
+                    cluster=self.cluster, epoch=epoch,
+                )
+            if self.level_recorder is not None:
+                for uid, level in decision.pod_levels.items():
+                    self.level_recorder(uid, level, decision.description)
+            yield schedule, result
 
     def _solve_results(self, schedules, problems):
         """Yield (schedule, result) pairs for the pass.
@@ -436,9 +487,13 @@ class ProvisionerWorker:
                     continue
             node_pods = iter(packing.pods_per_node)
 
-            def bind_callback(node: NodeSpec, _pods_iter=node_pods):
+            def bind_callback(
+                node: NodeSpec, _pods_iter=node_pods, _packing=packing
+            ):
                 pods = next(_pods_iter, [])
-                self._register_and_bind(node, pods)
+                self._register_and_bind(
+                    node, pods, extra_labels=_packing.node_labels
+                )
                 stats.launched_nodes += 1
                 stats.scheduled_pods += len(pods)
 
@@ -460,10 +515,17 @@ class ProvisionerWorker:
             return True
         return getattr(error, "status", None) == 404
 
-    def _register_and_bind(self, node: NodeSpec, pods: Sequence[PodSpec]):
+    def _register_and_bind(
+        self, node: NodeSpec, pods: Sequence[PodSpec], extra_labels=None
+    ):
         """Create the node object (not-ready taint + termination finalizer +
-        constraint labels) then bind its pods (ref: provisioner.go:209-250)."""
+        constraint labels) then bind its pods (ref: provisioner.go:209-250).
+        `extra_labels` carries the packing's topology-domain labels: a
+        custom-key spread domain is stamped at registration, so fresh nodes
+        are born into the domain the constrained solve assigned them."""
         node.labels.setdefault(wellknown.PROVISIONER_NAME_LABEL, self.provisioner.name)
+        for key, value in (extra_labels or {}).items():
+            node.labels.setdefault(key, value)
         for key, value in self.provisioner.spec.constraints.labels.items():
             node.labels.setdefault(key, value)
         node.taints = list(self.provisioner.spec.constraints.taints) + [
@@ -555,6 +617,14 @@ class ProvisioningController:
         # Runtime wiring (runtime.Manager): propagated to every worker so a
         # filling batch window wakes the batch loop immediately.
         self.batch_full: Optional[threading.Event] = None
+        # Set by SelectionController: receives (uid, level, description) for
+        # every constrained solve. Late-bound — workers route through
+        # _record_level so construction order doesn't matter.
+        self.level_recorder = None
+
+    def _record_level(self, uid: str, level: int, description: str = "") -> None:
+        if self.level_recorder is not None:
+            self.level_recorder(uid, level, description)
 
     def reconcile(self, name: str) -> None:
         provisioner = self.cluster.try_get_provisioner(name)
@@ -588,6 +658,7 @@ class ProvisioningController:
             replacement = ProvisionerWorker(
                 effective, self.cluster, self.cloud, self.solver,
                 cluster_state=self.cluster_state,
+                level_recorder=self._record_level,
             )
             replacement.batch_full = self.batch_full
             # Hand the old worker's accepted backlog (batch + overflow) to

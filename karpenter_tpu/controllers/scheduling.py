@@ -1,10 +1,27 @@
-"""Scheduler: split a pod batch into groups of isomorphic constraints, with
-topology-spread decisions injected as node selectors first.
+"""Scheduler: split a pod batch into groups of isomorphic constraints.
 
 Ref: pkg/controllers/provisioning/scheduling/{scheduler,topology,
 topologygroup}.go. The output Schedules feed the solver one at a time — all
 pods in a Schedule are satisfiable by the same tightened constraint set, which
 is what lets the solver treat them as one dense tensor problem.
+
+Two topology regimes:
+
+* **Compiled (default).** Topology-spread, pod (anti-)affinity, and the
+  preference-relaxation ladder are NOT resolved here: the schedule carries
+  its relaxation ladder (constraints/ladder.py) and the constraint compiler
+  lowers everything into the [L, G, T] kernel dispatch at solve time
+  (constraints/compiler.py). Spread pods that the greedy pre-pass used to
+  split into one-schedule-per-zone stay in ONE schedule, so one dispatch
+  co-optimizes spread against cost instead of serializing per domain.
+
+* **Greedy (KARPENTER_GREEDY_TOPOLOGY=1 / Scheduler(greedy_topology=True)).**
+  The legacy host-side pre-pass, kept as the parity oracle: topology-spread
+  decisions are injected as node selectors ahead of the solve
+  (Topology.inject, ref topology.go:40-140), now generalized to arbitrary
+  topology keys and max_skew > 1 so the oracle covers everything the
+  compiled path does (minus anti-affinity, which the pre-pass cannot
+  express).
 """
 
 from __future__ import annotations
@@ -16,10 +33,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from karpenter_tpu.api import wellknown
-from karpenter_tpu.api.pods import DO_NOT_SCHEDULE, PodSpec, TopologySpreadConstraint
+from karpenter_tpu.api.pods import PodSpec, TopologySpreadConstraint
 from karpenter_tpu.api.provisioner import Constraints, PodIncompatibleError, Provisioner
+from karpenter_tpu.constraints.ladder import LadderState, RelaxationLadder, build_ladder
+from karpenter_tpu.constraints.terms import node_domain, term_fingerprint
 from karpenter_tpu.controllers.cluster import Cluster
 
+# Legacy constant (the greedy pre-pass once rejected everything else); the
+# compiled path and the generalized greedy fallback both take arbitrary
+# node-label keys now, so this only names the keys with special lowering
+# (hostname: fabricated domains / per-node caps).
 SUPPORTED_TOPOLOGY_KEYS = (wellknown.HOSTNAME_LABEL, wellknown.ZONE_LABEL)
 
 _domain_counter = itertools.count(1)
@@ -28,10 +51,22 @@ _domain_counter = itertools.count(1)
 @dataclass
 class Schedule:
     """Pods satisfiable by one tightened constraint set
-    (ref: scheduler.go:54-58)."""
+    (ref: scheduler.go:54-58). On the compiled path a schedule additionally
+    carries its relaxation ladder: `needs_compiler` schedules route through
+    constraints/solve.solve_constrained (one [L, G, T] dispatch) instead of
+    the plain solver boundary."""
 
     constraints: Constraints
     pods: List[PodSpec] = field(default_factory=list)
+    ladder: Optional[RelaxationLadder] = None
+    valid_levels: Optional[List[bool]] = None
+    needs_compiler: bool = False
+    # The constraint representative: the scheduler-local shadow whose
+    # selector/spread/affinity state the compiler should read (on the
+    # greedy-topology path the shadow carries injected selectors and has
+    # its spread constraints cleared — inject already resolved them).
+    # None = read pods[0].
+    rep: Optional[PodSpec] = None
 
 
 class TopologyGroup:
@@ -50,7 +85,19 @@ class TopologyGroup:
             self.counts[domain] += 1
 
     def next_domain(self, allowed: Optional[Sequence[str]] = None) -> Optional[str]:
-        """argmin-count domain (mutating: increments the winner)."""
+        """argmin-count domain within the pod's reachable set (mutating:
+        increments the winner).
+
+        Skew is measured against the floor of the REACHABLE domains — a
+        pod whose selector excludes a domain cannot be asked to balance
+        against it — and in that frame the argmin sequence never stretches
+        skew beyond 1, so any max_skew >= 1 is honored without an explicit
+        guard (a pod pinned to one over-full domain still lands there,
+        exactly as the compiled water-fill fills a one-domain allowed set:
+        constraints/compiler.water_fill_takes shares this frame, which is
+        what keeps the two paths in placement parity). max_skew > 1 on the
+        hostname key is realized upstream by bucket fabrication
+        (_compute_hostname: ceil(n/max_skew) domains)."""
         candidates = [
             d for d in self.counts if allowed is None or d in allowed
         ]
@@ -109,8 +156,12 @@ class TopologyGroup:
 
 class Topology:
     """Injects topology-spread decisions as node selectors
-    (ref: topology.go:40-140). Only hostname and zone keys are supported —
-    selection rejects the rest before pods get here."""
+    (ref: topology.go:40-140) — the greedy fallback behind
+    KARPENTER_GREEDY_TOPOLOGY, kept as the compiled path's parity oracle.
+    Handles arbitrary topology keys: hostname fabricates fresh domains;
+    every other key spreads over label values discovered from live nodes,
+    the requirement envelope, and provisioner labels (matching the
+    compiler's discover_domains)."""
 
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
@@ -123,7 +174,7 @@ class Topology:
             if constraint.topology_key == wellknown.HOSTNAME_LABEL:
                 self._compute_hostname(group, members)
             else:
-                self._compute_zonal(group, constraints, members)
+                self._compute_labeled(group, constraints, members)
             allowed_per_pod = [
                 self._allowed_domains_for_pod(pod, group) for pod in members
             ]
@@ -140,12 +191,11 @@ class Topology:
 
     def _topology_groups(self, pods: Sequence[PodSpec]):
         """Group (constraint, pod) pairs by equivalent spread constraint
-        (ref: topology.go:57-75)."""
+        (ref: topology.go:57-75). Arbitrary keys pass through: a key with no
+        discoverable domains simply registers nothing and injects nothing."""
         groups: Dict[Tuple, List[Tuple[TopologySpreadConstraint, PodSpec]]] = {}
         for pod in pods:
             for constraint in pod.topology_spread:
-                if constraint.topology_key not in SUPPORTED_TOPOLOGY_KEYS:
-                    continue
                 groups.setdefault(constraint.group_key(), []).append(
                     (constraint, pod)
                 )
@@ -159,29 +209,45 @@ class Topology:
         for _ in range(num_domains):
             group.register(f"host-domain-{next(_domain_counter)}")
 
-    def _compute_zonal(
+    def _compute_labeled(
         self, group: TopologyGroup, constraints: Constraints, pods: List[PodSpec]
     ) -> None:
-        """Register allowed zones and count existing matching pods per zone
-        from live cluster state (ref: topology.go:112-140)."""
-        allowed = constraints.effective_requirements().allowed(wellknown.ZONE_LABEL)
-        zones = set()
+        """Register allowed domains for an arbitrary label key and count
+        existing matching pods per domain from live cluster state — the
+        arbitrary-key generalization of the reference's zonal pass
+        (ref: topology.go:112-140; zone stays a special case only in where
+        a node's value is read from)."""
+        key = group.constraint.topology_key
+        allowed = constraints.effective_requirements().allowed(key)
+        domains = set()
         for node in self.cluster.list_nodes():
-            if node.zone and allowed.contains(node.zone):
-                zones.add(node.zone)
-        # Zones can also come from the constraint envelope even before any
-        # node exists there.
+            value = self._node_domain(node, key)
+            if value and allowed.contains(value):
+                domains.add(value)
+        # Domains can also come from the constraint envelope (or provisioner
+        # labels) even before any node exists there.
         finite = allowed.finite_values()
         if finite:
-            zones |= set(finite)
-        group.register(*sorted(zones))
+            domains |= set(finite)
+        label_value = constraints.labels.get(key)
+        if label_value and allowed.contains(label_value):
+            domains.add(label_value)
+        group.register(*sorted(domains))
         for pod in self.cluster.list_pods(
             predicate=lambda p: p.node_name is not None
             and group.constraint.matches(p.labels)
         ):
             node = self.cluster.try_get_node(pod.node_name)
-            if node is not None and node.zone:
-                group.increment(node.zone)
+            if node is None:
+                continue
+            value = self._node_domain(node, key)
+            if value:
+                group.increment(value)
+
+    # THE zone-vs-label fallback rule, shared with the compiler's domain
+    # discovery (constraints/terms.node_domain) so the greedy oracle and
+    # the compiled path can never diverge on a node's domain.
+    _node_domain = staticmethod(node_domain)
 
     def _allowed_domains_for_pod(self, pod: PodSpec, group: TopologyGroup):
         """A pod with its own zone/hostname selector restricts its domains."""
@@ -198,64 +264,173 @@ class Topology:
 class Scheduler:
     """Ref: scheduling/scheduler.go:67-126."""
 
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, greedy_topology: Optional[bool] = None):
         self.cluster = cluster
         self.topology = Topology(cluster)
+        if greedy_topology is None:
+            from karpenter_tpu.constraints import greedy_topology_enabled
+
+            greedy_topology = greedy_topology_enabled()
+        self.greedy_topology = greedy_topology
 
     def solve(
         self, provisioner: Provisioner, pods: Sequence[PodSpec]
     ) -> List[Schedule]:
         constraints = provisioner.spec.constraints
-        # Topology decisions are injected into per-pass SHADOW copies, never
-        # the live pod: a fabricated zone/hostname selector must not survive a
-        # failed launch, or retries stay pinned to a blacked-out domain (the
-        # reference works on scheduler-local pod copies too).
+        # Topology decisions (when the greedy oracle is active) are injected
+        # into per-pass SHADOW copies, never the live pod: a fabricated
+        # zone/hostname selector must not survive a failed launch, or
+        # retries stay pinned to a blacked-out domain (the reference works
+        # on scheduler-local pod copies too).
         work = [(pod, self._scheduling_copy(pod)) for pod in pods]
-        self.topology.inject(constraints, [shadow for _, shadow in work])
+        if self.greedy_topology:
+            # The parity oracle: spread resolves host-side ahead of the
+            # solve, exactly like the reference's topology.go pre-pass. The
+            # shadows then drop their spread constraints — inject already
+            # turned them into selectors — while the relaxation ladder and
+            # (rejected-at-selection) affinity still compile as usual.
+            self.topology.inject(constraints, [shadow for _, shadow in work])
+            for _, shadow in work:
+                shadow.topology_spread = []
+        return self._solve_compiled(constraints, work)
+
+    # --- compiled path (default): constraints lower at solve time ----------
+
+    @staticmethod
+    def _compiled_signature(pod: PodSpec) -> Tuple:
+        """Constraint-relevant identity of a pod: pods sharing it share one
+        evaluation AND one schedule's ladder/spread/affinity config (the
+        compiler reads a representative pod)."""
+        return (
+            tuple(
+                (t.key, t.operator, t.value, t.effect) for t in pod.tolerations
+            ),
+            tuple(sorted(pod.node_selector.items())),
+            tuple(
+                (
+                    term.weight,
+                    tuple((r.key, r.operator, r.values) for r in term.requirements),
+                )
+                for term in pod.preferred_terms
+            ),
+            tuple(
+                tuple((r.key, r.operator, r.values) for r in term)
+                for term in pod.required_terms
+            ),
+            tuple(c.group_key() for c in pod.topology_spread),
+            term_fingerprint(pod.pod_affinity_terms),
+            term_fingerprint(pod.pod_anti_affinity_terms),
+            # Labels join the signature ONLY when spread/affinity is in
+            # play: the compiler reads the representative pod's labels
+            # (hostname anti-affinity self-match, spread selector
+            # membership), so label-divergent pods must not share a rep —
+            # while plain pods keep merging regardless of labels.
+            tuple(sorted(pod.labels.items()))
+            if (
+                pod.topology_spread
+                or pod.pod_affinity_terms
+                or pod.pod_anti_affinity_terms
+            )
+            else (),
+        )
+
+    @staticmethod
+    def _level_shadow(pod: PodSpec, state: LadderState) -> PodSpec:
+        import copy as _copy
+
+        shadow = _copy.copy(pod)
+        shadow.node_selector = dict(pod.node_selector)
+        shadow.preferred_terms = list(state.preferred)
+        shadow.required_terms = [list(term) for term in state.required]
+        return shadow
+
+    def _evaluate_compiled(self, constraints: Constraints, shadow: PodSpec):
+        """One signature's evaluation over its shadow: (tightened, merge
+        key, ladder, valid_levels, needs_compiler) or None when no
+        relaxation level is compatible (the pod is skipped, as the legacy
+        path skipped level-0-incompatible pods)."""
+        ladder = build_ladder(shadow)
+        needs = (
+            ladder.num_levels > 1
+            or bool(shadow.topology_spread)
+            or bool(shadow.pod_affinity_terms)
+            or bool(shadow.pod_anti_affinity_terms)
+        )
+        if not needs:
+            # Plain pod: the legacy one-shot evaluation, bit-identical.
+            try:
+                constraints.validate_pod(shadow)
+            except PodIncompatibleError:
+                return None
+            tightened = constraints.tighten(shadow)
+            return (
+                tightened,
+                tightened.requirements.canonical_key(),
+                None,
+                None,
+                False,
+            )
+        valid_levels = []
+        for state in ladder.states:
+            try:
+                constraints.validate_pod(self._level_shadow(shadow, state))
+                valid_levels.append(True)
+            except PodIncompatibleError:
+                valid_levels.append(False)
+        if not any(valid_levels):
+            return None
+        # The schedule envelope is the WIDEST one — provisioner constraints
+        # plus the pod's own selector, with no ladder terms: every level's
+        # candidate types must survive the fleet filter, and each level's
+        # mask narrows within it (constraints/compiler.py).
+        base = self._scheduling_copy(shadow)
+        base.preferred_terms = []
+        base.required_terms = []
+        tightened = constraints.tighten(base)
+        return (
+            tightened,
+            tightened.requirements.canonical_key(),
+            ladder,
+            valid_levels,
+            True,
+        )
+
+    def _solve_compiled(
+        self, constraints: Constraints, work: Sequence[Tuple[PodSpec, PodSpec]]
+    ) -> List[Schedule]:
+        evaluated: Dict[Tuple, object] = {}
+        _INCOMPATIBLE = object()
         schedules: Dict[Tuple, Schedule] = {}
         ordered: List[Schedule] = []
-        # validate+tighten depend only on the shadow's tolerations and
-        # scheduling requirements (post-topology-injection), so identical
-        # pods — the bulk of any storm — share ONE evaluation instead of a
-        # per-pod Requirements merge/consolidate pass (measured: ~1.3s of a
-        # 10k-pod storm's drain was spent re-tightening 5 identical specs
-        # 2000x each).
-        _INCOMPATIBLE = object()
-        evaluated: Dict[Tuple, object] = {}
         for pod, shadow in work:
-            signature = (
-                tuple(
-                    (t.key, t.operator, t.value, t.effect)
-                    for t in shadow.tolerations
-                ),
-                tuple(
-                    (r.key, r.operator, tuple(r.values))
-                    for r in shadow.scheduling_requirements()
-                ),
-            )
+            signature = self._compiled_signature(shadow)
             entry = evaluated.get(signature)
             if entry is None:
-                try:
-                    constraints.validate_pod(shadow)
-                except PodIncompatibleError:
-                    # logged-and-skipped in the reference (scheduler.go:96)
-                    evaluated[signature] = _INCOMPATIBLE
-                    continue
-                tightened = constraints.tighten(shadow)
-                entry = (tightened, tightened.requirements.canonical_key())
+                entry = (
+                    self._evaluate_compiled(constraints, shadow) or _INCOMPATIBLE
+                )
                 evaluated[signature] = entry
-            elif entry is _INCOMPATIBLE:
+            if entry is _INCOMPATIBLE:
                 continue
-            tightened, canonical = entry
+            tightened, canonical, ladder, valid_levels, needs = entry
             accelerators = frozenset(
                 name
                 for name in wellknown.ACCELERATOR_RESOURCES
                 if pod.requests.get(name, 0) > 0
             )
-            key = (canonical, accelerators)
+            # Compiled schedules merge by full signature (the compiler reads
+            # a representative shadow, so members must be homogeneous);
+            # plain schedules keep the legacy canonical-requirements merge.
+            key = (signature, accelerators) if needs else (canonical, accelerators)
             schedule = schedules.get(key)
             if schedule is None:
-                schedule = Schedule(constraints=tightened)
+                schedule = Schedule(
+                    constraints=tightened,
+                    ladder=ladder,
+                    valid_levels=valid_levels,
+                    needs_compiler=needs,
+                    rep=shadow,
+                )
                 schedules[key] = schedule
                 ordered.append(schedule)
             schedule.pods.append(pod)

@@ -3,19 +3,30 @@
 Ref: pkg/controllers/selection/{controller,preferences}.go — watches all pods
 (MaxConcurrentReconciles 10,000 in the reference; our runtime fans out over a
 thread pool), filters provisionable ones, rejects unsupported scheduling
-features, relaxes preferences on retry, and hands the pod to the first
-matching provisioner in alphabetical order.
+features, and hands the pod to the first matching provisioner in alphabetical
+order.
+
+Preference relaxation no longer lives here: the reference re-ran the whole
+schedule once per relaxation level across retries (preferences.go:64-106);
+the constraint compiler now lowers the full ladder into the [L, G, T] kernel
+dispatch (constraints/), which solves every level at once and picks the
+strictest feasible one on device. The UID-keyed TTL cache survives as the
+BOOKKEEPING layer: the provisioning worker records the kernel-chosen level
+per pod after each constrained solve (Preferences.record), preserving the
+reference's observability (which pods are running relaxed, at what level)
+without the retry loop or its detached-copy re-solve.
 """
 
 from __future__ import annotations
 
-import copy
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 from karpenter_tpu.api import wellknown
-from karpenter_tpu.api.pods import PodSpec, PreferredTerm
+from karpenter_tpu.api.pods import DO_NOT_SCHEDULE, PodSpec
 from karpenter_tpu.api.provisioner import PodIncompatibleError
-from karpenter_tpu.api.requirements import Requirement, SUPPORTED_OPERATORS
+from karpenter_tpu.api.requirements import SUPPORTED_OPERATORS
+from karpenter_tpu.constraints import greedy_topology_enabled
+from karpenter_tpu.constraints.terms import term_topology_key
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.provisioning import ProvisioningController
 from karpenter_tpu.controllers.scheduling import SUPPORTED_TOPOLOGY_KEYS
@@ -28,66 +39,44 @@ class UnsupportedPodError(Exception):
     (ref: selection/controller.go validate:108-159)."""
 
 
-# One pod's relaxation state: (preferred terms left, required OR-terms left).
-_RelaxState = Tuple[List[PreferredTerm], List[List[Requirement]]]
-
-
 class Preferences:
-    """UID-keyed relaxation side-cache for pods that keep failing to schedule
-    (ref: selection/preferences.go:40-106): first drop the heaviest preferred
-    term, then drop leading required OR-terms so later alternatives get tried.
-
-    The stored pod spec is never mutated — relaxation lives in this cache and
-    the selection path schedules a detached copy carrying the relaxed terms.
-    Like the reference's go-cache, the TTL refreshes only when a relax step
-    actually happens (Set, not Get): a pod stuck for five minutes gets its
-    full preferences back and the relaxation cycle restarts."""
+    """UID-keyed TTL cache of each pod's kernel-chosen relaxation level
+    (ref: selection/preferences.go:40-106 — the reference stored the relaxed
+    terms themselves and re-drove the solve; the kernel now solves every
+    level in one dispatch, so this cache records the OUTCOME). The stored
+    pod spec is never mutated. Entries expire on their own TTL, matching the
+    reference's go-cache: a pod that stops being re-solved for five minutes
+    simply ages out."""
 
     TTL_SECONDS = 300.0
 
     def __init__(self, clock: Optional[Clock] = None):
         self._cache = TtlCache(self.TTL_SECONDS, clock)
 
-    def current(self, pod: PodSpec) -> PodSpec:
-        """The pod as the provisioning path should see it right now: either
-        the pod itself (never relaxed) or a detached copy carrying the cached
-        relaxation."""
-        state = self._cache.get(pod.uid)
-        if state is None:
-            return pod
-        return self._with_terms(pod, state)
+    def record(self, uid: str, level: int, description: str = "") -> None:
+        """Record the level the [L, G, T] dispatch chose for this pod's
+        schedule (called by the provisioning worker after each constrained
+        solve). Level 0 = full preferences honored — recorded too, so
+        `level()` distinguishes "solved strict" from "never solved"."""
+        self._cache.set(uid, (int(level), description))
 
-    def advance(self, pod: PodSpec) -> bool:
-        """Relax one more step after a failed scheduling attempt
-        (ref: preferences.go:64-106 relax). Returns False when only the last
-        required term remains — that one is never dropped."""
-        preferred, required = self._cache.get(pod.uid) or self._copy_terms(pod)
-        if preferred:
-            heaviest = max(preferred, key=lambda term: term.weight)
-            preferred = [term for term in preferred if term is not heaviest]
-        elif len(required) > 1:
-            required = required[1:]
-        else:
-            return False
-        self._cache.set(pod.uid, (preferred, required))
-        return True
+    def level(self, pod_or_uid) -> Optional[int]:
+        uid = getattr(pod_or_uid, "uid", pod_or_uid)
+        entry: Optional[Tuple[int, str]] = self._cache.get(uid)
+        return None if entry is None else entry[0]
 
-    @staticmethod
-    def _copy_terms(pod: PodSpec) -> _RelaxState:
-        return list(pod.preferred_terms), [list(term) for term in pod.required_terms]
+    def describe(self, pod_or_uid) -> Optional[str]:
+        uid = getattr(pod_or_uid, "uid", pod_or_uid)
+        entry: Optional[Tuple[int, str]] = self._cache.get(uid)
+        return None if entry is None else entry[1]
 
-    @staticmethod
-    def _with_terms(pod: PodSpec, state: _RelaxState) -> PodSpec:
-        shadow = copy.copy(pod)
-        shadow.preferred_terms = list(state[0])
-        shadow.required_terms = [list(term) for term in state[1]]
-        return shadow
+    def forget(self, uid: str) -> None:
+        self._cache.delete(uid)
 
 
 class SelectionController:
     """Ref: selection/controller.go:55-102."""
 
-    REQUEUE_SECONDS = 1.0  # fresh attempt (relaxation advanced; ref: :77)
     # Re-verify cadence for pods a worker has ACCEPTED (batched or in its
     # overflow backlog): the worker owns delivery from here and watch events
     # still pull the key forward immediately, so the safety re-verify can be
@@ -105,6 +94,10 @@ class SelectionController:
         self.cluster = cluster
         self.provisioning = provisioning
         self.preferences = Preferences(cluster.clock)
+        # The provisioning workers report each constrained solve's chosen
+        # relaxation level back through this hook — selection owns the
+        # bookkeeping cache, provisioning owns the solve.
+        provisioning.level_recorder = self.preferences.record
         # UID → consecutive no-match failures; entries expire on their own so
         # deleted pods don't leak state.
         self._failures = TtlCache(2 * self.BACKOFF_MAX_SECONDS, cluster.clock)
@@ -118,30 +111,20 @@ class SelectionController:
         except UnsupportedPodError:
             return None  # ignored; kube-scheduler owns it (ref: :70-75)
 
-        # Schedule the pod at its current relaxation level. The stored spec
-        # is never touched: workers receive a detached relaxed copy
-        # (ref: preferences.go keeps relaxation in a UID-keyed TTL cache and
-        # provisioner.go:172 deliberately batches the in-memory relaxed pod).
-        relaxed = self.preferences.current(pod)
-        matched = self._select_and_enqueue(relaxed)
+        # Hand the STORED pod over untouched: the scheduler compiles its
+        # full relaxation ladder into the solve, so there is no relaxed copy
+        # to fabricate here (the old detached-copy re-solve loop is gone).
+        matched = self._select_and_enqueue(pod)
         if matched:
-            # Accepted by a worker (batch or overflow backlog): re-verify on
-            # the slow cadence; no further relaxation (relaxation is only
-            # for genuine incompatibility; ref: preferences.go:50-63).
             self._failures.delete(pod.uid)
             return self.ACCEPTED_REQUEUE_SECONDS
-        # No provisioner matched: relax one step if possible, then retry.
-        # The retry happens EVEN when relaxation is exhausted — the reference
+        # No provisioner matched. The retry happens anyway — the reference
         # returns the match error so controller-runtime keeps requeueing
         # (selectProvisioner:80-102), which is what heals a pod whose
         # provisioner appears (or widens) later — but with exponential
         # backoff, so a permanently-unschedulable pod isn't polled at 1 Hz
-        # forever.
-        if self.preferences.advance(pod):
-            # A fresh relaxation level is a new scheduling attempt worth
-            # retrying promptly.
-            self._failures.delete(pod.uid)
-            return self.REQUEUE_SECONDS
+        # forever. (Relaxation cannot help a no-match: every ladder level is
+        # already solved inside the kernel dispatch once a worker accepts.)
         failures = self._failures.get(pod.uid) or 0
         self._failures.set(pod.uid, failures + 1)
         # min() on the exponent too: the counter keeps growing for a pod
@@ -152,17 +135,16 @@ class SelectionController:
         )
 
     def _validate(self, pod: PodSpec) -> None:
-        if pod.pod_affinity_terms:
-            raise UnsupportedPodError("pod affinity is not supported")
-        if pod.pod_anti_affinity_terms:
-            raise UnsupportedPodError("pod anti-affinity is not supported")
+        greedy = greedy_topology_enabled()
+        SelectionController._validate_affinity(pod, greedy)
         if pod.match_fields_terms:
             raise UnsupportedPodError("node affinity matchFields is not supported")
-        for constraint in pod.topology_spread:
-            if constraint.topology_key not in SUPPORTED_TOPOLOGY_KEYS:
-                raise UnsupportedPodError(
-                    f"topology key {constraint.topology_key!r} is not supported"
-                )
+        if greedy:
+            for constraint in pod.topology_spread:
+                if constraint.topology_key not in SUPPORTED_TOPOLOGY_KEYS:
+                    raise UnsupportedPodError(
+                        f"topology key {constraint.topology_key!r} is not supported"
+                    )
         for terms in [
             *[term.requirements for term in pod.preferred_terms],
             *pod.required_terms,
@@ -172,6 +154,46 @@ class SelectionController:
                     raise UnsupportedPodError(
                         f"operator {requirement.operator!r} is not supported"
                     )
+
+    @staticmethod
+    def _validate_affinity(pod: PodSpec, greedy: bool) -> None:
+        for term in pod.pod_affinity_terms:
+            key = term_topology_key(term)
+            if greedy or key == wellknown.HOSTNAME_LABEL:
+                # Hostname affinity ("pack my pods onto one node") has no
+                # sound lowering onto fresh nodes; the greedy oracle path
+                # keeps the reference's blanket rejection.
+                raise UnsupportedPodError("pod affinity on this key is not supported")
+            if key != wellknown.ZONE_LABEL and not any(
+                c.topology_key == key for c in pod.topology_spread
+            ):
+                # Affinity on a custom key needs that key's spread
+                # constraint to give fresh nodes a domain (labels are
+                # stamped at registration); without it the compiler has no
+                # sound lowering and would silently drop the term.
+                raise UnsupportedPodError(
+                    f"pod affinity on key {key!r} requires a topology spread "
+                    "constraint on the same key"
+                )
+        if greedy and pod.pod_anti_affinity_terms:
+            raise UnsupportedPodError("pod anti-affinity is not supported")
+        for term in pod.pod_anti_affinity_terms:
+            key = term_topology_key(term)
+            if key in (wellknown.HOSTNAME_LABEL, wellknown.ZONE_LABEL):
+                continue
+            if not any(
+                c.topology_key == key
+                and c.when_unsatisfiable == DO_NOT_SCHEDULE
+                for c in pod.topology_spread
+            ):
+                # The compiler only lowers custom-key exclusions for the
+                # domain-expanded (hard) spread key; accepting anything else
+                # would silently drop the constraint (the reference rejects
+                # these pods so kube-scheduler owns them).
+                raise UnsupportedPodError(
+                    f"pod anti-affinity on key {key!r} requires a "
+                    "DoNotSchedule topology spread constraint on the same key"
+                )
 
     def _select_and_enqueue(self, pod: PodSpec) -> bool:
         """First matching provisioner in alphabetical order wins
@@ -189,9 +211,35 @@ class SelectionController:
                 # selection reads the provisioning controller's in-memory
                 # provisioners (ref: selectProvisioner:80-102) — the stored
                 # spec is pristine and intentionally wider.
-                worker.provisioner.spec.constraints.validate_pod(pod)
+                self._compatible(worker, pod)
             except PodIncompatibleError:
                 continue
             worker.add(pod)
             return True
         return False
+
+    @staticmethod
+    def _compatible(worker, pod: PodSpec) -> None:
+        """Raise PodIncompatibleError unless SOME relaxation level of the
+        pod fits the worker's constraints — level 0 alone would wrongly
+        bounce a pod whose impossible preference the kernel ladder will
+        drop (the legacy path healed this across relax-retry rounds)."""
+        constraints = worker.provisioner.spec.constraints
+        try:
+            constraints.validate_pod(pod)
+            return
+        except PodIncompatibleError:
+            if not pod.preferred_terms and len(pod.required_terms) <= 1:
+                raise
+        from karpenter_tpu.constraints.ladder import build_ladder
+        from karpenter_tpu.controllers.scheduling import Scheduler
+
+        for state in build_ladder(pod).states[1:]:
+            try:
+                constraints.validate_pod(Scheduler._level_shadow(pod, state))
+                return
+            except PodIncompatibleError:
+                continue
+        raise PodIncompatibleError(
+            f"pod {pod.namespace}/{pod.name} incompatible at every relaxation level"
+        )

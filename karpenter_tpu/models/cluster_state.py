@@ -660,6 +660,27 @@ class DeviceClusterState:
         with self._lock:
             return self._generation
 
+    def compile_tag(self) -> Optional[Tuple[int, int]]:
+        """(epoch, generation) for keying compiled-constraint envelopes
+        (constraints/compiler.CompilerCache). Generation bumps on EVERY
+        delta flush and epoch on full uploads, so the pair changes whenever
+        the encoded cluster changes — epoch alone would serve a stale
+        envelope across ordinary watch deltas. None while deltas are still
+        pending (or the state is torn/unflushed): the store has moved past
+        the last flush, so callers skip caching rather than key live
+        cluster reads (spread seed counts, anti-affinity exclusions) to a
+        tag that predates them."""
+        with self._lock:
+            if (
+                self._dev is None
+                or self._full_upload
+                or self._torn is not None
+                or self._group_dirty
+                or self._node_dirty
+            ):
+                return None
+            return (self._epoch, self._generation)
+
     def is_current(self, handle: DevicePodGroups) -> bool:
         with self._lock:
             return (

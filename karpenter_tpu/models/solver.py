@@ -459,6 +459,15 @@ def _quarantine_after_fetch_failure(
     single device is the whole-device verdict's territory."""
     if not any(handle.shards > 1 for handle in handles):
         return
+    quarantine_devices(error)
+
+
+def quarantine_devices(error: BaseException) -> None:
+    """Run the wedged-chip quarantine over the whole device set (best
+    effort — diagnosis must never mask the original error). Shared by the
+    fused-plan fetch hook above and the constrained [L, G, T] dispatch's
+    fetch (constraints/solve), so a chip that dies during a sharded
+    constrained solve also shrinks the mesh for the next dispatch."""
     try:
         from karpenter_tpu.utils import backend_health
 
@@ -576,6 +585,23 @@ def solve_mesh():
     from karpenter_tpu.parallel.mesh import make_mesh
 
     return make_mesh()
+
+
+def constrained_level_hook(mesh=None):
+    """(constrain, shards) for the constrained [L, G, T] dispatch
+    (constraints/solve._dispatch_kernel): under the same mesh policy as the
+    fused solve, the relaxation-level axis shards across every device
+    (parallel/sharded_solver.constrained_level_sharding); on a single
+    device the hook is None and the dispatch is the plain jit. Kept here so
+    the constrained path can never disagree with solve_mesh about when the
+    mesh is live (wedged-chip shrink included)."""
+    if mesh is None:
+        mesh = solve_mesh()
+    if mesh is None:
+        return None, 1
+    from karpenter_tpu.parallel.sharded_solver import constrained_level_sharding
+
+    return constrained_level_sharding(mesh)
 
 
 _MULTI_DEVICE: Optional[bool] = None

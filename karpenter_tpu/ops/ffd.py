@@ -122,6 +122,10 @@ class Packing:
     # first). None = reference semantics (derive rows from
     # instance_type_options x offered zones, priority per type).
     pool_options: Optional[List[PoolOption]] = None
+    # Constrained plans may stamp extra labels on every node of this packing
+    # (custom-key topology domains realize as labels at registration —
+    # constraints/solve.decode_constrained); None = no extra labels.
+    node_labels: Optional[dict] = None
 
     @property
     def pods(self) -> List[PodSpec]:

@@ -258,6 +258,259 @@ def pack_kernel(
     )
 
 
+# --- constrained multi-level pack: the [L, G, T] dispatch --------------------
+#
+# The constraint compiler (karpenter_tpu/constraints/compiler.py) lowers pod
+# affinity/anti-affinity, topology-spread, and the preference-relaxation
+# ladder into per-level tensors; this kernel solves EVERY relaxation level in
+# one vmapped dispatch and picks the strictest feasible level on device —
+# replacing the host-side relax-retry loop (one solve per level per 1s
+# requeue) with a single kernel call. Per level l:
+#
+#   * allow[l, g, t]   — group g may be packed onto type t at this level
+#                        (ladder envelope ∩ spread-domain zone offering ∩
+#                        affinity restrictions; fit is re-checked here).
+#   * penalty[l, g, t] — additive $/pod-ish spread/affinity pressure, folded
+#                        into the cost-mode score (ScheduleAnyway spread,
+#                        preferred-term steering).
+#   * counts[l, g]     — pods per group AT THIS LEVEL: domain-expanded
+#                        sub-groups carry per-level water-filled takes, so a
+#                        level that narrows the allowed domains redistributes
+#                        its pods.
+#   * conflict[g, h]   — g and h may not share a node (anti-affinity on the
+#                        hostname key; sub-groups pinned to different
+#                        domains).
+#   * node_cap[g]      — max pods of g per node (hostname topology spread
+#                        lowers to cap = max_skew; hostname self-anti-
+#                        affinity to cap = 1).
+#
+# Level selection: the strictest (lowest-index) level minimizing total
+# unschedulable pods wins; per-group the kernel also reports the first
+# level at which that group alone was fully packable (the bookkeeping the
+# selection TTL cache records instead of driving retries).
+
+NODE_CAP_NONE = 2**30  # int32-safe "no per-node cap" sentinel
+
+
+class LevelPack(NamedTuple):
+    """Output of the [L, G, T] constrained dispatch: the chosen level's
+    rounds plus the level-selection evidence."""
+
+    rounds: PackRounds  # the chosen level's rounds (fields as PackRounds)
+    chosen_level: jnp.ndarray  # [] int32 — strictest feasible level index
+    group_level: jnp.ndarray  # [G] int32 — first feasible level per group (L if none)
+    level_unsched: jnp.ndarray  # [L, G] int32 — unschedulable per level
+
+
+def _fill_one_node_constrained(capacity, vectors, counts, allow, conflict, node_cap):
+    """Greedy-fill one node of one type under constraint masks.
+
+    Same largest-first scan as _fill_one_node (quirk-free), plus: groups with
+    allow=False are skipped without aborting the fill; a group conflicting
+    with one already placed on THIS node is skipped; per-group node caps
+    bound the fill. The whole fill aborts only when the first *eligible*
+    active group cannot place a single pod (FFD "largest fits nowhere")."""
+    num_groups = vectors.shape[0]
+    eligible = (counts > 0) & allow
+    any_eligible = jnp.any(eligible)
+    first_eligible = jnp.argmax(eligible)
+
+    def step(carry, g):
+        remaining, placed, abort = carry
+        vec = vectors[g]
+        cnt = counts[g]
+        ratio = jnp.where(vec > 0, remaining / jnp.where(vec > 0, vec, 1.0), _INF)
+        n_fit = jnp.floor(jnp.min(ratio) + _EPS)
+        n_fit = jnp.maximum(n_fit, 0.0).astype(jnp.int32)
+        conflicted = jnp.any(placed & conflict[g])
+        allowed = eligible[g] & ~conflicted & ~abort
+        n = jnp.where(
+            allowed, jnp.minimum(jnp.minimum(cnt, n_fit), node_cap[g]), 0
+        )
+        abort = abort | ((g == first_eligible) & eligible[g] & ~conflicted & (n == 0))
+        remaining = remaining - n.astype(vectors.dtype) * vec
+        placed = placed | (jnp.arange(num_groups) == g) & (n > 0)
+        return (remaining, placed, abort), n
+
+    (_, _, abort), packed = jax.lax.scan(
+        step,
+        (capacity, jnp.zeros((num_groups,), bool), jnp.asarray(False)),
+        jnp.arange(num_groups),
+    )
+    packed = jnp.where(abort | ~any_eligible, 0, packed)
+    return packed
+
+
+def _pack_one_level(
+    vectors, counts, capacity, valid_types, prices, allow, penalty,
+    conflict, node_cap, *, mode: str,
+) -> PackRounds:
+    """One relaxation level's full round loop — the constrained analogue of
+    pack_kernel's body, vmapped over L by pack_kernel_levels."""
+    num_groups = vectors.shape[0]
+    num_types = capacity.shape[0]
+    mr = max_rounds(num_groups)
+
+    fits = jnp.all(vectors[:, None, :] <= capacity[None, :, :] + 1e-6, axis=-1)
+    usable = allow & fits & valid_types[None, :]  # [G, T]
+    packable = usable.any(axis=1)
+    # Groups no type admits at this level retire immediately — without this
+    # the round loop would spin on them until the iteration guard trips and
+    # flags a phantom overflow.
+    init_unsched = jnp.where(packable, 0, counts).astype(jnp.int32)
+    counts0 = jnp.where(packable, counts, 0).astype(jnp.int32)
+
+    largest_valid = num_types - 1 - jnp.argmax(valid_types[::-1])
+    ref_cap = jnp.maximum(capacity[largest_valid], 1.0)
+    group_weight = jnp.max(vectors / ref_cap, axis=1)  # [G]
+
+    def body(state: _LoopState) -> _LoopState:
+        fills = jax.vmap(
+            lambda cap, allow_t: _fill_one_node_constrained(
+                cap, vectors, state.counts, allow_t, conflict, node_cap
+            )
+        )(capacity, usable.T)  # [T, G]
+        fills = jnp.where(valid_types[:, None], fills, 0)
+        sums = fills.sum(axis=1)
+        packs_any = (sums > 0) & valid_types
+
+        if mode == "ffd":
+            # Masked analogue of the reference bound: the best achievable
+            # pod count this round; the smallest type achieving it wins.
+            bound = jnp.max(sums)
+            achieves = (sums == bound) & valid_types & (bound > 0)
+            t_sel = jnp.argmax(achieves)
+            have_pack = bound > 0
+        elif mode == "cost":
+            weighted = fills.astype(jnp.float32) @ group_weight  # [T]
+            pen = jnp.sum(fills.astype(jnp.float32) * penalty.T, axis=1)  # [T]
+            score = jnp.where(
+                packs_any, (prices + pen) / jnp.maximum(weighted, 1e-9), _INF
+            )
+            t_sel = jnp.argmin(score)
+            have_pack = jnp.any(packs_any)
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+
+        fill = fills[t_sel]
+        safe = state.counts // jnp.maximum(fill, 1)
+        repl_per_group = jnp.where(fill > 0, safe, jnp.iinfo(jnp.int32).max)
+        repl = jnp.maximum(jnp.min(repl_per_group), 1).astype(jnp.int32)
+
+        counts_packed = state.counts - repl * fill
+        round_type = state.round_type.at[state.num_rounds].set(t_sel.astype(jnp.int32))
+        round_fill = state.round_fill.at[state.num_rounds].set(fill.astype(jnp.int32))
+        round_repl = state.round_repl.at[state.num_rounds].set(repl)
+
+        first_active = jnp.argmax(state.counts > 0)
+        unsched = state.unschedulable.at[first_active].add(
+            jnp.where(have_pack, 0, state.counts[first_active])
+        )
+        counts_unsched = state.counts.at[first_active].set(
+            jnp.where(have_pack, state.counts[first_active], 0)
+        )
+        return _LoopState(
+            counts=jnp.where(have_pack, counts_packed, counts_unsched),
+            round_type=jnp.where(have_pack, round_type, state.round_type),
+            round_fill=jnp.where(have_pack, round_fill, state.round_fill),
+            round_repl=jnp.where(have_pack, round_repl, state.round_repl),
+            num_rounds=state.num_rounds + jnp.where(have_pack, 1, 0),
+            unschedulable=unsched,
+            iters=state.iters + 1,
+        )
+
+    def cond(state: _LoopState):
+        return (state.counts.sum() > 0) & (state.iters < mr + num_groups)
+
+    init = _LoopState(
+        counts=counts0,
+        round_type=jnp.zeros((mr,), jnp.int32),
+        round_fill=jnp.zeros((mr, num_groups), jnp.int32),
+        round_repl=jnp.zeros((mr,), jnp.int32),
+        num_rounds=jnp.asarray(0, jnp.int32),
+        unschedulable=init_unsched,
+        iters=jnp.asarray(0, jnp.int32),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    return PackRounds(
+        round_type=final.round_type,
+        round_fill=final.round_fill,
+        round_repl=final.round_repl,
+        num_rounds=jnp.minimum(final.num_rounds, mr),
+        unschedulable=final.unschedulable,
+        overflow=(final.counts.sum() > 0) | (final.num_rounds > mr),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "constrain"))
+def pack_kernel_levels(
+    vectors,  # [G, R] f32 — sub-group request vectors, FFD-sorted desc
+    level_counts,  # [L, G] i32 — per-level pods per sub-group
+    capacity,  # [T, R] f32
+    total,  # [T, R] f32 (layout parity with pack_kernel; the quirk-free
+    #                     constrained fill does not read it)
+    valid_types,  # [T] bool
+    prices,  # [T] f32
+    level_allow,  # [L, G, T] bool
+    level_penalty,  # [L, G, T] f32
+    conflict,  # [G, G] bool
+    node_cap,  # [G] i32 (NODE_CAP_NONE = uncapped)
+    *,
+    mode: str = "cost",
+    constrain=None,
+) -> LevelPack:
+    """THE [L, G, T] dispatch: solve every relaxation level, pick the
+    strictest feasible one on device. `constrain` is the mesh hook
+    (parallel/sharded_solver.constrained_level_sharding): it shards the L
+    axis over the device mesh so each chip solves its own levels — the round
+    loops are sequential state machines, but levels are embarrassingly
+    parallel — with one tiny cross-L argmin collective at the tail."""
+    del total
+    num_levels = level_counts.shape[0]
+    lg = (lambda x: x) if constrain is None else constrain
+    level_counts = lg(level_counts)
+    level_allow = lg(level_allow)
+    level_penalty = lg(level_penalty)
+
+    per_level = jax.vmap(
+        functools.partial(
+            _pack_one_level,
+            vectors,
+            capacity=capacity,
+            valid_types=valid_types,
+            prices=prices,
+            conflict=conflict,
+            node_cap=node_cap,
+            mode=mode,
+        )
+    )(level_counts, allow=level_allow, penalty=level_penalty)
+
+    unsched = per_level.unschedulable  # [L, G]
+    overflow = per_level.overflow  # [L] bool
+    # A level's miss count is its unschedulable pods PLUS its assignment
+    # shortfall: a level whose domain restrictions dropped pods from the
+    # counts entirely (the compiler zeroes sub-groups whose domain the
+    # level forbids) must not look feasible just because nothing it was
+    # given went unplaced. The fullest level defines the batch demand.
+    assigned = level_counts.sum(axis=1)  # [L]
+    shortfall = jnp.max(assigned) - assigned
+    totals = (
+        unsched.sum(axis=1) + shortfall + overflow.astype(jnp.int32) * (2**30)
+    )
+    chosen = jnp.argmin(totals).astype(jnp.int32)  # first min = strictest
+    rounds = jax.tree_util.tree_map(lambda leaf: leaf[chosen], per_level)
+    feasible = (unsched == 0) & ~overflow[:, None]  # [L, G]
+    group_level = jnp.where(
+        feasible.any(axis=0), jnp.argmax(feasible, axis=0), num_levels
+    ).astype(jnp.int32)
+    return LevelPack(
+        rounds=rounds,
+        chosen_level=chosen,
+        group_level=group_level,
+        level_unsched=unsched,
+    )
+
+
 def pad_to(array: np.ndarray, size: int, axis: int = 0, value=0) -> np.ndarray:
     pad = size - array.shape[axis]
     if pad <= 0:

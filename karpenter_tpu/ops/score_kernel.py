@@ -35,10 +35,20 @@ class LPResult(NamedTuple):
     objective: jnp.ndarray  # [] float — relaxed $/hr (lower bound-ish)
 
 
-def feasibility_mask(vectors: jnp.ndarray, capacity: jnp.ndarray, valid_types) -> jnp.ndarray:
-    """[G, T] bool — can one pod of group g fit an empty node of type t."""
+def feasibility_mask(
+    vectors: jnp.ndarray, capacity: jnp.ndarray, valid_types, allow=None
+) -> jnp.ndarray:
+    """[G, T] bool — can one pod of group g fit an empty node of type t.
+
+    `allow` is an optional [G, T] constraint mask (one level of the
+    constraint compiler's [L, G, T] tensor — see constraints/compiler.py):
+    a (group, type) pair the active relaxation level forbids is infeasible
+    regardless of fit, so the LP's assignment mass never lands on it."""
     fits = jnp.all(vectors[:, None, :] <= capacity[None, :, :] + 1e-6, axis=-1)
-    return fits & valid_types[None, :]
+    mask = fits & valid_types[None, :]
+    if allow is not None:
+        mask = mask & allow
+    return mask
 
 
 def lp_objective(

@@ -184,3 +184,44 @@ def _pad(array, size, value):
         return array
     widths = [(0, size - array.shape[0])] + [(0, 0)] * (array.ndim - 1)
     return np.pad(array, widths, constant_values=value)
+
+
+# --- constrained [L, G, T] level sharding ------------------------------------
+#
+# The constrained pack dispatch (ops/pack_kernel.pack_kernel_levels) vmaps a
+# sequential round loop over the relaxation-level axis. The round loops are
+# lax.while_loop state machines — the same reason the PR 6/9 pack rounds
+# replicate instead of sharding [G, T] — but LEVELS are embarrassingly
+# parallel: each level is an independent solve over the same fleet. So the
+# multi-chip lowering shards the L axis across every device of the
+# ("groups", "types") mesh (both axes flattened), each chip solves its own
+# levels, and the only collective is the tiny cross-level argmin + the
+# chosen level's round-state gather at the tail. Decode is bit-identical to
+# the single-device dispatch: the per-level math never sees the mesh.
+
+_LEVEL_HOOK_CACHE: dict = {}
+
+
+def constrained_level_sharding(mesh=None):
+    """(constrain, shards) for pack_kernel_levels: `constrain` pins every
+    [L, ...] operand's leading axis over the whole mesh; cached per device
+    set so the jitted dispatch (which hashes the hook as a static arg)
+    compiles once per mesh, not once per call."""
+    from karpenter_tpu.parallel.mesh import GROUPS_AXIS, TYPES_AXIS
+
+    mesh = mesh or make_mesh()
+    if mesh is None or mesh.devices.size <= 1:
+        return None, 1
+    key = tuple(int(d.id) for d in mesh.devices.flat)
+    cached = _LEVEL_HOOK_CACHE.get(key)
+    if cached is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P((GROUPS_AXIS, TYPES_AXIS)))
+
+        def constrain(x):
+            return jax.lax.with_sharding_constraint(x, sharding)
+
+        cached = (constrain, int(mesh.devices.size))
+        _LEVEL_HOOK_CACHE[key] = cached
+    return cached

@@ -1,0 +1,907 @@
+"""Constraint compiler suite: the [L, G, T] dispatch, kernel/mirror parity,
+compiled-vs-greedy placement parity on the seed scenarios, anti-affinity
+scenarios the greedy pass cannot express, the relaxation ladder, the
+compiler cache, and sharded-vs-single decode parity."""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.pods import (
+    SCHEDULE_ANYWAY,
+    PreferredTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api.provisioner import (
+    Constraints,
+    Provisioner,
+    ProvisionerSpec,
+)
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.constraints import build_ladder, shared_cache
+from karpenter_tpu.constraints.compiler import (
+    CompilerCache,
+    compile_constraints,
+    discover_domains,
+    water_fill_takes,
+)
+from karpenter_tpu.constraints.mirror import pack_levels_host
+from karpenter_tpu.controllers.scheduling import Scheduler, TopologyGroup
+from karpenter_tpu.ops.pack_kernel import NODE_CAP_NONE, pack_kernel_levels
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+def provisioner(name="default", **kwargs) -> Provisioner:
+    return Provisioner(name=name, spec=ProvisionerSpec(**kwargs))
+
+
+def zonal_spread(max_skew=1, labels=None, when=None):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=wellknown.ZONE_LABEL,
+        match_labels=labels or {"app": "web"},
+        **({"when_unsatisfiable": when} if when else {}),
+    )
+
+
+# --- the relaxation ladder ---------------------------------------------------
+
+
+class TestLadder:
+    def test_step_sequence_matches_reference_relax(self):
+        pod = fixtures.pod(
+            preferred_terms=[
+                PreferredTerm(weight=1, requirements=[Requirement.in_("a", ["x"])]),
+                PreferredTerm(weight=9, requirements=[Requirement.in_("b", ["y"])]),
+            ],
+            required_terms=[
+                [Requirement.in_(wellknown.ZONE_LABEL, ["test-zone-1"])],
+                [Requirement.in_(wellknown.ZONE_LABEL, ["test-zone-2"])],
+            ],
+        )
+        ladder = build_ladder(pod)
+        # full -> drop w9 -> drop w1 -> drop first required OR-term (the
+        # last required term is never dropped).
+        assert ladder.num_levels == 4
+        assert [len(s.preferred) for s in ladder.states] == [2, 1, 0, 0]
+        assert [len(s.required) for s in ladder.states] == [2, 2, 2, 1]
+        assert ladder.states[1].preferred[0].weight == 1  # heaviest dropped first
+
+    def test_plain_pod_has_single_level(self):
+        assert build_ladder(fixtures.pod()).num_levels == 1
+
+    def test_depth_cap_keeps_terminal_state(self):
+        pod = fixtures.pod(
+            preferred_terms=[
+                PreferredTerm(weight=i, requirements=[Requirement.in_("k", ["v"])])
+                for i in range(20)
+            ]
+        )
+        ladder = build_ladder(pod)
+        assert ladder.num_levels == 8
+        assert len(ladder.states[-1].preferred) == 0  # terminal state kept
+
+
+# --- kernel <-> mirror parity ------------------------------------------------
+
+
+class TestKernelMirrorParity:
+    """The jitted [L, G, T] dispatch and its numpy mirror must be
+    bit-identical — that is what lets host solvers and device solvers share
+    one constrained-solve semantics."""
+
+    def test_random_instances_bit_identical(self):
+        import jax
+
+        G, T, R, L = 5, 4, 3, 4  # fixed shapes: one jit compile per mode
+        for seed in range(6):
+            rng = np.random.default_rng(seed)
+            vectors = np.sort(
+                rng.uniform(0.2, 4, (G, R)).astype(np.float32), axis=0
+            )[::-1].copy()
+            counts = rng.integers(0, 25, (L, G)).astype(np.int32)
+            capacity = np.sort(rng.uniform(2, 20, (T, R)).astype(np.float32), axis=0)
+            valid = np.ones(T, bool)
+            prices = rng.uniform(0.1, 3, T).astype(np.float32)
+            allow = rng.random((L, G, T)) > 0.4
+            penalty = rng.uniform(0, 0.05, (L, G, T)).astype(np.float32)
+            conflict = rng.random((G, G)) > 0.8
+            conflict = conflict | conflict.T
+            np.fill_diagonal(conflict, False)
+            node_cap = np.where(
+                rng.random(G) > 0.7, rng.integers(1, 4, G), NODE_CAP_NONE
+            ).astype(np.int32)
+            for mode in ("ffd", "cost"):
+                kp = jax.device_get(
+                    pack_kernel_levels(
+                        vectors, counts, capacity, capacity.copy(), valid,
+                        prices, allow, penalty, conflict, node_cap, mode=mode,
+                    )
+                )
+                hp = pack_levels_host(
+                    vectors, counts, capacity, valid, prices, allow, penalty,
+                    conflict, node_cap, mode=mode,
+                )
+                assert int(kp.chosen_level) == hp.chosen_level, (seed, mode)
+                assert np.array_equal(kp.level_unsched, hp.level_unsched)
+                assert np.array_equal(kp.group_level, hp.group_level)
+                assert int(kp.rounds.num_rounds) == len(hp.rounds), (seed, mode)
+                for r, (t_h, f_h, rep_h) in enumerate(hp.rounds):
+                    assert int(kp.rounds.round_type[r]) == t_h, (seed, mode, r)
+                    assert np.array_equal(kp.rounds.round_fill[r], f_h)
+                    assert int(kp.rounds.round_repl[r]) == rep_h
+
+    def test_strictest_feasible_level_wins(self):
+        # Level 0 masks everything out; level 1 is feasible; level 2 (also
+        # feasible) must NOT be chosen — strictest wins.
+        vectors = np.array([[1.0, 1.0]], np.float32)
+        counts = np.tile(np.array([4], np.int32), (3, 1))
+        capacity = np.array([[8.0, 8.0]], np.float32)
+        allow = np.array([[[False]], [[True]], [[True]]])
+        pack = pack_levels_host(
+            vectors, counts, capacity, np.ones(1, bool),
+            np.array([1.0], np.float32), allow, np.zeros((3, 1, 1), np.float32),
+            np.zeros((1, 1), bool), np.full(1, NODE_CAP_NONE, np.int32),
+        )
+        assert pack.chosen_level == 1
+        assert pack.level_unsched[0, 0] == 4 and pack.level_unsched[1, 0] == 0
+        assert list(pack.group_level) == [1]
+
+    def test_node_cap_forces_one_per_node(self):
+        vectors = np.array([[1.0]], np.float32)
+        counts = np.array([[5]], np.int32)
+        capacity = np.array([[100.0]], np.float32)
+        pack = pack_levels_host(
+            vectors, counts, capacity, np.ones(1, bool),
+            np.array([1.0], np.float32), np.ones((1, 1, 1), bool),
+            np.zeros((1, 1, 1), np.float32), np.zeros((1, 1), bool),
+            np.array([1], np.int32),
+        )
+        # One round, fill 1, replicated 5x: five single-pod nodes.
+        assert len(pack.rounds) == 1
+        t, fill, repl = pack.rounds[0]
+        assert fill[0] == 1 and repl == 5
+
+    def test_conflict_groups_never_share_a_node(self):
+        vectors = np.array([[2.0], [1.0]], np.float32)
+        counts = np.array([[3, 3]], np.int32)
+        capacity = np.array([[100.0]], np.float32)
+        conflict = np.array([[False, True], [True, False]])
+        pack = pack_levels_host(
+            vectors, counts, capacity, np.ones(1, bool),
+            np.array([1.0], np.float32), np.ones((1, 2, 1), bool),
+            np.zeros((1, 2, 1), np.float32), conflict,
+            np.full(2, NODE_CAP_NONE, np.int32),
+        )
+        for t, fill, repl in pack.rounds:
+            assert (fill > 0).sum() == 1  # never co-resident
+        assert pack.level_unsched.sum() == 0
+
+
+# --- compiled vs greedy placement parity ------------------------------------
+
+
+def _greedy_harness():
+    """A harness whose provisioning workers run the legacy greedy
+    Topology.inject pre-pass (the parity oracle)."""
+    h = Harness()
+    h.apply_provisioner(provisioner())
+    for worker in h.provisioning.workers.values():
+        worker.scheduler = Scheduler(h.cluster, greedy_topology=True)
+    return h
+
+
+def _compiled_harness():
+    h = Harness()
+    h.apply_provisioner(provisioner())
+    return h
+
+
+def _zone_profile(h, pods):
+    scheduled = {}
+    for pod in pods:
+        live = h.cluster.get_pod(pod.namespace, pod.name)
+        if live.node_name is None:
+            continue
+        scheduled[pod.name] = h.cluster.get_node(live.node_name).zone
+    return scheduled
+
+
+SEED_SCENARIOS = [
+    ("balanced_6", 6, 1, 0),
+    ("uneven_7", 7, 1, 0),
+    ("skew_2", 8, 2, 0),
+    ("seeded_existing", 4, 1, 2),
+    ("single", 1, 1, 0),
+]
+
+
+class TestGreedyParity:
+    """Property: compiled-kernel placements equal the greedy host-side pass
+    on every seed scenario — same pods, same spread, same per-zone totals,
+    same scheduled set."""
+
+    @pytest.mark.parametrize("name,n,skew,seeded", SEED_SCENARIOS)
+    def test_zonal_spread_parity(self, name, n, skew, seeded):
+        profiles = {}
+        for flavor in ("greedy", "compiled"):
+            h = _greedy_harness() if flavor == "greedy" else _compiled_harness()
+            if seeded:
+                from karpenter_tpu.cloudprovider import NodeSpec
+
+                node = NodeSpec(name="seed", zone="test-zone-1")
+                h.cluster.create_node(node)
+                for i in range(seeded):
+                    existing = fixtures.pod(labels={"app": "web"})
+                    h.cluster.apply_pod(existing)
+                    h.cluster.bind_pod(existing, node)
+            pods = [
+                fixtures.pod(
+                    labels={"app": "web"},
+                    topology_spread=[zonal_spread(max_skew=skew)],
+                )
+                for _ in range(n)
+            ]
+            h.provision(*pods)
+            profile = _zone_profile(h, pods)
+            assert len(profile) == n, (flavor, name, "all pods scheduled")
+            profiles[flavor] = Counter(profile.values())
+        assert profiles["greedy"] == profiles["compiled"], name
+
+    def test_hostname_spread_parity(self):
+        for skew, n in ((1, 3), (2, 5)):
+            results = {}
+            for flavor in ("greedy", "compiled"):
+                h = _greedy_harness() if flavor == "greedy" else _compiled_harness()
+                pods = [
+                    fixtures.pod(
+                        labels={"app": "web"},
+                        topology_spread=[
+                            TopologySpreadConstraint(
+                                max_skew=skew,
+                                topology_key=wellknown.HOSTNAME_LABEL,
+                                match_labels={"app": "web"},
+                            )
+                        ],
+                    )
+                    for _ in range(n)
+                ]
+                h.provision(*pods)
+                buckets = Counter(
+                    h.expect_scheduled(p).name for p in pods
+                )
+                results[flavor] = sorted(buckets.values())
+            assert results["greedy"] == results["compiled"], (skew, n)
+
+    def test_affinity_limited_domains_parity(self):
+        for flavor in ("greedy", "compiled"):
+            h = _greedy_harness() if flavor == "greedy" else _compiled_harness()
+            pods = [
+                fixtures.pod(
+                    labels={"app": "web"},
+                    topology_spread=[zonal_spread()],
+                    required_terms=[
+                        [
+                            Requirement.in_(
+                                wellknown.ZONE_LABEL,
+                                ["test-zone-1", "test-zone-2"],
+                            )
+                        ]
+                    ],
+                )
+                for _ in range(4)
+            ]
+            h.provision(*pods)
+            zones = Counter(h.expect_scheduled(p).zone for p in pods)
+            assert set(zones) == {"test-zone-1", "test-zone-2"}, flavor
+            assert max(zones.values()) - min(zones.values()) <= 1, flavor
+
+
+# --- what greedy cannot express ---------------------------------------------
+
+
+class TestAntiAffinity:
+    """Anti-affinity scenarios the greedy host-side pass cannot express
+    (the reference rejects these pods at selection), asserted directly."""
+
+    def test_custom_key_anti_affinity_requires_same_key_spread(self):
+        """The compiler only lowers custom-key exclusions for the
+        domain-expanded hard spread key; selection must reject (not silently
+        drop) a rack-keyed term without a rack DoNotSchedule spread."""
+        from karpenter_tpu.controllers.selection import (
+            SelectionController,
+            UnsupportedPodError,
+        )
+
+        term = {
+            "topologyKey": "topology.kubernetes.io/rack",
+            "labelSelector": {"matchLabels": {"app": "db"}},
+        }
+        bare = fixtures.pod(
+            labels={"app": "db"}, pod_anti_affinity_terms=[dict(term)]
+        )
+        with pytest.raises(UnsupportedPodError):
+            SelectionController._validate(None, bare)
+        covered = fixtures.pod(
+            labels={"app": "db"},
+            pod_anti_affinity_terms=[dict(term)],
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/rack",
+                    match_labels={"app": "db"},
+                )
+            ],
+        )
+        SelectionController._validate(None, covered)  # lowerable: accepted
+
+    def test_all_domains_excluded_reports_unschedulable(self):
+        """Spread pods whose anti-affinity excludes EVERY domain never reach
+        the kernel's counts; they must surface as unschedulable (and stay
+        out of the Preferences level cache), not vanish from the solve."""
+        h = _compiled_harness()
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        for i, zone in enumerate(fixtures.ZONES):
+            node = NodeSpec(name=f"occupied-{i}", zone=zone)
+            h.cluster.create_node(node)
+            rival = fixtures.pod(labels={"app": "rival"})
+            h.cluster.apply_pod(rival)
+            h.cluster.bind_pod(rival, node)
+
+        pods = [
+            fixtures.pod(
+                labels={"app": "web"},
+                topology_spread=[zonal_spread()],
+                pod_anti_affinity_terms=[
+                    {
+                        "topologyKey": wellknown.ZONE_LABEL,
+                        "labelSelector": {"matchLabels": {"app": "rival"}},
+                    }
+                ],
+            )
+            for _ in range(3)
+        ]
+        h.provision(*pods)
+        for pod in pods:
+            h.expect_not_scheduled(pod)
+            assert h.selection.preferences.level(pod) is None
+
+    def test_hostname_self_anti_affinity_one_per_node(self):
+        h = _compiled_harness()
+        pods = [
+            fixtures.pod(
+                labels={"app": "db"},
+                pod_anti_affinity_terms=[
+                    {
+                        "topologyKey": wellknown.HOSTNAME_LABEL,
+                        "labelSelector": {"matchLabels": {"app": "db"}},
+                    }
+                ],
+            )
+            for _ in range(5)
+        ]
+        h.provision(*pods)
+        nodes = Counter(h.expect_scheduled(p).name for p in pods)
+        assert len(nodes) == 5  # one node per pod
+        assert max(nodes.values()) == 1
+
+    def test_zone_anti_affinity_avoids_occupied_domains(self):
+        h = _compiled_harness()
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        node = NodeSpec(name="occupied", zone="test-zone-1")
+        h.cluster.create_node(node)
+        enemy = fixtures.pod(labels={"app": "rival"})
+        h.cluster.apply_pod(enemy)
+        h.cluster.bind_pod(enemy, node)
+
+        pod = fixtures.pod(
+            pod_anti_affinity_terms=[
+                {
+                    "topologyKey": wellknown.ZONE_LABEL,
+                    "labelSelector": {"matchLabels": {"app": "rival"}},
+                }
+            ]
+        )
+        h.provision(pod)
+        assert h.expect_scheduled(pod).zone != "test-zone-1"
+
+    def test_zone_affinity_follows_occupied_domain(self):
+        h = _compiled_harness()
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        node = NodeSpec(name="anchor", zone="test-zone-2")
+        h.cluster.create_node(node)
+        friend = fixtures.pod(labels={"app": "cache"})
+        h.cluster.apply_pod(friend)
+        h.cluster.bind_pod(friend, node)
+
+        pod = fixtures.pod(
+            pod_affinity_terms=[
+                {
+                    "topologyKey": wellknown.ZONE_LABEL,
+                    "labelSelector": {"matchLabels": {"app": "cache"}},
+                }
+            ]
+        )
+        h.provision(pod)
+        assert h.expect_scheduled(pod).zone == "test-zone-2"
+
+    def test_label_divergent_pods_do_not_share_a_rep(self):
+        """Pods with identical anti-affinity terms but different labels must
+        not merge into one schedule: the compiler reads the representative
+        pod's labels for the self-match lowering, so a non-matching
+        bystander must neither inherit the one-per-node cap nor launder it
+        away from the matching pods."""
+        h = _compiled_harness()
+        term = {
+            "topologyKey": wellknown.HOSTNAME_LABEL,
+            "labelSelector": {"matchLabels": {"app": "db"}},
+        }
+        bystander = fixtures.pod(
+            labels={"app": "other"}, pod_anti_affinity_terms=[dict(term)]
+        )
+        matchers = [
+            fixtures.pod(labels={"app": "db"}, pod_anti_affinity_terms=[dict(term)])
+            for _ in range(3)
+        ]
+        # Bystander applied FIRST: a shared schedule would make it the rep.
+        h.provision(bystander, *matchers)
+        nodes = Counter(h.expect_scheduled(p).name for p in matchers)
+        assert len(nodes) == 3 and max(nodes.values()) == 1
+        h.expect_scheduled(bystander)
+
+    def test_zone_anti_affinity_respected_under_custom_key_spread(self):
+        """Zone-keyed anti-affinity must still bite when the domain axis is
+        a CUSTOM key (the rack spread owns the expansion; zone terms
+        restrict types and pin pools separately)."""
+        h = _compiled_harness()
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        node = NodeSpec(name="occupied", zone="test-zone-1")
+        h.cluster.create_node(node)
+        enemy = fixtures.pod(labels={"app": "rival"})
+        h.cluster.apply_pod(enemy)
+        h.cluster.bind_pod(enemy, node)
+
+        pods = [
+            fixtures.pod(
+                labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="rack",
+                        match_labels={"app": "web"},
+                    )
+                ],
+                required_terms=[[Requirement.in_("rack", ["r-1", "r-2"])]],
+                pod_anti_affinity_terms=[
+                    {
+                        "topologyKey": wellknown.ZONE_LABEL,
+                        "labelSelector": {"matchLabels": {"app": "rival"}},
+                    }
+                ],
+            )
+            for _ in range(4)
+        ]
+        h.provision(*pods)
+        racks = Counter(h.expect_scheduled(p).labels.get("rack") for p in pods)
+        assert set(racks) == {"r-1", "r-2"}
+        for pod in pods:
+            assert h.expect_scheduled(pod).zone != "test-zone-1"
+
+    def test_non_matching_hostname_term_does_not_fragment(self):
+        """A hostname anti-affinity term targeting OTHER labels (its
+        targets live in different schedules on different fresh nodes) must
+        not forbid this schedule's own pods from sharing nodes."""
+        h = _compiled_harness()
+        pods = [
+            fixtures.pod(
+                labels={"app": "web"},
+                pod_anti_affinity_terms=[
+                    {
+                        "topologyKey": wellknown.HOSTNAME_LABEL,
+                        "labelSelector": {"matchLabels": {"app": "db"}},
+                    }
+                ],
+            )
+            for _ in range(4)
+        ]
+        h.provision(*pods)
+        nodes = Counter(h.expect_scheduled(p).name for p in pods)
+        assert max(nodes.values()) > 1  # co-residence allowed
+
+    def test_custom_key_affinity_requires_same_key_spread(self):
+        """Affinity on a custom key with no same-key spread has no sound
+        lowering (fresh nodes never get the label) — rejected at selection
+        instead of silently dropped by the compiler."""
+        h = _compiled_harness()
+        pod = fixtures.pod(
+            pod_affinity_terms=[
+                {
+                    "topologyKey": "rack",
+                    "labelSelector": {"matchLabels": {"app": "cache"}},
+                }
+            ]
+        )
+        h.provision(pod)
+        h.expect_not_scheduled(pod)
+
+    def test_custom_key_affinity_with_spread_follows_domain(self):
+        """Affinity + same-key spread: the allowed domains intersect down
+        to those hosting matching pods, and fresh nodes are stamped into
+        that domain."""
+        h = _compiled_harness()
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        node = NodeSpec(name="anchor", zone="test-zone-1", labels={"rack": "r-7"})
+        h.cluster.create_node(node)
+        friend = fixtures.pod(labels={"app": "cache"})
+        h.cluster.apply_pod(friend)
+        h.cluster.bind_pod(friend, node)
+
+        pods = [
+            fixtures.pod(
+                labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="rack",
+                        match_labels={"app": "web"},
+                    )
+                ],
+                required_terms=[[Requirement.in_("rack", ["r-7", "r-8"])]],
+                pod_affinity_terms=[
+                    {
+                        "topologyKey": "rack",
+                        "labelSelector": {"matchLabels": {"app": "cache"}},
+                    }
+                ],
+            )
+            for _ in range(2)
+        ]
+        h.provision(*pods)
+        for pod in pods:
+            assert h.expect_scheduled(pod).labels.get("rack") == "r-7"
+
+    def test_greedy_flag_still_rejects_anti_affinity(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_GREEDY_TOPOLOGY", "1")
+        h = Harness()
+        h.apply_provisioner(provisioner())
+        pod = fixtures.pod(
+            pod_anti_affinity_terms=[{"topologyKey": wellknown.HOSTNAME_LABEL}]
+        )
+        h.provision(pod)
+        h.expect_not_scheduled(pod)
+
+
+class TestHardPinRealization:
+    def test_unpoolable_hard_pin_stays_pending(self, monkeypatch):
+        """When no launch pool survives a round's hard zone pin (e.g. ICE
+        blackout of the pinned zones), the round must NOT launch unpinned —
+        the pods stay pending and heal through a later sweep."""
+        from karpenter_tpu.constraints import solve as csolve
+
+        monkeypatch.setattr(csolve, "_round_pools", lambda *a, **k: (None, None))
+        h = _compiled_harness()
+        pods = [
+            fixtures.pod(labels={"app": "web"}, topology_spread=[zonal_spread()])
+            for _ in range(3)
+        ]
+        h.provision(*pods)
+        for pod in pods:
+            h.expect_not_scheduled(pod)
+
+
+# --- ladder solved in one dispatch ------------------------------------------
+
+
+class TestLadderSolve:
+    def test_kernel_chooses_strictest_feasible_level(self):
+        h = _compiled_harness()
+        pod = fixtures.pod(
+            preferred_terms=[
+                PreferredTerm(
+                    weight=10,
+                    requirements=[Requirement.in_(wellknown.ZONE_LABEL, ["nowhere"])],
+                ),
+                PreferredTerm(
+                    weight=1,
+                    requirements=[
+                        Requirement.in_(wellknown.ZONE_LABEL, ["test-zone-3"])
+                    ],
+                ),
+            ]
+        )
+        h.provision(pod)
+        # One pass: the dispatch drops only the impossible heaviest term and
+        # honors the surviving preference.
+        assert h.expect_scheduled(pod).zone == "test-zone-3"
+        assert h.selection.preferences.level(pod) == 1
+
+    def test_level_zero_when_preferences_satisfiable(self):
+        h = _compiled_harness()
+        pod = fixtures.pod(
+            preferred_terms=[
+                PreferredTerm(
+                    weight=1,
+                    requirements=[
+                        Requirement.in_(wellknown.ZONE_LABEL, ["test-zone-2"])
+                    ],
+                )
+            ]
+        )
+        h.provision(pod)
+        assert h.expect_scheduled(pod).zone == "test-zone-2"
+        assert h.selection.preferences.level(pod) == 0
+
+    def test_spread_and_ladder_together(self):
+        h = _compiled_harness()
+        pods = [
+            fixtures.pod(
+                labels={"app": "web"},
+                topology_spread=[zonal_spread()],
+                preferred_terms=[
+                    PreferredTerm(
+                        weight=5,
+                        requirements=[
+                            Requirement.in_(wellknown.ZONE_LABEL, ["mars"])
+                        ],
+                    )
+                ],
+            )
+            for _ in range(6)
+        ]
+        h.provision(*pods)
+        zones = Counter(h.expect_scheduled(p).zone for p in pods)
+        assert set(zones) == {"test-zone-1", "test-zone-2", "test-zone-3"}
+        assert max(zones.values()) - min(zones.values()) <= 1
+        assert all(h.selection.preferences.level(p) == 1 for p in pods)
+
+
+# --- compiler internals ------------------------------------------------------
+
+
+class TestCompilerCache:
+    def _schedule(self, h):
+        p = h.cluster.list_provisioners()[0]
+        pods = [
+            fixtures.pod(labels={"app": "web"}, topology_spread=[zonal_spread()])
+            for _ in range(3)
+        ]
+        schedules = Scheduler(h.cluster).solve(p, pods)
+        assert len(schedules) == 1 and schedules[0].needs_compiler
+        return schedules[0]
+
+    def test_envelope_cached_per_epoch(self):
+        from karpenter_tpu.ops.encode import build_fleet, group_pods
+
+        h = _compiled_harness()
+        schedule = self._schedule(h)
+        groups = group_pods(schedule.pods)
+        fleet = build_fleet(
+            fixtures.default_catalog(), schedule.constraints, schedule.pods
+        )
+        cache = CompilerCache()
+        compile_constraints(
+            schedule, groups, fleet, h.cluster, cache=cache, epoch=7
+        )
+        assert (cache.hits, cache.misses) == (0, 1)
+        compile_constraints(
+            schedule, groups, fleet, h.cluster, cache=cache, epoch=7
+        )
+        assert (cache.hits, cache.misses) == (1, 1)
+        # An epoch bump (watch delta) invalidates without scanning.
+        compile_constraints(
+            schedule, groups, fleet, h.cluster, cache=cache, epoch=8
+        )
+        assert (cache.hits, cache.misses) == (1, 2)
+
+    def test_compile_tag_moves_on_watch_deltas(self):
+        """The cache key must invalidate on ORDINARY churn, not just full
+        re-uploads: compile_tag is the (epoch, generation) pair — generation
+        bumps on every delta flush — and is None while deltas are pending
+        (the envelope reads the live store, which is already ahead)."""
+        from karpenter_tpu.controllers.cluster import Cluster
+        from karpenter_tpu.models.cluster_state import DeviceClusterState
+        from karpenter_tpu.api.pods import PodSpec
+
+        cluster = Cluster()
+        state = DeviceClusterState(cluster)
+        cluster.apply_pod(
+            PodSpec(name="a", requests={"cpu": "500m"}, unschedulable=True)
+        )
+        assert state.compile_tag() is None  # delta pending: no caching
+        state.pending_groups()  # flush
+        tag = state.compile_tag()
+        assert tag is not None
+        # A bind-style delta (no full upload) must move the tag.
+        cluster.apply_pod(
+            PodSpec(name="b", requests={"cpu": "500m"}, unschedulable=True)
+        )
+        assert state.compile_tag() is None
+        state.pending_groups()
+        assert state.compile_tag() not in (None, tag)
+
+    def test_no_epoch_no_cache(self):
+        from karpenter_tpu.ops.encode import build_fleet, group_pods
+
+        h = _compiled_harness()
+        schedule = self._schedule(h)
+        groups = group_pods(schedule.pods)
+        fleet = build_fleet(
+            fixtures.default_catalog(), schedule.constraints, schedule.pods
+        )
+        cache = CompilerCache()
+        compile_constraints(schedule, groups, fleet, h.cluster, cache=cache)
+        assert (cache.hits, cache.misses) == (0, 0)
+
+
+class TestWaterFill:
+    def test_matches_sequential_greedy_totals(self):
+        import random
+
+        rng = random.Random(3)
+        spread = zonal_spread()
+        for _ in range(100):
+            domains = [f"d{j}" for j in range(rng.randint(1, 5))]
+            seeds = [rng.randint(0, 8) for _ in domains]
+            n = rng.randint(0, 30)
+            group = TopologyGroup(spread)
+            for d, c in zip(domains, seeds):
+                group.register(d)
+                group.counts[d] = c
+            sequence = [group.next_domain() for _ in range(n)]
+            takes = water_fill_takes(seeds, n)
+            assert Counter(x for x in sequence if x) == Counter(
+                {d: t for d, t in zip(domains, takes) if t}
+            )
+
+    def test_discover_domains_arbitrary_key(self):
+        h = _compiled_harness()
+        from karpenter_tpu.cloudprovider import NodeSpec
+        from karpenter_tpu.ops.encode import build_fleet
+
+        node = NodeSpec(name="r1", zone="test-zone-1", labels={"rack": "r-7"})
+        h.cluster.create_node(node)
+        occupant = fixtures.pod(labels={"app": "web"})
+        h.cluster.apply_pod(occupant)
+        h.cluster.bind_pod(occupant, node)
+        constraint = TopologySpreadConstraint(
+            max_skew=1, topology_key="rack", match_labels={"app": "web"}
+        )
+        constraints = Constraints(
+            requirements=Requirements([Requirement.in_("rack", ["r-7", "r-8"])])
+        )
+        fleet = build_fleet(fixtures.default_catalog(), constraints, [])
+        discovered = discover_domains(constraint, constraints, fleet, h.cluster)
+        assert discovered.domains == ("r-7", "r-8")
+        assert discovered.seed_counts == (1, 0)
+
+
+class TestCustomKeySpread:
+    def test_custom_domains_stamped_on_fresh_nodes(self):
+        """Custom-key spread realizes by LABELING fresh nodes with their
+        assigned domain — the leapfrog over the reference, which rejected
+        non-hostname/zone keys outright."""
+        h = _compiled_harness()
+        # The envelope declares two rack domains; no node exists yet.
+        pods = [
+            fixtures.pod(
+                labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="rack",
+                        match_labels={"app": "web"},
+                    )
+                ],
+                required_terms=[[Requirement.in_("rack", ["r-1", "r-2"])]],
+            )
+            for _ in range(4)
+        ]
+        h.provision(*pods)
+        racks = Counter(
+            h.expect_scheduled(p).labels.get("rack") for p in pods
+        )
+        assert set(racks) == {"r-1", "r-2"}
+        assert max(racks.values()) - min(racks.values()) <= 1
+
+
+# --- sharded parity ----------------------------------------------------------
+
+
+class TestShardedParity:
+    def test_sharded_vs_single_decode_bit_identical(self):
+        """Extends the PR 9 parity assertion to the constrained dispatch:
+        the level-axis-sharded kernel must decode bit-identically to the
+        single-device dispatch (conftest forces an 8-device CPU mesh)."""
+        import jax
+
+        from karpenter_tpu.parallel.mesh import make_mesh
+        from karpenter_tpu.parallel.sharded_solver import constrained_level_sharding
+
+        mesh = make_mesh()
+        constrain, shards = constrained_level_sharding(mesh)
+        if shards <= 1:
+            pytest.skip("single-device environment")
+
+        rng = np.random.default_rng(11)
+        G, T, R, L = 4, 4, 3, 8
+        vectors = np.sort(
+            rng.uniform(0.2, 4, (G, R)).astype(np.float32), axis=0
+        )[::-1].copy()
+        counts = rng.integers(0, 25, (L, G)).astype(np.int32)
+        capacity = np.sort(rng.uniform(2, 20, (T, R)).astype(np.float32), axis=0)
+        valid = np.ones(T, bool)
+        prices = rng.uniform(0.1, 3, T).astype(np.float32)
+        allow = rng.random((L, G, T)) > 0.4
+        penalty = rng.uniform(0, 0.05, (L, G, T)).astype(np.float32)
+        conflict = np.zeros((G, G), bool)
+        node_cap = np.full(G, NODE_CAP_NONE, np.int32)
+
+        single = jax.device_get(
+            pack_kernel_levels(
+                vectors, counts, capacity, capacity.copy(), valid, prices,
+                allow, penalty, conflict, node_cap, mode="cost",
+            )
+        )
+        sharded = jax.device_get(
+            pack_kernel_levels(
+                vectors, counts, capacity, capacity.copy(), valid, prices,
+                allow, penalty, conflict, node_cap, mode="cost",
+                constrain=constrain,
+            )
+        )
+        assert int(single.chosen_level) == int(sharded.chosen_level)
+        assert np.array_equal(single.level_unsched, sharded.level_unsched)
+        assert np.array_equal(single.group_level, sharded.group_level)
+        assert int(single.rounds.num_rounds) == int(sharded.rounds.num_rounds)
+        assert np.array_equal(single.rounds.round_type, sharded.rounds.round_type)
+        assert np.array_equal(single.rounds.round_fill, sharded.rounds.round_fill)
+        assert np.array_equal(single.rounds.round_repl, sharded.rounds.round_repl)
+        assert np.array_equal(
+            single.rounds.unschedulable, sharded.rounds.unschedulable
+        )
+
+
+# --- device-solver path ------------------------------------------------------
+
+
+class TestDeviceSolverPath:
+    def test_constrained_provision_with_tpu_solver(self):
+        """The kernel path end-to-end (TPUSolver routes through the jitted
+        dispatch, here on the 8-device CPU mesh): spread + ladder in one
+        dispatch, same placements as the mirror."""
+        from karpenter_tpu.models.solver import TPUSolver
+
+        h = Harness(solver=TPUSolver(mode="cost"))
+        h.apply_provisioner(provisioner())
+        pods = [
+            fixtures.pod(labels={"app": "web"}, topology_spread=[zonal_spread()])
+            for _ in range(6)
+        ]
+        h.provision(*pods)
+        zones = Counter(h.expect_scheduled(p).zone for p in pods)
+        assert set(zones) == {"test-zone-1", "test-zone-2", "test-zone-3"}
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+
+class TestScheduleAnyway:
+    def test_soft_spread_does_not_block(self):
+        h = _compiled_harness()
+        pods = [
+            fixtures.pod(
+                labels={"app": "web"},
+                topology_spread=[zonal_spread(when=SCHEDULE_ANYWAY)],
+            )
+            for _ in range(3)
+        ]
+        h.provision(*pods)
+        for pod in pods:
+            h.expect_scheduled(pod)

@@ -47,14 +47,34 @@ class TestSelection:
         h.expect_not_scheduled(scheduled)
         h.expect_not_scheduled(daemon)
 
-    def test_pod_affinity_rejected(self):
+    def test_hostname_pod_affinity_rejected(self):
+        # Hostname affinity ("pack my pods onto one node") has no sound
+        # lowering onto fresh nodes — still rejected; zone-keyed affinity is
+        # compiled into the [L, G, T] dispatch and schedules.
         h = Harness()
         h.apply_provisioner(provisioner("default"))
-        pod = fixtures.pod(pod_affinity_terms=[{"topologyKey": "zone"}])
+        pod = fixtures.pod(
+            pod_affinity_terms=[{"topologyKey": wellknown.HOSTNAME_LABEL}]
+        )
         h.provision(pod)
         h.expect_not_scheduled(pod)
 
-    def test_unsupported_topology_key_rejected(self):
+    def test_zone_pod_affinity_accepted(self):
+        # The reference rejected ALL pod affinity (controller.go:117-123);
+        # the constraint compiler lowers zone-keyed terms — a batch with no
+        # existing targets seeds its own domain and schedules.
+        h = Harness()
+        h.apply_provisioner(provisioner("default"))
+        pod = fixtures.pod(
+            pod_affinity_terms=[{"topologyKey": wellknown.ZONE_LABEL}]
+        )
+        h.provision(pod)
+        h.expect_scheduled(pod)
+
+    def test_arbitrary_topology_key_accepted(self):
+        # Arbitrary topology keys are compiled now (the reference supported
+        # hostname/zone only); a key with no discoverable domains is ignored
+        # — the pod schedules instead of being bounced.
         h = Harness()
         h.apply_provisioner(provisioner("default"))
         pod = fixtures.pod(
@@ -63,7 +83,7 @@ class TestSelection:
             ]
         )
         h.provision(pod)
-        h.expect_not_scheduled(pod)
+        h.expect_scheduled(pod)
 
     def test_unsupported_operator_rejected(self):
         h = Harness()
@@ -76,7 +96,11 @@ class TestSelection:
         h.provision(pod)
         h.expect_not_scheduled(pod)
 
-    def test_preference_relaxation_on_retry(self):
+    def test_preference_relaxation_single_pass(self):
+        """The kernel ladder replaces relax-on-retry: an impossible
+        preference is dropped INSIDE the one [L, G, T] dispatch, so the pod
+        schedules on the first pass (the reference needed a failed pass plus
+        a requeue per relaxation level)."""
         h = Harness()
         h.apply_provisioner(provisioner("default"))
         # Prefers an impossible zone; required constraints are satisfiable.
@@ -89,17 +113,17 @@ class TestSelection:
             ]
         )
         h.provision(pod)
-        h.expect_not_scheduled(pod)  # first pass: preference blocks
-        # Retry (requeue) relaxes the preference, then schedules.
-        h.selection.reconcile(pod.namespace, pod.name)
-        for worker in h.provisioning.workers.values():
-            worker.provision()
         h.expect_scheduled(pod)
+        # The chosen level (1 = heaviest preferred term dropped) is recorded
+        # in the bookkeeping cache instead of driving retries.
+        assert h.selection.preferences.level(pod) == 1
 
 
 class TestPreferencesSideCache:
-    """Ref: selection/preferences.go:40-106 — relaxation lives in a UID-keyed
-    5-minute TTL cache; the stored pod spec is never mutated."""
+    """Ref: selection/preferences.go:40-106 — the UID-keyed 5-minute TTL
+    cache survives as the bookkeeping layer: it records the KERNEL-CHOSEN
+    relaxation level per pod (the [L, G, T] dispatch already solved every
+    level), and the stored pod spec is never mutated."""
 
     def _impossible_preference(self):
         return PreferredTerm(
@@ -112,14 +136,11 @@ class TestPreferencesSideCache:
         h.apply_provisioner(provisioner("default"))
         pod = fixtures.pod(preferred_terms=[self._impossible_preference()])
         h.provision(pod)
-        h.expect_not_scheduled(pod)  # preference blocks the first pass
-        h.selection.reconcile(pod.namespace, pod.name)  # retry: relaxed copy
-        for worker in h.provisioning.workers.values():
-            worker.provision()
-        h.expect_scheduled(pod)
+        h.expect_scheduled(pod)  # level 1 chosen inside the one dispatch
         live = h.cluster.get_pod(pod.namespace, pod.name)
         assert len(live.preferred_terms) == 1  # the user's spec is untouched
         assert live.preferred_terms[0].weight == 10
+        assert h.selection.preferences.level(live) == 1
 
     def test_required_terms_never_mutated_in_store(self):
         h = Harness()
@@ -136,21 +157,16 @@ class TestPreferencesSideCache:
         assert live.node_name is not None
         assert len(live.required_terms) == 2  # both OR-terms survive in store
 
-    def test_relaxation_expires_after_ttl(self):
+    def test_recorded_level_expires_after_ttl(self):
         h = Harness()
         h.apply_provisioner(provisioner("default"))
         pod = fixtures.pod(preferred_terms=[self._impossible_preference()])
-        h.cluster.apply_pod(pod)
-        h.selection.reconcile(pod.namespace, pod.name)  # fails, relaxes
-        relaxed = h.selection.preferences.current(
-            h.cluster.get_pod(pod.namespace, pod.name)
-        )
-        assert relaxed.preferred_terms == []  # relaxation is active
+        h.provision(pod)
+        assert h.selection.preferences.level(pod) == 1  # recorded
+        assert "preferred" in h.selection.preferences.describe(pod)
         h.clock.advance(301.0)
-        restored = h.selection.preferences.current(
-            h.cluster.get_pod(pod.namespace, pod.name)
-        )
-        assert len(restored.preferred_terms) == 1  # forgotten after 5 min
+        # Forgotten after 5 min, matching the reference's go-cache TTL.
+        assert h.selection.preferences.level(pod) is None
 
 
 class TestNoMatchBackoff:
@@ -184,9 +200,11 @@ class TestNoMatchBackoff:
         h.provisioning.workers.clear()
         assert h.selection.reconcile(pod.namespace, pod.name) == 1.0
 
-    def test_relaxation_steps_requeue_promptly(self):
-        """Each relaxation level is a fresh attempt — backoff only kicks in
-        once relaxation is exhausted."""
+    def test_preferred_terms_do_not_delay_backoff(self):
+        """Relaxation is solved inside the kernel dispatch, not across
+        retries — a no-match pod backs off immediately regardless of how
+        many preferred terms it carries (the legacy path burned one prompt
+        1s requeue per ladder level first)."""
         h = Harness()  # no provisioner: relaxation alone can't help
         pod = fixtures.pod(
             preferred_terms=[
@@ -197,11 +215,8 @@ class TestNoMatchBackoff:
             ]
         )
         h.cluster.apply_pod(pod)
-        first = h.selection.reconcile(pod.namespace, pod.name)
-        assert first == 1.0  # dropped the preferred term: retry promptly
-        second = h.selection.reconcile(pod.namespace, pod.name)
-        third = h.selection.reconcile(pod.namespace, pod.name)
-        assert (second, third) == (1.0, 2.0)  # exhausted → exponential
+        delays = [h.selection.reconcile(pod.namespace, pod.name) for _ in range(3)]
+        assert delays == [1.0, 2.0, 4.0]  # pure exponential from the start
 
 
 class TestMatchFields:

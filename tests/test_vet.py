@@ -577,3 +577,37 @@ def test_production_tree_is_vet_clean():
 def test_checker_names_unique():
     names = [checker.name for checker in ALL_CHECKERS]
     assert len(names) == len(set(names)) == 9
+
+
+def test_constraints_subsystem_in_vet_scope():
+    """The constraint compiler rides the same disciplines as the rest of
+    the tree: its modules are in the production scope, the compiler cache
+    carries live guarded-by annotations (a lock-discipline checker that
+    stopped consuming them would flag them as unconsumed), and the
+    fetch-discipline rule covers the constrained solve's fetch path."""
+    from tools.vet.framework import production_scope
+
+    scanned = {path.as_posix() for path in production_scope()}
+    for module in (
+        "compiler",
+        "ladder",
+        "mirror",
+        "solve",
+        "terms",
+        "__init__",
+    ):
+        assert any(
+            p.endswith(f"karpenter_tpu/constraints/{module}.py") for p in scanned
+        ), module
+    compiler_src = next(
+        p for p in scanned if p.endswith("karpenter_tpu/constraints/compiler.py")
+    )
+    source = open(compiler_src).read()
+    assert "vet: guarded-by(self._lock)" in source  # the compiler cache
+    solve_src = next(
+        p for p in scanned if p.endswith("karpenter_tpu/constraints/solve.py")
+    )
+    # The constrained solve fetches ONLY through the owned raw-fetch helper.
+    solve_source = open(solve_src).read()
+    assert "_to_host" in solve_source
+    assert "jax.device_get" not in solve_source

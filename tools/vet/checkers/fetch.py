@@ -9,7 +9,10 @@ silently, so the raw fetch primitives are pinned to three owners:
 
 - ``karpenter_tpu/models/solver.py::_to_host`` — THE raw fetch every
   compacted helper (fetch_plan/fetch_plans, FetchedPlan.lp_assignment)
-  bottoms out in;
+  bottoms out in; the constrained [L, G, T] dispatch
+  (``karpenter_tpu/constraints/solve.py``) fetches through it too, so the
+  constraint compiler rides this discipline with no allowlist entry of its
+  own;
 - ``karpenter_tpu/ops/consolidate.py::_fetch`` — consolidation's single
   fetch site (eager columns, lazy plan rows);
 - ``karpenter_tpu/utils/backend_health.py`` — the liveness probe.
