@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -115,6 +115,16 @@ multichip-smoke:
 constraints-smoke:
 	timeout -k 10 180 python tools/constraints_smoke.py
 
+# The observability guard (tools/obs_smoke.py): the pod-latency SLO
+# pipeline proven end to end — lifecycle-tracker pending samples exactly
+# matching an independent watch-oracle, a forced SLO breach producing a
+# gap-free flight-recorder dump naming the offending pods and their
+# slowest phase, and a pipelined sidecar solve exporting ONE stitched
+# Chrome trace (host + RPC + serve spans under a single trace id,
+# wall-clock anchored, every lane labeled).
+obs-smoke:
+	timeout -k 10 120 python tools/obs_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -128,6 +138,7 @@ smoke:
 	$(MAKE) chaos-smoke || rc=1; \
 	$(MAKE) multichip-smoke || rc=1; \
 	$(MAKE) constraints-smoke || rc=1; \
+	$(MAKE) obs-smoke || rc=1; \
 	exit $$rc
 
 proto:

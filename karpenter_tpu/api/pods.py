@@ -109,6 +109,11 @@ class PodSpec:
     node_name: Optional[str] = None
     unschedulable: bool = False  # PodScheduled=False reason=Unschedulable
     deletion_timestamp: Optional[float] = None
+    # metadata.creationTimestamp (epoch seconds): stamped by the cluster
+    # store on first apply when absent, preserved across updates. The pod
+    # lifecycle tracker (utils/obs.py) re-anchors its pending clock here
+    # after a controller restart, so restart-spanning latency is charged.
+    created_at: Optional[float] = None
 
     def __post_init__(self):
         if not self.uid:

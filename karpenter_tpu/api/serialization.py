@@ -126,6 +126,7 @@ def pod_to_dict(pod: PodSpec) -> Dict[str, Any]:
             "uid": pod.uid,
             "labels": dict(pod.labels),
             "annotations": dict(pod.annotations),
+            "creationTimestamp": pod.created_at,
         },
         "spec": {
             "requests": dict(pod.requests),
@@ -229,6 +230,7 @@ def pod_from_dict(data: Dict[str, Any]) -> PodSpec:
         node_name=status.get("nodeName"),
         unschedulable=status.get("unschedulable", False),
         deletion_timestamp=status.get("deletionTimestamp"),
+        created_at=metadata.get("creationTimestamp"),
     )
     if metadata.get("uid"):
         pod.uid = metadata["uid"]

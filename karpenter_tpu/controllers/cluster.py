@@ -90,6 +90,17 @@ class Cluster:
 
     def apply_pod(self, pod: PodSpec) -> PodSpec:
         with self._lock:
+            if pod.created_at is None:
+                # Stamp creationTimestamp on first apply; an update arriving
+                # without one (e.g. a watch-pump conversion) inherits the
+                # stored pod's — the lifecycle tracker's restart re-anchor
+                # depends on this surviving every round trip.
+                existing = self._pods.get((pod.namespace, pod.name))
+                pod.created_at = (
+                    existing.created_at
+                    if existing is not None and existing.created_at is not None
+                    else self.clock.now()
+                )
             self._pods[(pod.namespace, pod.name)] = pod
         self._notify("pod", pod)
         return pod

@@ -587,6 +587,17 @@ class ConsolidationController:
         node.annotations[wellknown.CONSOLIDATION_ACTION_ANNOTATION] = action.kind
         self.cluster.update_node(node)
         self._savings[node.name] = action.savings
+        # Flight-record the decision at its commit point (the annotation is
+        # durable intent; this is the forensic record of WHY).
+        from karpenter_tpu.utils.obs import RECORDER
+
+        RECORDER.record(
+            "consolidate",
+            node=node.name,
+            action=action.kind,
+            instance_type=node.instance_type,
+            savings=action.savings,
+        )
         self.log.info(
             "consolidating %s (%s %s/%s): %s, projected savings $%.4f/hr",
             node.name, node.instance_type, node.zone, node.capacity_type,

@@ -36,6 +36,14 @@ class Readiness:
         ]
         if len(node.taints) != before:
             cluster.update_node(node)
+            # The node-ready lifecycle edge: pods already bound here waited
+            # on the kubelet — attribute that wait to their node-ready phase.
+            from karpenter_tpu.utils.obs import OBS
+
+            OBS.stamp_many(
+                [p.uid for p in cluster.list_pods(node_name=node.name)],
+                "node-ready",
+            )
         return None
 
     # taint list uses Taint dataclass; imported for type parity

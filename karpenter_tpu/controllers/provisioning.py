@@ -28,8 +28,10 @@ from karpenter_tpu.controllers.scheduling import Scheduler
 from karpenter_tpu.models.solver import GreedySolver, Solver
 from karpenter_tpu.ops.ffd import PackResult
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils import tracing
 from karpenter_tpu.utils.crashpoints import any_armed, crashpoint
 from karpenter_tpu.utils.metrics import REGISTRY
+from karpenter_tpu.utils.obs import OBS, RECORDER
 from karpenter_tpu.utils.tracing import TRACER
 
 # Batching envelope (ref: provisioner.go:42-47).
@@ -71,6 +73,12 @@ BIND_DURATION = REGISTRY.histogram(
     "allocation_bind_duration_seconds",
     "Duration of node creation + pod binding per packing",
 )
+
+
+def _batch_uids(schedules) -> List[str]:
+    """Every pod uid across a pass's schedules — the lifecycle tracker's
+    stamp_many unit (one lock round per phase edge for the whole batch)."""
+    return [p.uid for s in schedules for p in s.pods]
 
 
 def global_requirements(instance_types) -> Requirements:
@@ -176,7 +184,9 @@ class ProvisionerWorker:
         overflow backlog once the window is full."""
         filled = False
         with self._lock:
+            accepted = False
             if pod.uid not in self._pending_uids:
+                accepted = True
                 if len(self._pending) >= MAX_PODS_PER_BATCH:
                     self._overflow.append(pod)
                 else:
@@ -191,6 +201,8 @@ class ProvisionerWorker:
                 if self._first_add is None:
                     self._first_add = now
                 self._last_add = now
+        if accepted:
+            OBS.stamp(pod.uid, "batched")
         if filled and self.batch_full is not None:
             self.batch_full.set()
 
@@ -251,6 +263,14 @@ class ProvisionerWorker:
         return pods
 
     def provision(self) -> ProvisionStats:
+        # One trace id per provisioning batch: every span this pass records
+        # — host stages, the sidecar RPC (ridden as gRPC metadata), the SPMD
+        # broadcast leg — carries it, so a merged Chrome trace stitches the
+        # whole batch across processes (docs/design/observability.md).
+        with TRACER.trace(tracing.new_trace_id()):
+            return self._provision()
+
+    def _provision(self) -> ProvisionStats:
         stats = ProvisionStats()
         pods = self._live_batch(self._drain())
         if not pods:
@@ -265,6 +285,7 @@ class ProvisionerWorker:
             "provision.schedule", provisioner=self.provisioner.name, pods=len(pods)
         ):
             schedules = self.scheduler.solve(self.provisioner, pods)
+        OBS.stamp_many(_batch_uids(schedules), "constraint-compiled")
         # Constrained schedules (relaxation ladder, topology spread, pod
         # (anti-)affinity) route through the compiler's [L, G, T] dispatch;
         # everything else stays on the plain solver boundary. All plain
@@ -355,6 +376,8 @@ class ProvisionerWorker:
                 epoch = None
         for schedule in constrained:
             instance_types = self.cloud.get_instance_types(schedule.constraints)
+            schedule_uids = [p.uid for p in schedule.pods]
+            OBS.stamp_many(schedule_uids, "solve-dispatched")
             with SOLVE_DURATION.measure(), TRACER.span(
                 "provision.solve.constrained",
                 pods=len(schedule.pods),
@@ -364,6 +387,15 @@ class ProvisionerWorker:
                     self.solver, schedule, instance_types, daemons,
                     cluster=self.cluster, epoch=epoch,
                 )
+            OBS.stamp_many(schedule_uids, "solve-fetched")
+            RECORDER.record(
+                "relaxation",
+                provisioner=self.provisioner.name,
+                pods=len(schedule.pods),
+                level=max(decision.pod_levels.values(), default=0),
+                description=decision.description,
+                trace=TRACER.current_trace() or "",
+            )
             if self.level_recorder is not None:
                 for uid, level in decision.pod_levels.items():
                     self.level_recorder(uid, level, decision.description)
@@ -382,13 +414,16 @@ class ProvisionerWorker:
         asserts, and interleaving binds with in-flight solves would leave
         whatever the pipeline happened to finish (same rule as the serial
         bind path in _register_and_bind)."""
+        batch_uids = _batch_uids(schedules)
         if any_armed():
+            OBS.stamp_many(batch_uids, "solve-dispatched")
             with SOLVE_DURATION.measure(), TRACER.span(
                 "provision.solve",
                 schedules=len(problems),
                 pods=sum(self._problem_pods(p) for p in problems),
             ):
                 results = self.solver.solve_many(problems)
+            OBS.stamp_many(batch_uids, "solve-fetched")
             yield from zip(schedules, results)
             return
         # Encode + dispatch is measured as its own sample: for device
@@ -406,6 +441,7 @@ class ProvisionerWorker:
             pods=sum(self._problem_pods(p) for p in problems),
         ):
             iterator = self.solver.solve_many_pipelined(problems)
+        OBS.stamp_many(batch_uids, "solve-dispatched")
         for index, schedule in enumerate(schedules):
             with SOLVE_DURATION.measure(), TRACER.span(
                 "provision.solve",
@@ -414,6 +450,7 @@ class ProvisionerWorker:
                 pods=len(schedule.pods),
             ):
                 result = next(iterator)
+            OBS.stamp_many([p.uid for p in schedule.pods], "solve-fetched")
             yield schedule, result
 
     def _daemon_schedules_here(self, template: PodSpec) -> bool:
@@ -497,14 +534,41 @@ class ProvisionerWorker:
                 stats.launched_nodes += 1
                 stats.scheduled_pods += len(pods)
 
+            launch_id = self._launch_identity(self.provisioner.name, packing)
+            # The flight-recorder's launch decision: WHAT is being bought
+            # (first-choice type + price), for whom, under which idempotency
+            # token — the record a breach/crash dump correlates against.
+            first_pool = (packing.pool_options or [None])[0]
+            RECORDER.record(
+                "launch",
+                provisioner=self.provisioner.name,
+                nodes=packing.node_quantity,
+                pods=len(packing.pods),
+                instance_type=(
+                    packing.instance_type_options[0].name
+                    if packing.instance_type_options
+                    else ""
+                ),
+                price=getattr(first_pool, "price", None),
+                zone=getattr(first_pool, "zone", None),
+                launch_id=launch_id,
+                trace=TRACER.current_trace() or "",
+            )
             errors = self.cloud.create(
                 constraints,
                 packing.instance_type_options,
                 packing.node_quantity,
                 bind_callback,
                 pool_options=packing.pool_options,
-                launch_id=self._launch_identity(self.provisioner.name, packing),
+                launch_id=launch_id,
             )
+            for error in errors:
+                RECORDER.record(
+                    "launch-error",
+                    provisioner=self.provisioner.name,
+                    launch_id=launch_id,
+                    error=repr(error),
+                )
             stats.launch_errors.extend(errors)
 
     @staticmethod
@@ -533,6 +597,7 @@ class ProvisionerWorker:
         ]
         if wellknown.TERMINATION_FINALIZER not in node.finalizers:
             node.finalizers.append(wellknown.TERMINATION_FINALIZER)
+        OBS.stamp_many([p.uid for p in pods], "launched")
         crashpoint("provision.before-register")
         try:
             self.cluster.create_node(node)

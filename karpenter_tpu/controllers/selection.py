@@ -32,6 +32,7 @@ from karpenter_tpu.controllers.provisioning import ProvisioningController
 from karpenter_tpu.controllers.scheduling import SUPPORTED_TOPOLOGY_KEYS
 from karpenter_tpu.utils.cache import TtlCache
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.obs import OBS
 
 
 class UnsupportedPodError(Exception):
@@ -106,6 +107,9 @@ class SelectionController:
         pod = self.cluster.try_get_pod(namespace, name)
         if pod is None or not pod.is_provisionable():
             return None
+        # Lifecycle anchor for harness-driven paths (the Manager path also
+        # anchors from the watch-delta feed; first sight wins in both).
+        OBS.first_seen(pod)
         try:
             self._validate(pod)
         except UnsupportedPodError:

@@ -133,8 +133,15 @@ class Terminator:
 
     def drain(self, node: NodeSpec) -> bool:
         """Returns True when fully drained (ref: terminate.go:58-82)."""
+        first_attempt = node.name not in self._drain_started
         self._drain_started.setdefault(node.name, self.cluster.clock.now())
         pods = self.cluster.list_pods(node_name=node.name)
+        if first_attempt:
+            # Flight-record the drain DECISION once, at first attempt — the
+            # black box names which node started displacing pods and when.
+            from karpenter_tpu.utils.obs import RECORDER
+
+            RECORDER.record("drain", node=node.name, pods=len(pods))
         # Refuse to drain while any pod carries do-not-evict
         # (ref: terminate.go:67-72).
         for pod in pods:

@@ -441,16 +441,25 @@ class KubeClient:
                 if not error.retryable or attempt >= self.retry.max_attempts:
                     raise
                 KUBE_API_RETRY_TOTAL.inc(label, error.reason)
+                self._flight_record_retry(label, error.reason, attempt)
                 self.clock.sleep(self.retry.backoff_s(attempt))
                 continue
             KUBE_API_REQUEST_DURATION.observe(self.clock.monotonic() - began, label)
             delay = self._status_retry_delay(status, payload, attempt)
             if delay is None:
                 return status, payload
-            KUBE_API_RETRY_TOTAL.inc(
-                label, "throttled" if status == 429 else "server-error"
-            )
+            reason = "throttled" if status == 429 else "server-error"
+            KUBE_API_RETRY_TOTAL.inc(label, reason)
+            self._flight_record_retry(label, reason, attempt)
             self.clock.sleep(delay)
+
+    @staticmethod
+    def _flight_record_retry(verb: str, reason: str, attempt: int) -> None:
+        """Every envelope retry lands in the flight recorder: a breach dump
+        must show whether the budget went to a misbehaving apiserver."""
+        from karpenter_tpu.utils.obs import RECORDER
+
+        RECORDER.record("retry", verb=verb, reason=reason, attempt=attempt)
 
     def _status_retry_delay(
         self, status: int, payload: dict, attempt: int

@@ -235,10 +235,35 @@ def pod_from_kube(obj: dict) -> PodSpec:
         node_name=spec.get("nodeName") or None,
         unschedulable=_unschedulable_from_kube(status),
         deletion_timestamp=from_rfc3339(metadata.get("deletionTimestamp")),
+        created_at=from_rfc3339(metadata.get("creationTimestamp")),
     )
     if metadata.get("uid"):
         pod.uid = metadata["uid"]
     return pod
+
+
+def _pod_metadata_to_kube(pod: PodSpec) -> dict:
+    metadata: dict = {
+        "name": pod.name,
+        "namespace": pod.namespace,
+        "uid": pod.uid,
+        "labels": dict(pod.labels),
+        "annotations": dict(pod.annotations),
+    }
+    if pod.owner_kind:
+        metadata["ownerReferences"] = [
+            {
+                "apiVersion": "apps/v1",
+                "kind": pod.owner_kind,
+                "name": f"{pod.name}-owner",
+                "controller": True,
+            }
+        ]
+    if pod.deletion_timestamp is not None:
+        metadata["deletionTimestamp"] = rfc3339(pod.deletion_timestamp)
+    if pod.created_at is not None:
+        metadata["creationTimestamp"] = rfc3339(pod.created_at)
+    return metadata
 
 
 def pod_to_kube(pod: PodSpec) -> dict:
@@ -321,24 +346,7 @@ def pod_to_kube(pod: PodSpec) -> dict:
     if pod.node_name:
         spec["nodeName"] = pod.node_name
 
-    metadata: dict = {
-        "name": pod.name,
-        "namespace": pod.namespace,
-        "uid": pod.uid,
-        "labels": dict(pod.labels),
-        "annotations": dict(pod.annotations),
-    }
-    if pod.owner_kind:
-        metadata["ownerReferences"] = [
-            {
-                "apiVersion": "apps/v1",
-                "kind": pod.owner_kind,
-                "name": f"{pod.name}-owner",
-                "controller": True,
-            }
-        ]
-    if pod.deletion_timestamp is not None:
-        metadata["deletionTimestamp"] = rfc3339(pod.deletion_timestamp)
+    metadata = _pod_metadata_to_kube(pod)
 
     status: dict = {"phase": pod.phase}
     if pod.unschedulable:

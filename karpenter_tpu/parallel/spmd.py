@@ -47,11 +47,23 @@ from typing import Optional
 import numpy as np
 
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.tracing import (
+    TRACER,
+    trace_id_to_words,
+    words_to_trace_id,
+)
 
 log = klog.named("parallel.spmd")
 
 OP_STOP = 0
 OP_SOLVE = 1
+
+# Header layout: [op, g_pad, t_pad, lp_steps, trace_lo, trace_hi]. The two
+# trace words carry the provisioning batch's trace id (tracing.new_trace_id,
+# split into 31-bit halves for the int32 transport) so follower-side spans
+# land under the SAME trace as the host and sidecar spans — a merged Chrome
+# trace stitches all three processes. (0, 0) means "no trace current".
+HEADER_WORDS = 6
 
 # The backend-capability signature: jaxlib's CPU client raises this when a
 # multi-process program reaches it. Shared with tests/test_spmd.py so the
@@ -158,10 +170,16 @@ class SpmdDispatcher:
         dwarfs any realistic schedule rate."""
         g_pad = int(padded[0].shape[0])
         t_pad = int(padded[2].shape[0])
+        trace_lo, trace_hi = trace_id_to_words(TRACER.current_trace())
         with self._lock:
             if self._stopped:
                 raise RuntimeError("SPMD dispatcher already stopped")
-            _broadcast(np.array([OP_SOLVE, g_pad, t_pad, lp_steps], np.int32))
+            _broadcast(
+                np.array(
+                    [OP_SOLVE, g_pad, t_pad, lp_steps, trace_lo, trace_hi],
+                    np.int32,
+                )
+            )
             if mesh is not None:
                 _broadcast(_device_mask(mesh))
             else:  # pragma: no cover — every production caller passes a mesh
@@ -187,7 +205,7 @@ class SpmdDispatcher:
             if self._stopped:
                 return
             self._stopped = True
-            _broadcast(np.zeros(4, np.int32))
+            _broadcast(np.zeros(HEADER_WORDS, np.int32))
 
 
 DISPATCHER = SpmdDispatcher()
@@ -211,10 +229,10 @@ def follower_step(dims: int):
 
     from karpenter_tpu.models.solver import _sharded_fused_kernel
 
-    header = np.asarray(  # vet: host-array(4-int SPMD header, deliberate fetch)
-        _broadcast(np.zeros(4, np.int32))
+    header = np.asarray(  # vet: host-array(fixed-shape SPMD header, deliberate fetch)
+        _broadcast(np.zeros(HEADER_WORDS, np.int32))
     )
-    op, g_pad, t_pad, lp_steps = (int(x) for x in header)
+    op, g_pad, t_pad, lp_steps, trace_lo, trace_hi = (int(x) for x in header)
     if op == OP_STOP:
         return None
     mask = np.asarray(  # vet: host-array(device-mask leg, deliberate fetch)
@@ -230,8 +248,13 @@ def follower_step(dims: int):
     )
     operands = _broadcast_operands(padded)
     kernel, _, _ = _sharded_fused_kernel(_mesh_from_mask(mask))
-    out = kernel(*operands, lp_steps=lp_steps)
-    jax.block_until_ready(out)
+    # The follower's span carries the lead's batch trace id (header words),
+    # so its lane stitches into the same cross-process timeline.
+    with TRACER.trace(words_to_trace_id(trace_lo, trace_hi)), TRACER.span(
+        "spmd.follower.step", g_pad=g_pad, t_pad=t_pad
+    ):
+        out = kernel(*operands, lp_steps=lp_steps)
+        jax.block_until_ready(out)
     return out
 
 

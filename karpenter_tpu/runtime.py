@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import heapq
 import http.server
+import json
 import threading
 from typing import Callable, Optional
 
@@ -37,6 +38,7 @@ from karpenter_tpu.models.solver import (
 )
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.metrics import REGISTRY
+from karpenter_tpu.utils.obs import OBS, RECORDER, stacks_snapshot
 from karpenter_tpu.utils.options import Options
 
 # Reconcile-loop health metrics, mirroring what the reference's controllers
@@ -460,6 +462,18 @@ class Manager:
             cluster,
             compaction_threshold=options.encode_compaction_threshold,
         )
+        # The pod-latency SLO pipeline (utils/obs.py): the lifecycle tracker
+        # rides the same verb-level watch feed as the incremental encoder —
+        # O(churn) per sweep — and the evaluator takes its targets from the
+        # --slo-pending-p99 / --slo-ttfl flags. Sharing the store's clock
+        # keeps phase deltas honest under fake-clock harnesses.
+        OBS.configure(
+            clock=cluster.clock,
+            slo_pending_p99=options.slo_pending_p99,
+            slo_ttfl=options.slo_ttfl,
+        )
+        RECORDER.configure(clock=cluster.clock)
+        OBS.attach(cluster)
         self.provisioning = ProvisioningController(
             cluster, cloud, self.solver, cluster_state=self.cluster_state
         )
@@ -709,6 +723,23 @@ class _HTTPHandler(http.server.BaseHTTPRequestHandler):
             body = REGISTRY.render().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path == "/debug/flightrecorder":
+            # The black box, on demand: a consistent snapshot of the
+            # decision/fault ring with seq/dropped metadata so the reader
+            # can prove it gap-free (docs/design/observability.md).
+            body = RECORDER.dump_json().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path == "/debug/slo":
+            body = json.dumps(OBS.slo_snapshot(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path == "/debug/stacks":
+            # Instantaneous stacks + a short StackProf sample: "what is the
+            # process wedged on / burning on" without attaching a debugger.
+            body = json.dumps(stacks_snapshot(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         elif self.path == "/healthz":
             # Unhealthy once the manager stops (e.g. deposed leader) so the
             # liveness probe restarts the pod instead of letting a stopped

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import grpc
@@ -32,9 +33,19 @@ from karpenter_tpu.solver_service import wire
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.clock import SYSTEM_CLOCK
 from karpenter_tpu.utils.metrics import REGISTRY
-from karpenter_tpu.utils.tracing import TRACER
+from karpenter_tpu.utils.tracing import TRACE_METADATA_KEY, TRACER, Span
 
 log = klog.named("remote-solver")
+
+
+def _trace_metadata():
+    """gRPC call metadata carrying the current batch trace id, or None —
+    the sidecar enters the same trace for its serve spans, so one merged
+    Chrome trace stitches the host, RPC, and solve lanes."""
+    trace_id = TRACER.current_trace()
+    if not trace_id:
+        return None
+    return ((TRACE_METADATA_KEY, trace_id),)
 
 # Endpoint blackout after a failed RPC (the ICE-cache pattern).
 BLACKOUT_SECONDS = 30.0
@@ -190,6 +201,7 @@ class RemoteSolver(Solver):
                     self._stream_rpc(
                         iter(request for request, _ in built),
                         timeout=deadline,
+                        metadata=_trace_metadata(),
                     )
                 )
                 span.set(outcome="ok")
@@ -263,8 +275,13 @@ class RemoteSolver(Solver):
             self.timeout_s + STREAM_PER_ITEM_SECONDS * len(items),
         )
         start = self.clock()
+        span_trace = TRACER.current_trace()
+        span_parent = TRACER.current_parent()
+        span_start = time.perf_counter()
         responses = self._stream_rpc(
-            iter(request for request, _ in built), timeout=deadline
+            iter(request for request, _ in built),
+            timeout=deadline,
+            metadata=_trace_metadata(),
         )
         received, stream_done = self._start_stream_drain(responses)
         produced = 0
@@ -288,11 +305,45 @@ class RemoteSolver(Solver):
             produced += 1
         _await_half_close(received, stream_done, failure)
         rpc_elapsed = (stream_done[0] or self.clock()) - start
+        self._record_stream_span(
+            span_trace, span_parent, span_start, rpc_elapsed,
+            len(items), failure,
+        )
         if self._note_stream_outcome(
             failure, produced, len(items), errored, rpc_elapsed
         ):
             for groups, fleet in items[produced:]:
                 yield self.fallback.solve_encoded(groups, fleet)
+
+    def _record_stream_span(
+        self, trace, parent, start_s: float, duration_s: float,
+        solves: int, failure,
+    ) -> None:
+        """The pipelined stream's RPC span, recorded manually: a `with`
+        span around the generator would charge the caller's bind work
+        between pulls to the wire, so this takes the drain thread's
+        wire-time stamps instead (the same reason the RPC histogram does).
+        Trace/parent were captured before the first yield, while the
+        caller's batch trace context and span stack were still current."""
+        if not TRACER.enabled:
+            return
+        TRACER.record(
+            Span(
+                name="solver.rpc.stream",
+                start_s=start_s,
+                duration_s=duration_s,
+                attributes={
+                    "endpoint": self.endpoint,
+                    "solves": solves,
+                    "pipelined": True,
+                    "outcome": "ok" if failure is None else "error",
+                },
+                parent=parent,
+                thread_id=threading.get_ident(),
+                thread_name=threading.current_thread().name,
+                trace=trace or "",
+            )
+        )
 
     def _start_stream_drain(self, responses):
         """Eagerly drain a SolveStream response iterator into a queue from a
@@ -363,7 +414,9 @@ class RemoteSolver(Solver):
             types=fleet.num_types,
         ) as span:
             try:
-                response = self._solve_rpc(request, timeout=self.timeout_s)
+                response = self._solve_rpc(
+                    request, timeout=self.timeout_s, metadata=_trace_metadata()
+                )
             except grpc.RpcError as error:
                 span.set(outcome="error")
                 rpc_error = error

@@ -28,9 +28,22 @@ from karpenter_tpu.ops import ffd, native
 from karpenter_tpu.solver_service import solver_pb2 as pb
 from karpenter_tpu.solver_service import wire
 from karpenter_tpu.utils import logging as klog
-from karpenter_tpu.utils.tracing import TRACER
+from karpenter_tpu.utils.tracing import TRACE_METADATA_KEY, TRACER
 
 log = klog.named("solver-server")
+
+
+def _trace_from_context(context) -> Optional[str]:
+    """The batch trace id the client rode in on the RPC metadata, or None.
+    Request-scoped stream contexts (no invocation_metadata) read as None —
+    the enclosing stream handler already entered the trace."""
+    metadata_fn = getattr(context, "invocation_metadata", None)
+    if metadata_fn is None:
+        return None
+    for key, value in metadata_fn():
+        if key == TRACE_METADATA_KEY:
+            return value
+    return None
 
 
 class _RequestAbort(Exception):
@@ -112,7 +125,9 @@ class _Handler:
         self.warmed = threading.Event()
 
     def solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
-        with TRACER.span("solver.serve", mode=request.mode or "cost"):
+        with TRACER.trace(_trace_from_context(context)), TRACER.span(
+            "solver.serve", mode=request.mode or "cost"
+        ):
             return self._solve(request, context)
 
     def _solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
@@ -189,14 +204,17 @@ class _Handler:
         return dense.unschedulable
 
     def solve_stream(self, request_iterator, context):
-        """Batched, pipelined solve: dispatch every cost-mode request's
-        kernel (and queue its compacted device->host copy) before fetching
-        any result, then yield responses IN REQUEST ORDER as each finishes —
-        the client starts decoding/binding schedule N while schedules N+1..
-        are still computing and copying on the device. Each per-item fetch
-        finds its payload already staged (plan_start_fetch at dispatch), so
-        the stream still pays ~one round trip of latency, not one per item.
-        Non-cost / empty requests take the unary path inline."""
+        """SolveStream entry: enter the client's batch trace (RPC metadata)
+        for the whole stream — ingest, serve span, and every per-item
+        solve record under the one id the host minted."""
+        with TRACER.trace(_trace_from_context(context)):
+            yield from self._solve_stream(request_iterator, context)
+
+    def _ingest_stream(self, request_iterator):
+        """Dispatch phase of the pipelined stream: every cost-mode request's
+        kernel launched (and its compacted device->host copy staged) before
+        any result is fetched. Returns (ready, pending, order) — inline
+        answers by slot, dispatched work in arrival order, total count."""
         ready = {}  # order -> finished SolveResponse
         pending = []  # (order, start, fused, arrays..., pool_prices)
         order = 0
@@ -245,7 +263,18 @@ class _Handler:
             except Exception as err:  # noqa: BLE001 — isolate malformed input
                 ready[order] = _error_response(repr(err))
             order += 1
+        return ready, pending, order
 
+    def _solve_stream(self, request_iterator, context):
+        """Batched, pipelined solve: dispatch every cost-mode request's
+        kernel before fetching any result (_ingest_stream), then yield
+        responses IN REQUEST ORDER as each finishes — the client starts
+        decoding/binding schedule N while schedules N+1.. are still
+        computing and copying on the device. Each per-item fetch finds its
+        payload already staged (plan_start_fetch at dispatch), so the
+        stream still pays ~one round trip of latency, not one per item.
+        Non-cost / empty requests take the unary path inline."""
+        ready, pending, order = self._ingest_stream(request_iterator)
         # Column-LP mix candidates: host work running in a worker thread
         # CONCURRENTLY with the (staged) fetches — the same _HostOverlap the
         # in-process paths use, consumed per item so request N's response

@@ -474,8 +474,10 @@ class MeshHealth:
         self._reported_at: dict = {}  # vet: guarded-by(self._lock) — chip id -> clock time
 
     def report_chip_wedged(self, device_id: int, reason: str) -> None:
+        fresh = False
         with self._lock:
             if device_id not in self._wedged:
+                fresh = True
                 log.warning(
                     "chip %d quarantined out of the solver mesh: %s",
                     device_id,
@@ -484,6 +486,12 @@ class MeshHealth:
             self._wedged[device_id] = reason
             self._reported_at[device_id] = self._clock.now()
             WEDGED_CHIPS.set(float(len(self._wedged)))
+        if fresh:
+            # Quarantines are exactly the class of rare, consequential event
+            # the flight recorder exists for (recorded outside the lock).
+            from karpenter_tpu.utils.obs import RECORDER
+
+            RECORDER.record("quarantine", chip=device_id, reason=reason)
 
     def clear(self, device_id: Optional[int] = None) -> None:
         """Un-quarantine one chip (a re-probe saw it answer) or, with no

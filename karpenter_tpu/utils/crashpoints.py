@@ -105,6 +105,15 @@ class _Arm:
 _lock = threading.Lock()
 _armed: Dict[str, _Arm] = {}
 _passages: Dict[str, int] = {}  # every passage ever, armed or not
+# Fired just before a crash executes (black-box hooks: the flight recorder
+# registers a dump here so even an action="exit" kill — which skips atexit —
+# leaves a forensic record). Append-only from module init; never under _lock.
+_crash_callbacks: List = []
+
+
+def on_crash(callback) -> None:
+    """Register callback(site) to run right before an armed crash fires."""
+    _crash_callbacks.append(callback)
 
 
 def crashpoint(name: str) -> None:
@@ -124,6 +133,11 @@ def crashpoint(name: str) -> None:
         if arm.hits < arm.at:
             return
         del _armed[name]  # one-shot: the process only dies once
+    for callback in _crash_callbacks:
+        try:
+            callback(name)
+        except Exception:  # noqa: BLE001 — a black-box hook must not mask the crash
+            pass
     if arm.action == "exit":
         os._exit(86)
     raise SimulatedCrash(name)

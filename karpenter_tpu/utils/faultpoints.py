@@ -128,6 +128,7 @@ def draw(site: str) -> Optional[Fault]:
     `site`, or None. No-op (one dict read, no lock) unless armed."""
     if not _armed:
         return None
+    winner = None
     with _lock:
         faults = _armed.get(site)
         if not faults:
@@ -139,8 +140,16 @@ def draw(site: str) -> Optional[Fault]:
                 continue
             fault.fires += 1
             _fired[site] = _fired.get(site, 0) + 1
-            return fault
-    return None
+            winner = fault
+            break
+    if winner is not None:
+        # Chaos is only diagnosable if the black box saw it: every injected
+        # fault lands in the flight recorder (outside the site lock), so a
+        # storm postmortem can line faults up against retries and launches.
+        from karpenter_tpu.utils.obs import RECORDER
+
+        RECORDER.record("fault", site=site, fault=winner.kind)
+    return winner
 
 
 def fires(site: str) -> bool:

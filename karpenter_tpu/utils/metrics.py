@@ -24,6 +24,28 @@ DURATION_BUCKETS = (
 LabelValues = Tuple[str, ...]
 
 
+def escape_label_value(value) -> str:
+    """Text-exposition-format escaping for label VALUES: backslash, double
+    quote, and newline must be escaped or the rendered line is malformed and
+    the whole /metrics page fails to parse (Prometheus exposition spec §
+    'Comments, help text, and type information'). Reason strings routinely
+    carry quotes (exception reprs) — they flow in via sweep_failures_total."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def render_labels(names: Sequence[str], values: LabelValues) -> str:
+    """'k1="v1",k2="v2"' with values escaped — the one label serializer both
+    metric types render through, so escaping cannot drift between them."""
+    return ",".join(
+        f'{n}="{escape_label_value(v)}"' for n, v in zip(names, values)
+    )
+
+
 class _Timer:
     """Histogram.measure() context manager, hoisted to module level — the
     previous closure built a fresh class object per measured block, which at
@@ -86,9 +108,7 @@ class Gauge:
         with self._lock:
             for label_values, value in sorted(self._values.items()):
                 if self.label_names:
-                    labels = ",".join(
-                        f'{n}="{v}"' for n, v in zip(self.label_names, label_values)
-                    )
+                    labels = render_labels(self.label_names, label_values)
                     lines.append(f"{self.name}{{{labels}}} {value}")
                 else:
                     # Label-free series (e.g. backend_probe_result) render
@@ -171,7 +191,7 @@ class Histogram:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
             for key, counts in sorted(self._counts.items()):
-                base = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
+                base = render_labels(self.label_names, key)
                 sep = "," if base else ""
                 running = 0
                 for bound, count in zip(self.buckets, counts):

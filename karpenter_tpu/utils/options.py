@@ -77,6 +77,13 @@ class Options:
     # Seconds of quiet after any interruption/termination activity before
     # consolidation acts again — the voluntary path yields to reclamation.
     consolidation_cooldown: float = 60.0
+    # Pod-latency SLO targets (utils/obs.py SloEvaluator): rolling-window
+    # p99 ceilings for end-to-end pending time and time-to-first-launch.
+    # Exceeding a target counts slo_breaches_total{slo} and triggers a
+    # flight-recorder dump (KARPENTER_FLIGHT_DIR). 0 disables the objective
+    # — the gauges still publish. See docs/design/observability.md.
+    slo_pending_p99: float = 0.0
+    slo_ttfl: float = 0.0
     # Tombstone-density trigger for the incremental encoder's masked
     # compaction (models/cluster_state.py): when freed-but-unreused slot
     # rows exceed this fraction of the high-water mark, live rows are
@@ -126,20 +133,20 @@ class Options:
                 "interruption-escalate-fraction must be in (0, 1], got "
                 f"{self.interruption_escalate_fraction}"
             )
+        # Non-negative scalars where 0 means "disabled": one data-driven
+        # check so each new knob costs a row, not a branch.
+        for flag, value in (
+            ("slo-pending-p99", self.slo_pending_p99),
+            ("slo-ttfl", self.slo_ttfl),
+            ("consolidation-max-disruption", self.consolidation_max_disruption),
+            ("consolidation-cooldown", self.consolidation_cooldown),
+        ):
+            if value < 0:
+                errors.append(f"{flag} must be >= 0 (0 disables), got {value}")
         if not 0.0 < self.encode_compaction_threshold <= 1.0:
             errors.append(
                 "encode-compaction-threshold must be in (0, 1], got "
                 f"{self.encode_compaction_threshold}"
-            )
-        if self.consolidation_max_disruption < 0:
-            errors.append(
-                "consolidation-max-disruption must be >= 0 (0 disables), got "
-                f"{self.consolidation_max_disruption}"
-            )
-        if self.consolidation_cooldown < 0:
-            errors.append(
-                f"consolidation-cooldown must be >= 0, got "
-                f"{self.consolidation_cooldown}"
             )
         if self.cluster_store != "memory" and self.cluster_store != "incluster" and not self.cluster_store.startswith(
             ("http://", "https://")
@@ -214,6 +221,14 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         "--encode-compaction-threshold", type=float,
         default=float(_env("ENCODE_COMPACTION_THRESHOLD", "0.5")),
     )
+    parser.add_argument(
+        "--slo-pending-p99", type=float,
+        default=float(_env("SLO_PENDING_P99", "0")),
+    )
+    parser.add_argument(
+        "--slo-ttfl", type=float,
+        default=float(_env("SLO_TTFL", "0")),
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -237,6 +252,8 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         consolidation_max_disruption=args.consolidation_max_disruption,
         consolidation_cooldown=args.consolidation_cooldown,
         encode_compaction_threshold=args.encode_compaction_threshold,
+        slo_pending_p99=args.slo_pending_p99,
+        slo_ttfl=args.slo_ttfl,
     )
     options.validate()
     return options

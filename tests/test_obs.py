@@ -1,0 +1,401 @@
+"""Pod-latency SLO pipeline tests (utils/obs.py): the lifecycle tracker's
+phase attribution, the rolling SLO evaluator's breach handling, and the
+flight recorder's gap-free-dump contract. All rebuild-added surface — the
+reference ships only aggregate Prometheus histograms (SURVEY.md §5)."""
+
+import json
+import threading
+
+import pytest
+
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.utils import obs
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.obs import (
+    PHASES,
+    POD_PENDING_SECONDS,
+    POD_PHASE_SECONDS,
+    SLO_BREACHES_TOTAL,
+    SLO_P99_PENDING,
+    FlightRecorder,
+    PodLifecycleTracker,
+    SloEvaluator,
+)
+
+
+def make_pod(name="p", created_at=None, **kwargs):
+    pod = PodSpec(name=name, unschedulable=True, **kwargs)
+    pod.created_at = created_at
+    return pod
+
+
+class TestFlightRecorder:
+    def test_seq_monotonic_and_gap_free_when_unbounded(self):
+        recorder = FlightRecorder(maxlen=100)
+        for i in range(50):
+            recorder.record("launch", n=i)
+        snap = recorder.snapshot()
+        assert snap["dropped"] == 0
+        assert [e["seq"] for e in snap["events"]] == list(range(1, 51))
+        assert snap["first_seq"] == 1 and snap["last_seq"] == 50
+
+    def test_ring_eviction_counts_dropped(self):
+        recorder = FlightRecorder(maxlen=10)
+        for i in range(25):
+            recorder.record("retry", n=i)
+        snap = recorder.snapshot()
+        assert len(snap["events"]) == 10
+        assert snap["dropped"] == 15
+        # The surviving window is the NEWEST events, still contiguous.
+        assert [e["seq"] for e in snap["events"]] == list(range(16, 26))
+
+    def test_dump_json_round_trips(self):
+        recorder = FlightRecorder(maxlen=10)
+        recorder.record("quarantine", chip=3, reason='wedged "hard"')
+        loaded = json.loads(recorder.dump_json())
+        [event] = loaded["events"]
+        assert event["kind"] == "quarantine"
+        assert event["reason"] == 'wedged "hard"'
+
+    def test_dump_writes_file_when_dir_configured(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_FLIGHT_DIR", str(tmp_path))
+        recorder = FlightRecorder(maxlen=10)
+        recorder.record("crash", site="provision.before-register")
+        path = recorder.dump(tag="test")
+        assert path is not None
+        loaded = json.loads(open(path).read())
+        assert loaded["events"][0]["site"] == "provision.before-register"
+
+    def test_dump_without_dir_is_http_only(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_FLIGHT_DIR", raising=False)
+        recorder = FlightRecorder(maxlen=10)
+        recorder.record("x")
+        assert recorder.dump() is None
+
+    def test_concurrent_writers_dump_deterministically(self):
+        """A snapshot under concurrent writers is still internally
+        consistent: seq strictly increasing, dropped == seq - len(events),
+        no torn event dicts (every event has kind + seq)."""
+        recorder = FlightRecorder(maxlen=256)
+        stop = threading.Event()
+
+        def writer(k):
+            i = 0
+            while not stop.is_set():
+                recorder.record("w", writer=k, i=i)
+                i += 1
+
+        threads = [
+            threading.Thread(target=writer, args=(k,), daemon=True)
+            for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(20):
+                snap = recorder.snapshot()
+                seqs = [e["seq"] for e in snap["events"]]
+                assert seqs == sorted(seqs)
+                assert len(set(seqs)) == len(seqs)
+                assert snap["dropped"] == snap["seq"] - len(snap["events"])
+                assert all("kind" in e for e in snap["events"])
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=2.0)
+
+    def test_crashpoint_hook_records_and_dumps(self, tmp_path, monkeypatch):
+        """An armed crash fires the obs crash hook BEFORE dying: the black
+        box records the site and lands a dump file even though the process
+        would be gone before atexit."""
+        from karpenter_tpu.utils import crashpoints
+
+        monkeypatch.setenv("KARPENTER_FLIGHT_DIR", str(tmp_path))
+        before = obs.RECORDER.count("crash")
+        crashpoints.arm("obs.test-site")
+        try:
+            with pytest.raises(crashpoints.SimulatedCrash):
+                crashpoints.crashpoint("obs.test-site")
+        finally:
+            crashpoints.disarm_all()
+        assert obs.RECORDER.count("crash") == before + 1
+        dumps = list(tmp_path.glob("flightrecorder-crash-obs-test-site-*.json"))
+        assert dumps, "crash dump file missing"
+
+
+class TestSloEvaluator:
+    def _evaluator(self, **targets):
+        clock = FakeClock()
+        evaluator = SloEvaluator(clock=clock, recorder=FlightRecorder(clock=clock))
+        evaluator.configure(**targets)
+        return clock, evaluator
+
+    def test_quantiles_published(self):
+        clock, ev = self._evaluator()
+        for s in [0.1, 0.2, 0.3, 5.0]:
+            ev.add_pending(s, f"uid-{s}", "batched")
+            clock.advance(2.0)
+        snap = ev.evaluate(force=True)
+        assert snap["pending"]["count"] == 4
+        assert snap["pending"]["p99"] == 5.0
+        assert SLO_P99_PENDING.get() == 5.0
+
+    def test_window_expires_old_samples(self):
+        clock, ev = self._evaluator()
+        ev.add_pending(9.0, "old", "batched")
+        clock.advance(ev.WINDOW_SECONDS + 1)
+        ev.add_pending(1.0, "new", "batched")
+        snap = ev.evaluate(force=True)
+        assert snap["pending"]["count"] == 1
+        assert snap["pending"]["p99"] == 1.0
+
+    def test_breach_counts_and_names_offenders(self):
+        clock, ev = self._evaluator(pending_p99_target=1.0)
+        ev.add_pending(0.5, "fast", "batched")
+        ev.add_pending(30.0, "slow", "solve-dispatched")
+        ev.evaluate(force=True)
+        assert ev.breaches.get("pending-p99") == 1
+        [breach] = [
+            e
+            for e in ev.recorder.snapshot()["events"]
+            if e["kind"] == "slo-breach"
+        ]
+        assert breach["slo"] == "pending-p99"
+        worst = breach["offenders"][0]
+        assert worst["pod_uid"] == "slow"
+        assert worst["slowest_phase"] == "solve-dispatched"
+
+    def test_breach_episode_gated_by_cooldown(self):
+        clock, ev = self._evaluator(pending_p99_target=1.0)
+        ev.add_pending(30.0, "slow", "batched")
+        ev.evaluate(force=True)
+        clock.advance(2.0)  # inside the cooldown
+        ev.evaluate(force=True)
+        assert ev.breaches["pending-p99"] == 1
+        clock.advance(ev.BREACH_COOLDOWN_S + 1)
+        ev.evaluate(force=True)
+        assert ev.breaches["pending-p99"] == 2
+
+    def test_zero_target_disables_objective(self):
+        clock, ev = self._evaluator()  # defaults: both targets 0
+        ev.add_pending(1e6, "huge", "batched")
+        ev.evaluate(force=True)
+        assert ev.breaches == {}
+
+    def test_ttfl_breach_is_separate_objective(self):
+        clock, ev = self._evaluator(ttfl_target=0.5)
+        ev.add_pending(30.0, "pending-slow", "batched")  # pending not gated
+        ev.add_ttfl(2.0, "launch-slow")
+        ev.evaluate(force=True)
+        assert ev.breaches == {"ttfl": 1}
+
+
+class TestPodLifecycleTracker:
+    def _tracker(self):
+        clock = FakeClock()
+        tracker = PodLifecycleTracker(clock=clock)
+        tracker.evaluator = SloEvaluator(
+            clock=clock, recorder=FlightRecorder(clock=clock)
+        )
+        return clock, tracker
+
+    def test_phase_attribution_in_arrival_order(self):
+        clock, tracker = self._tracker()
+        pod = make_pod()
+        tracker.first_seen(pod)
+        before = {phase: POD_PHASE_SECONDS.count(phase) for phase in PHASES}
+        clock.advance(1.0)
+        tracker.stamp(pod.uid, "batched")
+        clock.advance(2.0)
+        tracker.stamp(pod.uid, "solve-dispatched")
+        assert POD_PHASE_SECONDS.count("batched") == before["batched"] + 1
+        assert (
+            POD_PHASE_SECONDS.count("solve-dispatched")
+            == before["solve-dispatched"] + 1
+        )
+
+    def test_repeat_stamp_ignored(self):
+        clock, tracker = self._tracker()
+        pod = make_pod()
+        tracker.first_seen(pod)
+        before = POD_PHASE_SECONDS.count("batched")
+        tracker.stamp(pod.uid, "batched")
+        clock.advance(5.0)
+        tracker.stamp(pod.uid, "batched")  # monotonic: second stamp dropped
+        assert POD_PHASE_SECONDS.count("batched") == before + 1
+
+    def test_unknown_pod_stamp_is_noop(self):
+        clock, tracker = self._tracker()
+        tracker.stamp("never-seen", "batched")  # must not raise or record
+        assert tracker.tracked() == 0
+
+    def test_bound_records_end_to_end_pending(self):
+        clock, tracker = self._tracker()
+        pod = make_pod()
+        tracker.first_seen(pod)
+        before = POD_PENDING_SECONDS.count()
+        clock.advance(1.0)
+        tracker.stamp(pod.uid, "batched")
+        clock.advance(3.0)
+        tracker.stamp(pod.uid, "bound")
+        assert POD_PENDING_SECONDS.count() == before + 1
+        [(_, seconds, uid, slowest)] = list(tracker.evaluator._pending)
+        assert uid == pod.uid
+        assert seconds == 4.0
+        assert slowest == "bound"  # 3s bound leg > 1s batched leg
+
+    def test_launched_feeds_ttfl(self):
+        clock, tracker = self._tracker()
+        pod = make_pod()
+        tracker.first_seen(pod)
+        clock.advance(0.7)
+        tracker.stamp(pod.uid, "launched")
+        [(_, seconds, uid, _)] = list(tracker.evaluator._ttfl)
+        assert uid == pod.uid and seconds == pytest.approx(0.7)
+
+    def test_restart_reanchors_on_creation_timestamp(self):
+        """A tracker that first sees a pod mid-flight (controller restart)
+        anchors at creationTimestamp, so the pending time charged spans the
+        restart instead of starting at process boot."""
+        clock, tracker = self._tracker()
+        pod = make_pod(created_at=clock.now() - 42.0)
+        tracker.first_seen(pod)
+        tracker.stamp(pod.uid, "bound")
+        [(_, seconds, _, _)] = list(tracker.evaluator._pending)
+        assert seconds == pytest.approx(42.0)
+
+    def test_future_creation_timestamp_clamps_to_now(self):
+        clock, tracker = self._tracker()
+        pod = make_pod(created_at=clock.now() + 1000.0)
+        tracker.first_seen(pod)
+        tracker.stamp(pod.uid, "bound")
+        [(_, seconds, _, _)] = list(tracker.evaluator._pending)
+        assert seconds == 0.0
+
+    def test_terminal_stamps_retire_the_entry(self):
+        clock, tracker = self._tracker()
+        pod = make_pod()
+        tracker.first_seen(pod)
+        tracker.stamp(pod.uid, "node-ready")
+        assert tracker.tracked() == 1
+        tracker.stamp(pod.uid, "bound")
+        assert tracker.tracked() == 0
+
+    def test_stamp_many_matches_stamp(self):
+        clock, tracker = self._tracker()
+        pods = [make_pod(name=f"p{i}") for i in range(5)]
+        for pod in pods:
+            tracker.first_seen(pod)
+        clock.advance(2.0)
+        before = POD_PHASE_SECONDS.count("batched")
+        tracker.stamp_many([p.uid for p in pods], "batched")
+        assert POD_PHASE_SECONDS.count("batched") == before + 5
+        clock.advance(1.0)
+        before_pending = POD_PENDING_SECONDS.count()
+        tracker.stamp_many([p.uid for p in pods], "bound")
+        assert POD_PENDING_SECONDS.count() == before_pending + 5
+        assert len(tracker.evaluator._pending) == 5
+
+    def test_reschedule_starts_fresh_cycle(self):
+        clock, tracker = self._tracker()
+        pod = make_pod()
+        tracker.first_seen(pod)
+        clock.advance(10.0)
+        tracker.reanchor(pod.uid)
+        clock.advance(1.0)
+        tracker.stamp(pod.uid, "bound")
+        [(_, seconds, _, _)] = list(tracker.evaluator._pending)
+        assert seconds == pytest.approx(1.0)  # not 11.0: the cycle restarted
+
+    def test_bounded_tracking_evicts_oldest(self, monkeypatch):
+        clock, tracker = self._tracker()
+        monkeypatch.setattr(PodLifecycleTracker, "MAX_TRACKED", 3)
+        pods = [make_pod(name=f"p{i}") for i in range(5)]
+        for pod in pods:
+            tracker.first_seen(pod)
+        assert tracker.tracked() == 3
+        # The OLDEST entries fell; the newest still stamp.
+        before = POD_PHASE_SECONDS.count("batched")
+        tracker.stamp(pods[-1].uid, "batched")
+        tracker.stamp(pods[0].uid, "batched")  # evicted: no-op
+        assert POD_PHASE_SECONDS.count("batched") == before + 1
+
+
+class TestWatchFeedIntegration:
+    def _attached(self):
+        clock = FakeClock()
+        cluster = Cluster(clock=clock)
+        tracker = PodLifecycleTracker(clock=clock)
+        tracker.evaluator = SloEvaluator(
+            clock=clock, recorder=FlightRecorder(clock=clock)
+        )
+        tracker.attach(cluster)
+        return clock, cluster, tracker
+
+    def test_apply_and_bind_through_the_delta_feed(self):
+        from karpenter_tpu.cloudprovider import NodeSpec
+
+        clock, cluster, tracker = self._attached()
+        pod = cluster.apply_pod(PodSpec(name="w", unschedulable=True))
+        assert tracker.tracked() == 1
+        assert pod.created_at == clock.now()
+        node = NodeSpec(name="n1", instance_type="t", zone="z", capacity_type="od")
+        cluster.create_node(node)
+        clock.advance(2.5)
+        cluster.bind_pod(pod, node)
+        [(_, seconds, uid, _)] = list(tracker.evaluator._pending)
+        assert uid == pod.uid and seconds == pytest.approx(2.5)
+
+    def test_delete_forgets(self):
+        clock, cluster, tracker = self._attached()
+        pod = cluster.apply_pod(PodSpec(name="w", unschedulable=True))
+        assert tracker.tracked() == 1
+        cluster.delete_pod(pod.namespace, pod.name)
+        assert tracker.tracked() == 0
+
+    def test_bind_of_unseen_pod_reanchors_from_creation(self):
+        """Restart catch-up: a pod pending across the restart whose BIND
+        event arrives before the tracker saw it pending — with a
+        creationTimestamp on the object, the full pending time is charged."""
+        clock, cluster, tracker = self._attached()
+        pod = PodSpec(name="survivor", node_name="n1")
+        pod.created_at = clock.now() - 7.0
+        tracker.on_delta("bind", "pod", pod)
+        [(_, seconds, _, _)] = list(tracker.evaluator._pending)
+        assert seconds == pytest.approx(7.0)
+
+    def test_relist_of_long_bound_pod_records_nothing(self):
+        """An apply of an already-bound pod the tracker never saw pending
+        (watch re-list at restart) must NOT charge creation→now as pending
+        — that would re-record every long-bound pod's full age on every
+        restart and poison the p99."""
+        clock, cluster, tracker = self._attached()
+        pod = PodSpec(name="veteran", node_name="n1")
+        pod.created_at = clock.now() - 86400.0
+        tracker.on_delta("apply", "pod", pod)
+        assert list(tracker.evaluator._pending) == []
+        assert tracker.tracked() == 0
+
+    def test_newest_attach_wins(self):
+        clock, cluster, tracker = self._attached()
+        rebuilt = Cluster(clock=clock)
+        tracker.attach(rebuilt)  # chaos harness rebuilt the "process"
+        cluster.apply_pod(PodSpec(name="stale", unschedulable=True))
+        assert tracker.tracked() == 0  # old store's callback went inert
+        rebuilt.apply_pod(PodSpec(name="fresh", unschedulable=True))
+        assert tracker.tracked() == 1
+
+
+class TestStacksSnapshot:
+    def test_names_every_thread(self):
+        snap = obs.stacks_snapshot(sample_s=0.0)
+        assert snap["pid"] > 0
+        assert snap["thread_count"] >= 1
+        assert any("MainThread" in name for name in snap["threads"])
+
+    def test_sampled_profile_collects(self):
+        snap = obs.stacks_snapshot(sample_s=0.05)
+        # StackProf ships in the production package (utils/stackprof.py):
+        # the sampler must actually run and attribute frames.
+        assert snap["profile_samples"] > 0

@@ -229,6 +229,36 @@ class TestSpmdCpuMesh:
         )
         assert not replay, "follower consumed a different number of legs"
 
+    def test_trace_id_rides_the_header_to_follower_spans(self, monkeypatch):
+        """The SPMD leg of trace stitching: a trace id current on the lead
+        rides the fixed-shape header as two int32 words, and the follower's
+        step span carries the SAME id."""
+        from karpenter_tpu.parallel.mesh import make_mesh
+        from karpenter_tpu.api import wellknown
+        from karpenter_tpu.utils import tracing
+
+        tracer = tracing.Tracer(enabled=True)
+        monkeypatch.setattr(spmd, "TRACER", tracer)
+        mesh = make_mesh()
+        kernel, padded, _ = self._example(mesh)
+
+        wire = []
+        monkeypatch.setattr(
+            spmd, "_broadcast", lambda value: (wire.append(value), value)[1]
+        )
+        trace_id = tracing.new_trace_id()
+        with tracer.trace(trace_id):
+            spmd.SpmdDispatcher().lead_dispatch(kernel, padded, 6, mesh=mesh)
+        header = np.asarray(wire[0])
+        assert header.shape == (spmd.HEADER_WORDS,)
+        assert tracing.words_to_trace_id(header[4], header[5]) == trace_id
+
+        replay = list(wire)
+        monkeypatch.setattr(spmd, "_broadcast", lambda _: replay.pop(0))
+        assert spmd.follower_step(wellknown.NUM_RESOURCE_DIMS) is not None
+        [step] = tracer.spans("spmd.follower.step")
+        assert step.trace == trace_id
+
     def test_device_mask_replicates_shrunk_mesh(self, monkeypatch):
         import jax
 
@@ -250,7 +280,7 @@ class TestSpmdCpuMesh:
         from karpenter_tpu.api import wellknown
 
         monkeypatch.setattr(
-            spmd, "_broadcast", lambda _: np.zeros(4, np.int32)
+            spmd, "_broadcast", lambda _: np.zeros(spmd.HEADER_WORDS, np.int32)
         )
         assert spmd.follower_step(wellknown.NUM_RESOURCE_DIMS) is None
 

@@ -70,7 +70,81 @@ class TestChromeExport:
         flushed = tracer.flush(str(path))
         assert flushed == str(path)
         loaded = json.loads(path.read_text())
-        assert len(loaded["traceEvents"]) == 2
+        # 2 span ('X') events + process_name/thread_name metadata ('M')
+        # events — the labels a merged multi-process viewer needs.
+        spans = [e for e in loaded["traceEvents"] if e["ph"] == "X"]
+        metadata = [e for e in loaded["traceEvents"] if e["ph"] == "M"]
+        assert len(spans) == 2
+        assert {e["name"] for e in metadata} == {"process_name", "thread_name"}
+
+    def test_export_is_wall_clock_anchored(self, tracer):
+        """Satellite: raw perf_counter ts values are incomparable across
+        processes — exported ts must be epoch-anchored and the offset
+        recorded in the export metadata, so multi-process traces align."""
+        import time as _time
+
+        before = _time.time() * 1e6
+        with tracer.span("anchored"):
+            pass
+        [event] = [
+            e for e in tracer.chrome_trace_events() if e["name"] == "anchored"
+        ]
+        after = _time.time() * 1e6
+        assert before - 1e6 <= event["ts"] <= after + 1e6
+        document = tracer.chrome_trace_document()
+        assert document["metadata"]["clock_epoch_offset_s"] == tracer.epoch_offset_s
+
+    def test_full_thread_ids_exported(self, tracer):
+        """Satellite: the old `thread_id & 0xFFFF` truncation collided
+        lanes; exported tid must be the full ident."""
+        import threading
+
+        with tracer.span("here"):
+            pass
+        [span] = tracer.spans("here")
+        assert span.thread_id == threading.get_ident()
+        [event] = tracer.chrome_trace_events()
+        assert event["tid"] == threading.get_ident()
+
+
+class TestTraceContext:
+    def test_trace_id_rides_spans(self, tracer):
+        trace_id = tracing.new_trace_id()
+        with tracer.trace(trace_id):
+            with tracer.span("inside"):
+                pass
+        with tracer.span("outside"):
+            pass
+        [inside] = tracer.spans("inside")
+        [outside] = tracer.spans("outside")
+        assert inside.trace == trace_id
+        assert outside.trace == ""
+
+    def test_trace_context_restores_previous(self, tracer):
+        outer, inner = tracing.new_trace_id(), tracing.new_trace_id()
+        with tracer.trace(outer):
+            with tracer.trace(inner):
+                assert tracer.current_trace() == inner
+            assert tracer.current_trace() == outer
+        assert tracer.current_trace() is None
+
+    def test_none_keeps_outer_trace(self, tracer):
+        outer = tracing.new_trace_id()
+        with tracer.trace(outer):
+            with tracer.trace(None):
+                assert tracer.current_trace() == outer
+
+    def test_trace_id_word_round_trip(self):
+        """The SPMD header leg carries the id as two non-negative int32
+        words; the round trip must be lossless for every minted id."""
+        for _ in range(32):
+            trace_id = tracing.new_trace_id()
+            lo, hi = tracing.trace_id_to_words(trace_id)
+            assert 0 <= lo < 2**31 and 0 <= hi < 2**31
+            assert tracing.words_to_trace_id(lo, hi) == trace_id
+        assert tracing.trace_id_to_words(None) == (0, 0)
+        assert tracing.trace_id_to_words("") == (0, 0)
+        assert tracing.words_to_trace_id(0, 0) is None
 
     def test_flush_without_target_is_noop(self, tracer, monkeypatch):
         monkeypatch.delenv("KARPENTER_TRACE_FILE", raising=False)
@@ -111,6 +185,52 @@ class TestPipelineWiring:
         assert rpc.attributes["outcome"] == "ok"
         assert rpc.attributes["server_ms"] > 0
         assert tracer.spans("solver.serve")  # server-side span, same process here
+
+    def test_provision_mints_a_batch_trace_id(self, tracer, monkeypatch):
+        """Every provisioning pass runs under a fresh trace id; all its
+        stage spans carry it, so one batch filters to one timeline."""
+        from karpenter_tpu.controllers import provisioning as prov_mod
+
+        monkeypatch.setattr(prov_mod, "TRACER", tracer)
+        h = Harness(solver=GreedySolver())
+        h.apply_provisioner(Provisioner(name="default"))
+        h.provision(*fixtures.pods(4))
+        [schedule] = tracer.spans("provision.schedule")
+        [bind] = tracer.spans("provision.bind")
+        assert schedule.trace and schedule.trace == bind.trace
+
+    def test_trace_id_rides_rpc_metadata_to_server_spans(
+        self, tracer, monkeypatch
+    ):
+        """The stitching contract: a trace id current on the client rides
+        the SolveStream/Solve gRPC metadata, and the sidecar's serve spans
+        carry the SAME id — a merged export stitches host + RPC + solve
+        lanes under one trace."""
+        from karpenter_tpu.solver_service import client as client_mod
+        from karpenter_tpu.solver_service import server as server_mod
+        from karpenter_tpu.solver_service.client import RemoteSolver
+        from karpenter_tpu.solver_service.server import SolverServer
+        from karpenter_tpu.api.provisioner import Constraints
+
+        monkeypatch.setattr(client_mod, "TRACER", tracer)
+        monkeypatch.setattr(server_mod, "TRACER", tracer)
+        trace_id = tracing.new_trace_id()
+        server = SolverServer(port=0).start(warmup=False)
+        try:
+            remote = RemoteSolver(f"127.0.0.1:{server.port}")
+            with tracer.trace(trace_id):
+                remote.solve(
+                    fixtures.pods(6), fixtures.size_ladder(3), Constraints()
+                )
+            remote.close()
+        finally:
+            server.stop()
+        [rpc] = tracer.spans("solver.rpc")
+        [serve] = tracer.spans("solver.serve")
+        assert rpc.trace == trace_id
+        # The serve span ran on a gRPC worker thread in "another process's"
+        # role: its id arrived via the wire metadata, not thread state.
+        assert serve.trace == trace_id
 
     def test_rpc_error_span_marks_outcome(self, tracer, monkeypatch):
         from karpenter_tpu.solver_service import client as client_mod

@@ -39,6 +39,37 @@ class TestTtlCache:
         assert len(cache._entries) <= TtlCache.SWEEP_INTERVAL + 1
 
 
+class TestExpositionEscaping:
+    """Prometheus text-format escaping regression (ISSUE 13 satellite): a
+    label value carrying `\\`, `"`, or a newline must render per the spec
+    — before the fix one hostile reason string (an exception repr) made the
+    whole /metrics page unparseable."""
+
+    def test_gauge_escapes_hostile_label_values(self):
+        from karpenter_tpu.utils.metrics import Gauge
+
+        gauge = Gauge("test_escape_gauge", "h", ["reason"])
+        gauge.inc('Error("C:\\path")\nline2')
+        [line] = [l for l in gauge.render() if not l.startswith("#")]
+        assert line == (
+            'test_escape_gauge{reason="Error(\\"C:\\\\path\\")\\nline2"} 1.0'
+        )
+
+    def test_histogram_escapes_hostile_label_values(self):
+        from karpenter_tpu.utils.metrics import Histogram
+
+        histogram = Histogram("test_escape_hist", "h", ["op"], buckets=(1.0,))
+        histogram.observe(0.5, 'a"b\\c')
+        rendered = "\n".join(histogram.render())
+        assert 'op="a\\"b\\\\c"' in rendered
+        assert 'a"b\\c"' not in rendered  # no raw quote survives
+
+    def test_plain_values_unchanged(self):
+        from karpenter_tpu.utils.metrics import escape_label_value
+
+        assert escape_label_value("spot/us-east-1a") == "spot/us-east-1a"
+
+
 class TestBackoffQueue:
     """The eviction-queue retry semantics (utils/workqueue.BackoffQueue),
     driven by the FakeClock: set-dedup holds across in-flight processing and

@@ -192,6 +192,34 @@ CASES = [
         """,
     ),
     (
+        # Span names declared once in the SPAN_NAMES inventory (ISSUE 13
+        # satellite): an ad-hoc TRACER.span literal orphans every trace
+        # query keyed on the old name.
+        "span-consistency",
+        """
+        from karpenter_tpu.utils.tracing import TRACER
+
+        SPAN_NAMES = ("provision.known",)
+
+        def work():
+            with TRACER.span("provision.unknown"):
+                pass
+        """,
+        """
+        from karpenter_tpu.utils.tracing import TRACER
+
+        SPAN_NAMES = ("provision.known",)
+
+        def work(name, harness_tracer):
+            with TRACER.span("provision.known"):
+                pass
+            with TRACER.span(name):  # dynamic: arity unknowable, skipped
+                pass
+            with harness_tracer.span("scratch"):  # not the TRACER receiver
+                pass
+        """,
+    ),
+    (
         "jax-platforms-ownership",
         """
         import os
@@ -382,6 +410,28 @@ def test_metrics_duplicate_declaration(tmp_path):
     assert [f.key for f in findings] == ["duplicate:vet_test_dup_total"]
 
 
+def test_span_inventory_cannot_be_self_declared(tmp_path):
+    """A local SPAN_NAMES next to an ad-hoc span must NOT whitelist it when
+    the canonical utils/tracing.py inventory is in scope — otherwise any
+    file escapes the one-home discipline by declaring its own tuple."""
+    tracing_dir = tmp_path / "utils"
+    tracing_dir.mkdir()
+    (tracing_dir / "tracing.py").write_text(
+        'SPAN_NAMES = ("provision.known",)\n'
+    )
+    (tmp_path / "rogue.py").write_text(
+        "from karpenter_tpu.utils.tracing import TRACER\n"
+        'SPAN_NAMES = ("rogue.span",)\n'
+        "def work():\n"
+        '    with TRACER.span("rogue.span"):\n'
+        "        pass\n"
+    )
+    findings = CHECKERS_BY_NAME["span-consistency"].run(
+        load_modules([tracing_dir / "tracing.py", tmp_path / "rogue.py"])
+    )
+    assert [f.key for f in findings] == ["unknown-span:rogue.span@work"]
+
+
 def test_lock_discipline_holds_annotation(tmp_path):
     source = """
     import threading
@@ -566,7 +616,7 @@ def test_cli_rejects_missing_path(capsys):
 def test_production_tree_is_vet_clean():
     """`make vet` as a tier-1 test: zero findings, zero stale baseline
     entries over karpenter_tpu/ + the driver entry files. A regression in
-    any of the seven disciplines fails here with a file:line message."""
+    any of the disciplines fails here with a file:line message."""
     findings, stale = run_vet()
     rendered = [f.render() for f in findings] + [
         f"stale baseline entry ({checker}): {entry}" for checker, entry in stale
@@ -576,7 +626,7 @@ def test_production_tree_is_vet_clean():
 
 def test_checker_names_unique():
     names = [checker.name for checker in ALL_CHECKERS]
-    assert len(names) == len(set(names)) == 9
+    assert len(names) == len(set(names)) == 10
 
 
 def test_constraints_subsystem_in_vet_scope():

@@ -40,6 +40,12 @@ MIN_AVAILABLE = 2
 CRASH_ROUNDS = {1: "interruption.after-annotate", 3: "interruption.mid-drain"}
 INTERRUPTION_DEADLINE_S = 600.0  # fake seconds: never reached -> polite drains
 MIN_INJECTED = 80  # the storm must actually bite this many times
+# SLO gates (fake seconds): generous ceilings the storm must stay inside —
+# every wait budget below translates to <= ~135 fake seconds of pending, so
+# a p99 beyond this is a real regression, not noise. The targets arm the
+# SloEvaluator's breach machinery; the gate asserts ZERO breach episodes.
+SLO_PENDING_P99_S = 240.0
+SLO_TTFL_S = 240.0
 
 
 def build_process(state):
@@ -67,7 +73,13 @@ def build_process(state):
     manager = Manager(
         cluster,
         state["cloud"],
-        Options(cluster_name="chaos", solver="greedy", leader_election=False),
+        Options(
+            cluster_name="chaos",
+            solver="greedy",
+            leader_election=False,
+            slo_pending_p99=SLO_PENDING_P99_S,
+            slo_ttfl=SLO_TTFL_S,
+        ),
     )
     manager.start()
     state["cluster"], state["manager"] = cluster, manager
@@ -86,10 +98,22 @@ def nudge(state):
     deadlines pace on it — ~3 fake seconds per real second keeps windows
     closing while staying far inside the 600s interruption deadline and the
     900s liveness ceiling."""
+    from karpenter_tpu.kubeapi import ApiError, TransportError
+
     state["clock"].advance(0.3)
     manager = state["manager"]
     manager.loops["interruption"].enqueue("sweep")
     for node in state["cluster"].list_nodes():
+        if not node.ready:
+            # Kubelet heartbeat: a joining node reports Ready so the
+            # Readiness reconciler strips the not-ready taint — the
+            # node-ready lifecycle phase the SLO gate asserts publishes.
+            node.ready = True
+            node.status_reported_at = state["clock"].now()
+            try:
+                state["cluster"].update_node(node)
+            except (ApiError, TransportError):
+                node.ready = False  # storm ate the heartbeat; retry next beat
         manager.loops["node"].enqueue(node.name)
         manager.loops["termination"].enqueue(node.name)
     for pod in state["cluster"].list_pods():
@@ -440,6 +464,56 @@ def assert_no_leaks_after_grace(state):
     assert not leaked, f"leaked instances after GC grace: {sorted(leaked)}"
 
 
+def assert_slo_pipeline(state, injected) -> float:
+    """The observability acceptance gate: every lifecycle phase published
+    per-phase quantiles, the end-to-end p99 pending time flowed through the
+    SLO evaluator without a breach, and the flight recorder is provably
+    gap-free (dropped == 0 ⇒ every event ever recorded is in the dump —
+    including one per injected fault)."""
+    from karpenter_tpu.utils.obs import (
+        OBS,
+        PHASES,
+        POD_PENDING_SECONDS,
+        POD_PHASE_SECONDS,
+        RECORDER,
+    )
+
+    snapshot = OBS.slo_snapshot()
+    for phase in PHASES:
+        assert POD_PHASE_SECONDS.count(phase) > 0, (
+            f"lifecycle phase {phase!r} never published a sample"
+        )
+        p = snapshot["phases"][phase]
+        print(
+            f"  phase {phase:<20s} n={POD_PHASE_SECONDS.count(phase):<5d} "
+            f"window p50={p['p50']:.3f}s p99={p['p99']:.3f}s"
+        )
+    assert POD_PENDING_SECONDS.count() > 0, "no end-to-end pending samples"
+    p99 = snapshot["pending"]["p99"]
+    print(
+        f"  pending: n={POD_PENDING_SECONDS.count()} window "
+        f"p50={snapshot['pending']['p50']:.3f}s p99={p99:.3f}s "
+        f"(target {SLO_PENDING_P99_S}s) ttfl p99={snapshot['ttfl']['p99']:.3f}s"
+    )
+    assert OBS.evaluator.breaches == {}, (
+        f"SLO breached under the storm: {OBS.evaluator.breaches} "
+        f"(pending p99 {p99:.1f}s vs target {SLO_PENDING_P99_S}s)"
+    )
+    flight = RECORDER.snapshot()
+    assert flight["dropped"] == 0, (
+        f"flight recorder dropped {flight['dropped']} events — the dump has "
+        "unexplained gaps"
+    )
+    seqs = [e["seq"] for e in flight["events"]]
+    assert seqs == list(range(1, flight["seq"] + 1)), "seq gap in the ring"
+    assert RECORDER.count("fault") >= min(injected, MIN_INJECTED), (
+        "injected faults missing from the flight recorder"
+    )
+    assert RECORDER.count("retry") > 0, "envelope retries never flight-recorded"
+    assert RECORDER.count("launch") > 0, "launch decisions never flight-recorded"
+    return p99
+
+
 def settle_and_verify(state, pods, crashes, interrupted):
     from karpenter_tpu.utils import faultpoints
 
@@ -462,8 +536,9 @@ def settle_and_verify(state, pods, crashes, interrupted):
     assert state["oracle"].violations == [], (
         f"PDB dipped below minAvailable: {state['oracle'].violations}"
     )
+    pending_p99 = assert_slo_pipeline(state, injected)
     assert_no_leaks_after_grace(state)
-    return retries, injected
+    return retries, injected, pending_p99
 
 
 
@@ -486,7 +561,7 @@ def main() -> int:
         arm_fault_storm()
         crashes, interrupted, extras = storm(state, pods)
         assert crashes >= 2, f"needed >=2 mid-storm crashes, got {crashes}"
-        retries, injected = settle_and_verify(
+        retries, injected, pending_p99 = settle_and_verify(
             state, pods + extras, crashes, interrupted
         )
     except AssertionError as failure:
@@ -496,8 +571,9 @@ def main() -> int:
         f"chaos-smoke: OK in {time.time() - began:.1f}s "
         f"({len(interrupted)} reclaims through {injected} injected API "
         f"faults, {retries} envelope retries, {crashes} mid-storm "
-        "crash+restarts; 0 PDB violations, 0 leaked instances, all sweep "
-        "loops alive)"
+        f"crash+restarts; 0 PDB violations, 0 leaked instances, all sweep "
+        f"loops alive; pending p99 {pending_p99:.1f}s inside the "
+        f"{SLO_PENDING_P99_S:.0f}s SLO, flight recorder gap-free)"
     )
     return 0
 

@@ -50,7 +50,6 @@ ALLOWED = {
     "karpenter_tpu/models/solver.py::cost_solve_finish": 16,
     "karpenter_tpu/ops/encode.py::build_fleet": 24,
     "karpenter_tpu/ops/mix_pack.py::mix_candidate": 23,
-    "karpenter_tpu/solver_service/server.py::_Handler.solve_stream": 21,
 }
 
 

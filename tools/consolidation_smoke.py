@@ -26,6 +26,11 @@ sys.path.insert(0, REPO)
 NODES = 6
 PODS_PER_NODE = 4
 GUARDED = 3  # pods behind a PDB that forces the drain to roll
+# SLO gate (fake seconds): the churn storm advances ~30 fake seconds end to
+# end, so a rolling p99 pending time beyond this ceiling is a scheduling
+# regression, not noise. The target arms the SloEvaluator's breach
+# machinery; the gate asserts ZERO breach episodes fired.
+SLO_PENDING_P99_S = 60.0
 
 
 def build():
@@ -36,12 +41,19 @@ def build():
     )
     from karpenter_tpu.controllers.cluster import Cluster
     from karpenter_tpu.utils.clock import FakeClock
+    from karpenter_tpu.utils.obs import OBS, RECORDER
 
     clock = FakeClock()
     cluster = Cluster(clock=clock)
     cloud = FakeCloudProvider(
         instance_types=consolidation_instance_types(), clock=clock
     )
+    # The pod-latency SLO pipeline, wired the way Manager does it: the
+    # tracker rides the store's watch-delta feed; the evaluator's armed
+    # target turns any pending-time blowout into a counted breach.
+    OBS.configure(clock=clock, slo_pending_p99=SLO_PENDING_P99_S)
+    RECORDER.configure(clock=clock)
+    OBS.attach(cluster)
     state = {"clock": clock, "cluster": cluster, "cloud": cloud}
     restart(state)
     cluster.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
@@ -255,6 +267,35 @@ def settle_and_verify(state, survivors, cost_before, actions) -> None:
     return cost_after
 
 
+def assert_slo_pipeline() -> float:
+    """The observability gate: displaced pods' pending times flowed through
+    the SLO evaluator inside the target with ZERO breach episodes, the
+    flight recorder captured every consolidation decision and drain, and
+    the record is provably gap-free (dropped == 0 ⇒ the dump holds every
+    event ever recorded)."""
+    from karpenter_tpu.utils.obs import OBS, POD_PENDING_SECONDS, RECORDER
+
+    snapshot = OBS.slo_snapshot()
+    assert POD_PENDING_SECONDS.count() > 0, "no end-to-end pending samples"
+    p99 = snapshot["pending"]["p99"]
+    assert OBS.evaluator.breaches == {}, (
+        f"SLO breached under the churn storm: {OBS.evaluator.breaches} "
+        f"(pending p99 {p99:.1f}s vs target {SLO_PENDING_P99_S}s)"
+    )
+    flight = RECORDER.snapshot()
+    assert flight["dropped"] == 0, (
+        f"flight recorder dropped {flight['dropped']} events — the dump has "
+        "unexplained gaps"
+    )
+    seqs = [e["seq"] for e in flight["events"]]
+    assert seqs == list(range(1, flight["seq"] + 1)), "seq gap in the ring"
+    assert RECORDER.count("consolidate") > 0, (
+        "consolidation decisions never flight-recorded"
+    )
+    assert RECORDER.count("drain") > 0, "drains never flight-recorded"
+    return p99
+
+
 def main() -> int:
     began = time.time()
     try:
@@ -272,6 +313,7 @@ def main() -> int:
         )
         crashes, actions = storm(state)
         cost_after = settle_and_verify(state, survivors, cost_before, actions)
+        pending_p99 = assert_slo_pipeline()
         assert oracle.violations == [], (
             f"PDB violations during the storm: {oracle.violations}"
         )
@@ -284,7 +326,9 @@ def main() -> int:
         f"consolidation-smoke: OK in {time.time() - began:.1f}s "
         f"(cost ${cost_before:.2f} -> ${cost_after:.2f}/hr over "
         f"{int(actions)} actions, {crashes} mid-storm crash+restarts, "
-        "0 PDB violations, 0 leaked instances)"
+        f"0 PDB violations, 0 leaked instances; pending p99 "
+        f"{pending_p99:.1f}s inside the {SLO_PENDING_P99_S:.0f}s SLO, "
+        "flight recorder gap-free)"
     )
     return 0
 

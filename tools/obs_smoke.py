@@ -1,0 +1,290 @@
+"""Observability smoke (`make obs-smoke`): the pod-latency SLO pipeline
+proven end to end, three legs:
+
+1. TRACKER PARITY — the lifecycle tracker's end-to-end pending samples are
+   checked pod-by-pod against an independent watch-oracle that records
+   first-provisionable-seen and bind timestamps straight off the store's
+   verb-level delta feed. The tracker anchors on creationTimestamp and the
+   oracle on its own wall reads of the same FakeClock, so every sample must
+   match EXACTLY — any drift means a phase stamp landed on the wrong clock
+   or the re-anchor logic charged dishonest time.
+
+2. BREACH → DUMP ROUND TRIP — tightening the pending-p99 target below the
+   observed quantile must count a breach episode, and the triggered
+   flight-recorder dump (KARPENTER_FLIGHT_DIR) must be a gap-free JSON
+   record naming the breaching pods and each one's slowest phase.
+
+3. STITCHED TRACE — a pipelined sidecar solve (real gRPC SolverServer +
+   RemoteSolver) run under one minted trace id exports a single Chrome
+   trace containing the host span, the RPC span, and the sidecar serve
+   spans all carrying that id, with wall-clock-anchored timestamps and
+   process/thread metadata events — the cross-process stitching contract
+   docs/design/observability.md specifies.
+
+Runs on the fake provider + fake clock; the only wall time is the gRPC
+round trips. `make obs-smoke` wraps this in a hard timeout.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("KARPENTER_TRACE", "1")  # before any karpenter import
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+WAVES = 3
+PODS_PER_WAVE = 6
+# Leg 2's tightened target: far below the fake-seconds pending times the
+# waves accrue, so the forced evaluation MUST breach.
+TIGHT_PENDING_P99_S = 0.001
+
+
+class WatchOracle:
+    """Independent truth for pod latency: first-provisionable-seen and
+    bind timestamps recorded straight off the store's verb-level feed,
+    sharing nothing with the tracker but the clock."""
+
+    def __init__(self, cluster, clock):
+        self.clock = clock
+        self.first = {}
+        self.bound = {}
+        cluster.watch_deltas(self._on)
+
+    def _on(self, verb, kind, obj) -> None:
+        if kind != "pod":
+            return
+        now = self.clock.now()
+        if verb == "bind":
+            self.bound.setdefault(obj.uid, now)
+        elif obj.node_name is None and obj.is_provisionable():
+            self.first.setdefault(obj.uid, now)
+
+
+def build():
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.cluster import Cluster
+    from karpenter_tpu.controllers.node import NodeController
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.selection import SelectionController
+    from karpenter_tpu.utils.clock import FakeClock
+    from karpenter_tpu.utils.obs import OBS, RECORDER
+
+    clock = FakeClock()
+    cluster = Cluster(clock=clock)
+    cloud = FakeCloudProvider(clock=clock)
+    OBS.reset()
+    RECORDER.clear()
+    OBS.configure(clock=clock, slo_pending_p99=0.0, slo_ttfl=0.0)
+    RECORDER.configure(clock=clock)
+    OBS.attach(cluster)
+    oracle = WatchOracle(cluster, clock)
+    state = {
+        "clock": clock,
+        "cluster": cluster,
+        "cloud": cloud,
+        "oracle": oracle,
+    }
+    state["provisioning"] = ProvisioningController(cluster, cloud, None)
+    state["selection"] = SelectionController(cluster, state["provisioning"])
+    state["node"] = NodeController(cluster)
+    cluster.apply_provisioner(
+        Provisioner(name="default", spec=ProvisionerSpec())
+    )
+    state["provisioning"].reconcile("default")
+    return state
+
+
+def run_waves(state) -> None:
+    """Three arrival waves with distinct dwell times: apply, let pending
+    time accrue on the fake clock, provision (bind), then a kubelet
+    heartbeat so the node-ready phase stamps too."""
+    from tests import fixtures
+
+    for wave in range(WAVES):
+        for i in range(PODS_PER_WAVE):
+            pod = fixtures.pod(cpu="2", name=f"obs-{wave}-{i}")
+            state["cluster"].apply_pod(pod)
+            state["selection"].reconcile(pod.namespace, pod.name)
+        state["clock"].advance(0.7 + 0.4 * wave)  # pending time accrues
+        for worker in list(state["provisioning"].workers.values()):
+            worker.provision()
+        state["clock"].advance(0.5)  # kubelet join time -> node-ready phase
+        for node in list(state["cluster"].list_nodes()):
+            if not node.ready:
+                node.ready = True
+                node.status_reported_at = state["clock"].now()
+                state["cluster"].update_node(node)
+            state["node"].reconcile(node.name)
+
+
+def assert_tracker_parity(state) -> int:
+    """Every bound pod's tracker pending sample == the oracle's
+    bind-seen minus first-seen, exactly."""
+    from karpenter_tpu.utils.obs import OBS, PHASES, POD_PHASE_SECONDS
+
+    oracle = state["oracle"]
+    expected = {
+        uid: oracle.bound[uid] - oracle.first[uid] for uid in oracle.bound
+    }
+    assert len(expected) == WAVES * PODS_PER_WAVE, (
+        f"oracle saw {len(expected)} binds, expected {WAVES * PODS_PER_WAVE}"
+    )
+    samples = {
+        uid: seconds for (_, seconds, uid, _) in OBS.evaluator._pending
+    }
+    missing = set(expected) - set(samples)
+    assert not missing, f"tracker missed pending samples for {missing}"
+    extras = set(samples) - set(expected)
+    assert not extras, f"tracker invented pending samples for {extras}"
+    for uid, want in expected.items():
+        got = samples[uid]
+        assert abs(got - want) < 1e-6, (
+            f"pending mismatch for {uid}: tracker {got:.6f}s vs "
+            f"watch-oracle {want:.6f}s"
+        )
+    for phase in PHASES:
+        assert POD_PHASE_SECONDS.count(phase) > 0, (
+            f"lifecycle phase {phase!r} never published a sample"
+        )
+    return len(expected)
+
+
+def assert_breach_round_trip(state, flight_dir) -> None:
+    """Tighten the target below the observed quantile; the forced
+    evaluation must count a breach and drop a gap-free dump naming the
+    breaching pods and their slowest phase."""
+    from karpenter_tpu.utils.obs import OBS, SLO_BREACHES_TOTAL
+
+    OBS.configure(slo_pending_p99=TIGHT_PENDING_P99_S)
+    before = SLO_BREACHES_TOTAL.get("pending-p99")
+    snapshot = OBS.evaluator.evaluate(force=True)
+    assert snapshot["pending"]["p99"] > TIGHT_PENDING_P99_S
+    assert OBS.evaluator.breaches.get("pending-p99", 0) >= 1, (
+        "tightened target did not count a breach episode"
+    )
+    assert SLO_BREACHES_TOTAL.get("pending-p99") == before + 1
+    dumps = [f for f in os.listdir(flight_dir) if "slo-pending-p99" in f]
+    assert dumps, f"breach produced no flight-recorder dump in {flight_dir}"
+    with open(os.path.join(flight_dir, dumps[0])) as f:
+        record = json.load(f)
+    assert record["dropped"] == 0, "breach dump has unexplained gaps"
+    seqs = [e["seq"] for e in record["events"]]
+    assert seqs == sorted(seqs), "breach dump events out of seq order"
+    breaches = [e for e in record["events"] if e["kind"] == "slo-breach"]
+    assert breaches, "breach dump does not contain the slo-breach event"
+    check_offenders(breaches[-1]["offenders"], set(state["oracle"].bound))
+
+
+def check_offenders(offenders, known) -> None:
+    """The breach event must name real pods and attribute a known phase."""
+    from karpenter_tpu.utils.obs import PHASES
+
+    assert offenders, "breach event names no offending pods"
+    for offender in offenders:
+        assert offender["pod_uid"] in known, (
+            f"breach named unknown pod {offender['pod_uid']}"
+        )
+        assert offender["slowest_phase"] in PHASES, (
+            f"breach offender carries bogus slowest phase: {offender}"
+        )
+
+
+def solve_pipelined_under_trace(trace_id) -> None:
+    """One real pipelined sidecar solve (gRPC SolverServer + RemoteSolver)
+    run inside the minted trace context — the host span, the RPC span, and
+    the sidecar serve spans all land in TRACER."""
+    from karpenter_tpu.api.provisioner import Constraints
+    from karpenter_tpu.solver_service.client import RemoteSolver
+    from karpenter_tpu.solver_service.server import SolverServer
+    from karpenter_tpu.utils.tracing import TRACER
+    from tests import fixtures
+
+    problems = [
+        (fixtures.pods(6), fixtures.size_ladder(3), Constraints(), ())
+        for _ in range(3)
+    ]
+    server = SolverServer(port=0).start(warmup=False)
+    try:
+        remote = RemoteSolver(f"127.0.0.1:{server.port}")
+        with TRACER.trace(trace_id), TRACER.span("provision.solve"):
+            results = list(remote.solve_many_pipelined(problems))
+        remote.close()
+    finally:
+        server.stop()
+    assert len(results) == 3 and all(r is not None for r in results)
+
+
+def check_span_lanes(doc, spans) -> None:
+    """Every span lane must be labeled by process/thread metadata events."""
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in meta)
+    named_tids = {e["tid"] for e in meta if e["name"] == "thread_name"}
+    assert {e["tid"] for e in spans} <= named_tids, (
+        "some span lanes have no thread_name metadata event"
+    )
+
+
+def assert_stitched_trace(tmp_dir) -> dict:
+    """A pipelined sidecar solve under one minted trace id must export a
+    single Chrome trace whose host, RPC, and serve spans all carry that id,
+    wall-clock anchored, with process/thread metadata lanes."""
+    from karpenter_tpu.utils import tracing
+    from karpenter_tpu.utils.tracing import TRACER
+
+    assert TRACER.enabled, "KARPENTER_TRACE did not enable the tracer"
+    trace_id = tracing.new_trace_id()
+    solve_pipelined_under_trace(trace_id)
+
+    path = TRACER.flush(os.path.join(tmp_dir, "stitched-trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    in_trace = {
+        e["name"] for e in spans if e["args"].get("trace") == trace_id
+    }
+    for required in ("provision.solve", "solver.rpc.stream", "solver.serve"):
+        assert required in in_trace, (
+            f"span {required!r} missing from trace {trace_id}: the export "
+            f"only stitched {sorted(in_trace)}"
+        )
+    # Wall-clock anchoring: a `ts` is epoch microseconds, so it must land
+    # within this process's lifetime — raw perf_counter values (the old
+    # export) sit near zero and fail this by ~56 years.
+    host = next(e for e in spans if e["name"] == "provision.solve")
+    assert abs(host["ts"] / 1e6 - time.time()) < 600, (
+        "span timestamps are not wall-clock anchored"
+    )
+    assert doc["metadata"]["clock_epoch_offset_s"] > 0
+    check_span_lanes(doc, spans)
+    return {"trace": trace_id, "spans": len(spans)}
+
+
+def main() -> int:
+    began = time.time()
+    flight_dir = tempfile.mkdtemp(prefix="obs-smoke-flight-")
+    os.environ["KARPENTER_FLIGHT_DIR"] = flight_dir
+    try:
+        state = build()
+        run_waves(state)
+        bound = assert_tracker_parity(state)
+        assert_breach_round_trip(state, flight_dir)
+        stitched = assert_stitched_trace(flight_dir)
+    except AssertionError as failure:
+        print(f"obs-smoke: FAIL in {time.time() - began:.1f}s: {failure}")
+        return 1
+    print(
+        f"obs-smoke: OK in {time.time() - began:.1f}s "
+        f"({bound} pods tracker==watch-oracle exact, breach -> gap-free "
+        f"dump naming offenders + slowest phase, {stitched['spans']} spans "
+        f"stitched under trace {stitched['trace']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

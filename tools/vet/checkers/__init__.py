@@ -8,6 +8,7 @@ from tools.vet.checkers import (
     fetch,
     locks,
     metricsuse,
+    spanuse,
     transport,
 )
 
@@ -16,6 +17,7 @@ ALL_CHECKERS = (
     *crash.CHECKERS,
     *clocks.CHECKERS,
     *metricsuse.CHECKERS,
+    *spanuse.CHECKERS,
     *backend.CHECKERS,
     *fetch.CHECKERS,
     *transport.CHECKERS,

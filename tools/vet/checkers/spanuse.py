@@ -1,0 +1,105 @@
+"""span-consistency: every ``TRACER.span("name")`` literal appears in the
+module-level ``SPAN_NAMES`` inventory (utils/tracing.py) — the tracing
+analogue of the metrics one-home discipline.
+
+Span names are query keys: trace viewers, the obs smoke, and the tests all
+select spans by name, so a renamed or ad-hoc span silently orphans whatever
+asserted on the old one. The inventory is the single declaration home;
+``unknown `TRACER.span(...)` literals`` are findings. Dynamic names
+(non-constant first arg) are skipped — arity unknowable statically, same
+rule as metrics-consistency's ``*splat`` skip. Only calls on a receiver
+named ``TRACER`` (or ``*_TRACER``) are matched: the process-wide tracer is
+the one the inventory governs; harness-local tracers in tests drive
+whatever names they like.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.vet.framework import Checker, Finding, Module, walk_with_qualname
+
+NAME = "span-consistency"
+
+INVENTORY_VAR = "SPAN_NAMES"
+
+
+def _inventory(modules: List[Module]) -> Optional[Set[str]]:
+    """The module-level SPAN_NAMES tuple from utils/tracing.py when that
+    module is in scope (the full-tree scan always has it) — a local
+    SPAN_NAMES anywhere else must NOT count, or any file could
+    self-whitelist its ad-hoc spans. Scratch/explicit-path scans without
+    tracing.py fall back to the union of scanned declarations, so the
+    fixture files stay self-contained; None when nothing declares an
+    inventory (nothing to check against, so nothing to find)."""
+    canonical = [m for m in modules if m.rel.endswith("utils/tracing.py")]
+    names: Optional[Set[str]] = None
+    for module in canonical or modules:
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if INVENTORY_VAR not in targets:
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            names = names or set()
+            names.update(
+                element.value
+                for element in node.value.elts
+                if isinstance(element, ast.Constant)
+                and isinstance(element.value, str)
+            )
+    return names
+
+
+def _span_literal(node: ast.Call) -> Optional[str]:
+    """The span-name literal of a checkable TRACER.span("...") call."""
+    func = node.func
+    if not (
+        isinstance(func, ast.Attribute)
+        and func.attr == "span"
+        and isinstance(func.value, ast.Name)
+        and func.value.id.endswith("TRACER")
+    ):
+        return None
+    if not node.args:
+        return None
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return first.value
+    return None
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    inventory = _inventory(modules)
+    if inventory is None:
+        return []
+    findings: List[Finding] = []
+    for module in modules:
+        for node, qual in walk_with_qualname(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _span_literal(node)
+            if name is None or name in inventory:
+                continue
+            findings.append(
+                Finding(
+                    checker=NAME,
+                    file=module.rel,
+                    line=node.lineno,
+                    key=f"unknown-span:{name}@{qual or '<module>'}",
+                    message=(
+                        f"span name {name!r} is not in the SPAN_NAMES "
+                        "inventory (utils/tracing.py) — declare it there so "
+                        "trace queries and dashboards can't drift"
+                    ),
+                )
+            )
+    return findings
+
+
+CHECKERS = (Checker(NAME, _check),)
