@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -125,6 +125,20 @@ constraints-smoke:
 obs-smoke:
 	timeout -k 10 120 python tools/obs_smoke.py
 
+# The market capstone (tools/market_smoke.py): the compound market storm —
+# a scripted price spike on every occupied pool (folded through the live
+# MarketFeed into a reprice that invalidates the solver caches and requeues
+# the cost controllers) racing a spot-interruption storm AND an API fault
+# storm (plus market.feed stale/reorder/blackout chaos), with the controller
+# process killed and rebuilt twice mid-storm (market.mid-tick,
+# interruption.mid-drain). Asserts realized fleet cost within 1.1x of the
+# post-spike optimum from simulate_plan_cost, zero PDB violations
+# (server-side watch oracle), zero leaked instances after the GC grace, a
+# gap-free flight record carrying reprice events + generation-stamped
+# launches, and the p99 pending SLO held. Hard 180s timeout.
+market-smoke:
+	timeout -k 10 180 python tools/market_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -139,6 +153,7 @@ smoke:
 	$(MAKE) multichip-smoke || rc=1; \
 	$(MAKE) constraints-smoke || rc=1; \
 	$(MAKE) obs-smoke || rc=1; \
+	$(MAKE) market-smoke || rc=1; \
 	exit $$rc
 
 proto:

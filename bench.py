@@ -141,6 +141,150 @@ def bench_bind(num_pods=10_000, pods_per_node=100):
     return elapsed_ms
 
 
+def bench_market_dynamics(
+    solver, num_pods=2_000, num_types=25, num_zones=2, wave_types=5, seed=0
+):
+    """Live-market scenario (karpenter_tpu/market): a 50-pool regime-
+    switching feed drifts a spot market, a scripted interruption wave then
+    takes out every pool of the `wave_types` cheapest types, and the cell
+    compares FORECAST-AWARE packing (the PriceBook's hazard lowered into
+    the fused dispatch as a per-[T] penalty) against FORECAST-BLIND packing
+    (no active book) under that wave.
+
+    Realized accounting: every node pays its allocated pool's spot price;
+    a node allocated onto a wave pool additionally pays its REPLACEMENT
+    (re-allocated with the wave excluded) — the re-buy an interruption
+    forces. cost_ratio_forecast = aware/blind; < 1 means the forecast's
+    advertised premium bought more than it cost, BEFORE any pool actually
+    interrupted."""
+    from karpenter_tpu.api.pods import PodSpec
+    from karpenter_tpu.api.provisioner import Constraints
+    from karpenter_tpu.cloudprovider import InstanceType, Offering
+    from karpenter_tpu.cloudprovider.market import allocate, plan_offers
+    from karpenter_tpu.market.feed import MarketFeed, MarketTick, TICK_PRICE
+    from karpenter_tpu.market.pricebook import PriceBook, set_active_book
+    from karpenter_tpu.utils.clock import FakeClock
+
+    zones = [f"mz-{i}" for i in range(num_zones)]
+    catalog = [
+        InstanceType(
+            name=f"mkt-{i}.xlarge",
+            capacity={"cpu": 16, "memory": "64Gi", "pods": 110},
+            architecture="amd64",
+            offerings=[
+                Offering(zone=z, capacity_type=ct, price=p)
+                for z in zones
+                for ct, p in (
+                    ("on-demand", 0.40 + 0.01 * i),
+                    ("spot", (0.40 + 0.01 * i) * 0.6),
+                )
+            ],
+        )
+        for i in range(num_types)
+    ]
+    pods = [
+        PodSpec(name=f"mkt-pod-{i}", requests={"cpu": 2.0, "memory": 4 * 2**30})
+        for i in range(num_pods)
+    ]
+    constraints = Constraints()
+
+    # Drift the 50-pool market through the regime-switching walk, folded
+    # into a PriceBook exactly as the market sweep would.
+    feed = MarketFeed(
+        [(it.name, z) for it in catalog for z in zones], seed=seed
+    )
+    feed.advance(30.0)
+    clock = FakeClock()
+    book = PriceBook(clock=clock)
+    for tick in feed.ticks_after(0):
+        book.apply(tick)
+
+    # The scripted interruption wave: every pool of the cheapest types. Six
+    # depth-decline ticks per pool feed the hazard's trend leg, and one
+    # observed interruption per pool feeds its event leg — the "pool being
+    # bought out from under you" signature the forecast exists to catch.
+    wave_pools = [
+        (it.name, z) for it in catalog[:wave_types] for z in zones
+    ]
+    seq = feed.last_seq
+    for pool in wave_pools:
+        depth = 1.0
+        for _ in range(6):
+            seq += 1
+            depth *= 0.6
+            book.apply(
+                MarketTick(
+                    seq=seq, kind=TICK_PRICE,
+                    instance_type=pool[0], zone=pool[1],
+                    discount=book.spot_discount(pool) or 0.5, depth=depth,
+                )
+            )
+        book.note_interruption(pool)
+    market = book.market()
+    wave = set(wave_pools)
+
+    # A replacement for an interrupted node re-solves against the FULL
+    # catalog (the plan's own option rows may sit entirely inside the
+    # wave's price band): its floor is the cheapest surviving spot pool.
+    od_price = {
+        (it.name, z): o.price
+        for it in catalog
+        for z in zones
+        for o in it.offerings
+        if o.zone == z and o.capacity_type == "on-demand"
+    }
+    survivor_floor = min(
+        market.spot_price(pool, od)
+        for pool, od in od_price.items()
+        if pool not in wave
+    )
+
+    def realized(result) -> tuple:
+        total, interrupted_nodes = 0.0, 0
+        for packing in result.packings:
+            offers = plan_offers(packing, zones, "spot", market)
+            chosen = allocate(offers, "spot", market)
+            if chosen is None:
+                total += packing.node_quantity * survivor_floor
+                continue
+            total += packing.node_quantity * chosen.price
+            if (chosen.instance_type, chosen.zone) in wave:
+                # The wave lands: every node on a wave pool re-buys from
+                # the surviving pools (the interruption's churn cost).
+                interrupted_nodes += packing.node_quantity
+                replacement = allocate(offers, "spot", market, excluded=wave)
+                replacement_price = (
+                    replacement.price
+                    if replacement is not None
+                    else survivor_floor
+                )
+                total += packing.node_quantity * replacement_price
+        return total, interrupted_nodes
+
+    set_active_book(None)
+    blind = solver.solve(pods, catalog, constraints)
+    blind_cost, blind_interrupted = realized(blind)
+    set_active_book(book)
+    try:
+        aware = solver.solve(pods, catalog, constraints)
+    finally:
+        set_active_book(None)
+    aware_cost, aware_interrupted = realized(aware)
+    return {
+        "pools": num_types * num_zones,
+        "wave_pools": len(wave_pools),
+        "cost_forecast_blind": round(blind_cost, 4),
+        "cost_forecast_aware": round(aware_cost, 4),
+        # The acceptance cell: < 1 = forecast-aware packing strictly
+        # cheaper than forecast-blind under the scripted wave.
+        "cost_ratio_forecast": round(aware_cost / blind_cost, 4)
+        if blind_cost
+        else 1.0,
+        "interrupted_nodes_blind": blind_interrupted,
+        "interrupted_nodes_aware": aware_interrupted,
+    }
+
+
 def bench_consolidation_churn(nodes=12, pods_per_node=4, seed=0):
     """Steady-state churn scenario for the consolidation subsystem: scale a
     fleet up on the fake provider, churn most of the workload away (the
@@ -1149,6 +1293,7 @@ def main():
     # cheapest advertised offering (assumes lowest-price allocation even for
     # spot).
     encode_incremental = bench_encode_incremental()
+    market_dynamics = bench_market_dynamics(solver)
     greedy_ideal = greedy_result.projected_cost()
     lowest_price_ratio = (
         cost_result.projected_cost() / greedy_ideal if greedy_ideal else 1.0
@@ -1217,6 +1362,12 @@ def main():
                 # the new subsystem recovers cost the reference's
                 # grow-only lifecycle leaves on the table.
                 "consolidation_churn": bench_consolidation_churn(),
+                # Live market (ISSUE 14): forecast-aware vs forecast-blind
+                # packing under a scripted interruption wave over a 50-pool
+                # regime-switching feed; cost_ratio_forecast < 1 = the
+                # hazard penalty's advertised premium bought more than it
+                # cost before any pool interrupted.
+                "market_dynamics": market_dynamics,
                 "cost_ratio": round(cost_ratio, 4),
                 "cost_ratio_per_seed": [round(r, 4) for r in ratios],
                 "cost_ratio_lowest_price": round(lowest_price_ratio, 4),
@@ -1260,6 +1411,9 @@ def main():
                 # same 50k-pod scale — the O(cluster)->O(churn) headline.
                 "encode_warm_ms": round(encode_warm_ms, 3),
                 "encode_delta_ms": encode_incremental["encode_delta_ms"],
+                # Forecast-aware vs forecast-blind under the scripted
+                # interruption wave (market_dynamics; < 1 = aware cheaper).
+                "market_cost_ratio": market_dynamics["cost_ratio_forecast"],
                 "backend": _backend_platform(),
                 "device_unavailable": device_unavailable,
             }

@@ -253,6 +253,22 @@ class CloudProvider(abc.ABC):
         reclaimed pool so replacement capacity re-solves AWAY from it (the
         same cache the ICE blackout feeds). Default: no-op."""
 
+    def poll_market_events(self, after_seq: int = 0) -> List:
+        """Spot-market ticks (karpenter_tpu.market.feed.MarketTick) with
+        seq > after_seq, strictly seq-ordered and REPLAYABLE from 0: a
+        restarted controller re-folds the whole history to reconstruct its
+        PriceBook (state AND generation) — there is no ack protocol; the
+        feed is the durable cursorless history, the way
+        DescribeSpotPriceHistory is on EC2. Providers without a market feed
+        return [] (the market controller is then inert for them)."""
+        return []
+
+    def attach_market(self, book) -> None:
+        """Give the provider the controller's PriceBook so ADVERTISED spot
+        offering prices track the live market (get_instance_types applies
+        the book's per-pool discount; ICE-closed pools drop their spot
+        offerings). Default: no-op — static catalogs stay static."""
+
     @abc.abstractmethod
     def get_instance_types(self, constraints: Optional[Constraints] = None) -> List[InstanceType]:
         ...

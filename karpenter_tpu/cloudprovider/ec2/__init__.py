@@ -18,6 +18,7 @@ from typing import Callable, List, Optional, Sequence
 
 import datetime
 import json
+import threading
 
 from karpenter_tpu.api.provisioner import Constraints, Provisioner
 from karpenter_tpu.cloudprovider import (
@@ -119,6 +120,20 @@ class Ec2CloudProvider(CloudProvider):
             self.clock,
         )
         self._fleet_limiter = RateLimiter(FLEET_QPS, FLEET_BURST, self.clock)
+        # Market tick numbering (poll_market_events): DescribeSpotPriceHistory
+        # is a SLIDING window, so a row's rank in any one poll is not a
+        # stable identity — old rows age out and renumber everything after
+        # them. Seqs are therefore assigned from this process-local counter
+        # as rows first cross each POOL's sort-key cursor (per-pool, so a
+        # late-published row for a quiet pool is not shadowed by a busier
+        # pool's newer cursor), and emitted ticks are retained (bounded —
+        # see _compact_market_history_locked) so a re-fold from seq 0
+        # replays the sequence. A restarted process starts both a fresh
+        # numbering and a fresh PriceBook, so the two can never disagree.
+        self._market_lock = threading.Lock()
+        self._market_seq = 0  # vet: guarded-by(self._market_lock)
+        self._market_cursors: dict = {}  # vet: guarded-by(self._market_lock)
+        self._market_history: List = []  # vet: guarded-by(self._market_lock)
 
     # --- CloudProvider interface ------------------------------------------
 
@@ -237,6 +252,101 @@ class Ec2CloudProvider(CloudProvider):
 
     def ack_interruption(self, event: InterruptionEvent) -> None:
         self.api.delete_queue_message(event.event_id)
+
+    def attach_market(self, book) -> None:
+        """Advertised spot offering prices track the controller's folded
+        market (instancetypes applies the book's discounts at get)."""
+        self.instance_types.attach_market(book)
+
+    # Retained-tick budget: past this the oldest half of the history
+    # collapses to its newest tick per pool (exactly the snapshot a
+    # from-0 re-fold needs) so a weeks-long controller doesn't hoard
+    # every price change ever seen.
+    MARKET_HISTORY_MAX = 50_000
+    # Safe market-sweep cadence when --market-poll-interval is left at
+    # auto: every poll is a paginated DescribeSpotPriceHistory, so 1 Hz
+    # (the in-memory fake's cadence) would burn ~86k calls/day against
+    # the API throttle shared with fleet/catalog calls.
+    MARKET_POLL_DEFAULT_S = 15.0
+
+    def poll_market_events(self, after_seq: int = 0) -> List:
+        """DescribeSpotPriceHistory rows as a strictly-ordered, replayable
+        tick stream. Rows sort on (timestamp, type, zone, price) — a total
+        deterministic order — and each row is assigned a seq from a
+        process-local counter the first time it crosses its POOL's sort-key
+        cursor, then retained: seqs stay stable when the API's sliding
+        window drops old rows, a late-published row for one pool is never
+        shadowed by another pool's newer rows (eventual consistency), and a
+        re-fold from seq 0 replays the in-process sequence (see __init__).
+        A row at or below its own pool's cursor is stale information by
+        construction (the book only folds forward) and is dropped.
+        Discounts derive from the offering catalog's on-demand prices;
+        rows for unknown pools are skipped (no anchor = no discount)."""
+        from karpenter_tpu.market.feed import TICK_PRICE, MarketTick
+
+        rows = sorted(
+            self.api.describe_spot_price_history(),
+            key=lambda r: (r.timestamp, r.instance_type, r.zone, r.price),
+        )
+        od_prices = self.instance_types.on_demand_prices()
+        with self._market_lock:
+            for row in rows:
+                pool = (row.instance_type, row.zone)
+                key = (row.timestamp, row.price)
+                cursor = self._market_cursors.get(pool)
+                if cursor is not None and key <= cursor:
+                    continue
+                self._market_cursors[pool] = key
+                od = od_prices.get(pool, 0.0)
+                if od <= 0:
+                    continue
+                self._market_seq += 1
+                discount = row.price / od
+                self._market_history.append(
+                    MarketTick(
+                        seq=self._market_seq,
+                        kind=TICK_PRICE,
+                        instance_type=row.instance_type,
+                        zone=row.zone,
+                        discount=discount,
+                        # EC2 never reveals pool depth, but the forecast's
+                        # trend leg is computed from depth deltas — so proxy
+                        # it as 1/discount (spot price climbing toward
+                        # on-demand = the pool draining), the same inverse
+                        # price/depth coupling the simulated walk produces.
+                        # A sustained price climb then raises hazard BEFORE
+                        # any interruption lands, on the real backend too.
+                        depth=1.0 / discount,
+                        at=row.timestamp,
+                    )
+                )
+            if len(self._market_history) > self.MARKET_HISTORY_MAX:
+                self._compact_market_history_locked()
+            # Ordered by seq but not necessarily dense after compaction.
+            return [t for t in self._market_history if t.seq > after_seq]
+
+    def _compact_market_history_locked(self) -> None:
+        """Bound the replay history: the oldest half collapses to its
+        newest tick per pool — the snapshot a from-0 re-fold needs to
+        anchor quiet pools — and pools superseded in the kept tail drop
+        out entirely. Seqs are preserved (the fold keys on them), so the
+        stream stays strictly ordered, just no longer dense."""
+        half = len(self._market_history) // 2
+        prefix, tail = (
+            self._market_history[:half],
+            self._market_history[half:],
+        )
+        newest_by_pool = {tick.pool: tick for tick in prefix}
+        tail_pools = {tick.pool for tick in tail}
+        snapshot = sorted(
+            (
+                tick
+                for pool, tick in newest_by_pool.items()
+                if pool not in tail_pools
+            ),
+            key=lambda tick: tick.seq,
+        )
+        self._market_history = snapshot + tail
 
     def blackout_offering(
         self, instance_type: str, zone: str, capacity_type: str

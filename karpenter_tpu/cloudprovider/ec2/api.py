@@ -216,6 +216,19 @@ class QueueMessage:
 
 
 @dataclass(frozen=True)
+class SpotPrice:
+    """One DescribeSpotPriceHistory row: the spot $/hr one pool advertised
+    at `timestamp` (epoch seconds). The market feed sorts rows into a
+    strictly-ordered tick stream — the poll IS the replayable history, so
+    the controller's PriceBook can always re-fold from zero."""
+
+    instance_type: str
+    zone: str
+    price: float
+    timestamp: float = 0.0
+
+
+@dataclass(frozen=True)
 class Instance:
     """Ref: ec2.Instance fields read by instanceToNode (instance.go:232-268).
     `tags` and `launched_at` (epoch seconds, 0.0 = unknown) feed the
@@ -301,6 +314,12 @@ class Ec2Api(abc.ABC):
     def delete_queue_message(self, receipt_handle: str) -> None:
         """Ack one received message (SQS DeleteMessage). Deleting an unknown
         or already-deleted handle is success."""
+
+    def describe_spot_price_history(self) -> List[SpotPrice]:
+        """Spot price history for this account's pools (EC2
+        DescribeSpotPriceHistory), oldest-first is NOT guaranteed — callers
+        sort. Default: no spot-price feed, the market controller is inert."""
+        return []
 
 
 def match_tags(tags: Mapping[str, str], filters: Mapping[str, str]) -> bool:

@@ -47,6 +47,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
     LaunchTemplate,
     QueueMessage,
     SecurityGroup,
+    SpotPrice,
     Subnet,
 )
 
@@ -615,6 +616,36 @@ class AwsHttpEc2Api(Ec2Api):
                     )
                 )
         return offerings
+
+    def describe_spot_price_history(self) -> List[SpotPrice]:
+        """DescribeSpotPriceHistory over the signed Query API with the
+        shared retry envelope — the polling leg of the live market feed
+        (karpenter_tpu/market): rows become a replayable tick stream in
+        Ec2CloudProvider.poll_market_events."""
+        items = self._ec2_paginated(
+            "DescribeSpotPriceHistory",
+            {"ProductDescription.1": "Linux/UNIX", "MaxResults": "1000"},
+            "spotPriceHistorySet/item",
+        )
+        rows: List[SpotPrice] = []
+        for item in items:
+            name = _text(item, "instanceType")
+            zone = _text(item, "availabilityZone")
+            try:
+                price = float(_text(item, "spotPrice") or "0")
+            except ValueError:
+                continue  # a malformed row must not poison the whole poll
+            if not name or not zone or price <= 0:
+                continue
+            rows.append(
+                SpotPrice(
+                    instance_type=name,
+                    zone=zone,
+                    price=price,
+                    timestamp=_parse_launch_time(_text(item, "timestamp")),
+                )
+            )
+        return rows
 
     @staticmethod
     def _filter_params(filters: Mapping[str, str]) -> Dict[str, str]:

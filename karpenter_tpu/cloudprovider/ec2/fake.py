@@ -30,6 +30,7 @@ from karpenter_tpu.cloudprovider.ec2.api import (
     LaunchTemplate,
     QueueMessage,
     SecurityGroup,
+    SpotPrice,
     Subnet,
     match_tags,
 )
@@ -161,6 +162,10 @@ class FakeEc2(Ec2Api):
         # until deleted (the SQS visibility model, so record-then-ack crash
         # consistency is testable against this fake too).
         self.interruption_messages: Dict[str, QueueMessage] = {}
+        # Injectable spot-price history (DescribeSpotPriceHistory rows):
+        # append-only, re-served in full on every poll — the replayable
+        # cursorless history the market controller re-folds after a restart.
+        self.spot_price_history: List[SpotPrice] = []
         self.calls: Dict[str, List] = {
             "create_fleet": [],
             "create_launch_template": [],
@@ -192,6 +197,22 @@ class FakeEc2(Ec2Api):
                         )
                     )
         return offerings
+
+    def inject_spot_price(
+        self, instance_type: str, zone: str, price: float, timestamp: float = 0.0
+    ) -> SpotPrice:
+        """Test hook: append one DescribeSpotPriceHistory row."""
+        row = SpotPrice(
+            instance_type=instance_type,
+            zone=zone,
+            price=price,
+            timestamp=timestamp,
+        )
+        self.spot_price_history.append(row)
+        return row
+
+    def describe_spot_price_history(self) -> List[SpotPrice]:
+        return list(self.spot_price_history)
 
     def describe_subnets(self, filters: Mapping[str, str]) -> List[Subnet]:
         return [s for s in self.subnets if match_tags(s.tags, filters)]

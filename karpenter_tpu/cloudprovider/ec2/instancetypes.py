@@ -126,7 +126,14 @@ class InstanceTypeProvider:
         # (ref: instancetypes.go:44-46).
         self._cache = TtlCache(CATALOG_CACHE_TTL, clock)
         self._unavailable = TtlCache(ICE_BLACKOUT_TTL, clock)
+        # The controller's PriceBook (attach_market): advertised spot prices
+        # track its folded market; ICE-closed pools drop their spot
+        # offering. Plain slot, GIL-atomic swap at boot.
+        self._market_book = None
         self._lock = threading.Lock()
+
+    def attach_market(self, book) -> None:
+        self._market_book = book
 
     def get(self, provider: Ec2Provider) -> List[InstanceType]:
         """All instance types purchasable in the provider's subnet zones,
@@ -137,6 +144,13 @@ class InstanceTypeProvider:
         subnet_zones = {
             subnet.zone for subnet in self.subnet_provider.get(provider)
         }
+        # One on-demand anchor map per get(), not per offering: with a
+        # book attached every spot offering reprices against it, and
+        # rebuilding it inside the loop would make the catalog quadratic
+        # in offerings.
+        od_prices = (
+            self.on_demand_prices() if self._market_book is not None else {}
+        )
         result = []
         for info in infos.values():
             offerings = []
@@ -149,16 +163,44 @@ class InstanceTypeProvider:
                     info.name, offering.zone, offering.capacity_type
                 ):
                     continue
-                offerings.append(
-                    Offering(
-                        zone=offering.zone,
-                        capacity_type=offering.capacity_type,
-                        price=offering.price,
-                    )
-                )
+                priced = self._market_priced(info.name, offering, od_prices)
+                if priced is not None:
+                    offerings.append(priced)
             if offerings:
                 result.append(adapt_instance_type(info, offerings))
         return result
+
+    def _market_priced(self, name: str, offering, od_prices) -> Optional[Offering]:
+        """One offering under the attached PriceBook, priced by the SHARED
+        rule (market.pricebook.advertised_price — the fake provider calls
+        the same function, so the backends cannot drift): spot follows the
+        folded market (on-demand anchor x live discount), ICE-closed pools
+        vanish, anything unpriced keeps the wire/catalog price."""
+        from karpenter_tpu.market.pricebook import advertised_price
+
+        pool = (name, offering.zone)
+        price = advertised_price(
+            self._market_book,
+            pool,
+            offering.capacity_type,
+            offering.price,
+            od_prices.get(pool),
+        )
+        if price is None:
+            return None
+        return Offering(
+            zone=offering.zone, capacity_type=offering.capacity_type, price=price
+        )
+
+    def on_demand_prices(self) -> Dict[tuple, float]:
+        """{(type, zone): on-demand $/hr} from the cached offering listing —
+        the anchor spot discounts are computed against."""
+        out: Dict[tuple, float] = {}
+        for name, offerings in self._get_offerings().items():
+            for offering in offerings:
+                if offering.capacity_type == "on-demand":
+                    out[(name, offering.zone)] = offering.price
+        return out
 
     def _get_infos(self) -> Dict[str, InstanceTypeInfo]:
         with self._lock:

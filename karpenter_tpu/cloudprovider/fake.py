@@ -197,6 +197,14 @@ class FakeCloudProvider(CloudProvider):
         self._interruptions: Dict[str, InterruptionEvent] = {}  # vet: guarded-by(self._lock)
         self._event_ids = itertools.count(1)
         self.acked_interruptions: List[str] = []
+        # Live market wiring (karpenter_tpu/market): the feed generates the
+        # tick stream poll_market_events serves; the attached PriceBook (the
+        # controller's fold of that stream) reprices ADVERTISED spot
+        # offerings and drops ICE-closed pools, so every catalog consumer
+        # sees the market the controller folded. Plain slots (GIL-atomic
+        # swaps, read-only use): attach happens at harness/Manager boot.
+        self._market_feed = None
+        self._market_book = None
         self._lock = threading.Lock()
 
     # --- helpers ------------------------------------------------------------
@@ -220,6 +228,70 @@ class FakeCloudProvider(CloudProvider):
         as ICE feedback: the pool vanishes from get_instance_types for the
         TTL, so replacement capacity re-solves away from it."""
         self.cache_unavailable(instance_type, zone, capacity_type)
+
+    # --- market feed --------------------------------------------------------
+
+    def attach_market_feed(self, feed) -> None:
+        """Wire a karpenter_tpu.market.feed.MarketFeed as this cloud's tick
+        source; poll_market_events advances it on the provider clock. An
+        un-stepped feed is re-anchored to that clock here — a feed built
+        with the default epoch anchor would otherwise owe one step per
+        elapsed second since 0 at the first poll (FakeClock starts at
+        1e6)."""
+        feed.rebase(self._now())
+        self._market_feed = feed
+
+    def attach_market(self, book) -> None:
+        self._market_book = book
+
+    def poll_market_events(self, after_seq: int = 0) -> List:
+        feed = self._market_feed
+        if feed is None:
+            return []
+        feed.advance(self._now())
+        return feed.ticks_after(after_seq)
+
+    def _market_offering(self, name: str, offering: Offering, od_price):
+        """One offering under the attached book, priced by the SHARED rule
+        (market.pricebook.advertised_price — the EC2 catalog path calls the
+        same function, so the backends cannot drift): spot prices track the
+        folded market (od * discount), ICE-closed pools drop their spot
+        offering, anything unpriced keeps the catalog price."""
+        from karpenter_tpu.market.pricebook import advertised_price
+
+        price = advertised_price(
+            self._market_book,
+            (name, offering.zone),
+            offering.capacity_type,
+            offering.price,
+            od_price,
+        )
+        if price is None:
+            return None
+        if price == offering.price:
+            return offering
+        return Offering(
+            zone=offering.zone,
+            capacity_type=offering.capacity_type,
+            price=price,
+            consolidatable=offering.consolidatable,
+        )
+
+    def _priced_offerings(self, it: InstanceType) -> List[Offering]:
+        """The type's available offerings under blackouts + the live market."""
+        od_by_zone = {
+            o.zone: o.price
+            for o in it.offerings
+            if o.capacity_type == wellknown.CAPACITY_TYPE_ON_DEMAND
+        }
+        out = []
+        for o in it.offerings:
+            if not self._offering_available(it.name, o):
+                continue
+            priced = self._market_offering(it.name, o, od_by_zone.get(o.zone))
+            if priced is not None:
+                out.append(priced)
+        return out
 
     # --- interruption feed --------------------------------------------------
 
@@ -272,9 +344,7 @@ class FakeCloudProvider(CloudProvider):
         Get:61-104 subtracts the unavailable-offerings cache)."""
         out = []
         for it in self._instance_types:
-            offerings = [
-                o for o in it.offerings if self._offering_available(it.name, o)
-            ]
+            offerings = self._priced_offerings(it)
             if not offerings:
                 continue
             out.append(

@@ -233,21 +233,37 @@ def simulate_plan_cost(
     market: Optional[SpotMarket] = None,
     zones: Sequence[str] = (),
     depth_slack: float = DEPTH_SLACK,
+    excluded: Iterable[Pool] = (),
 ) -> float:
     """Total realized $/hr of a PackResult when every node is bought through
-    the reference's fleet strategies against one shared market state."""
+    the reference's fleet strategies against one shared market state.
+    `excluded` pools (ICE'd / blacked-out mid-storm) are unpurchasable for
+    the allocation AND the infeasible fallback below."""
     allowed_zones = constraints.effective_requirements().allowed(wellknown.ZONE_LABEL)
     zone_filter = [z for z in zones if allowed_zones.contains(z)] if zones else []
+    excluded = set(excluded)
     total = 0.0
     for packing in result.packings:
         capacity_type = capacity_type_for(constraints, packing.instance_type_options)
         offers = plan_offers(packing, zone_filter, capacity_type, market)
-        chosen = allocate(offers, capacity_type, market, depth_slack=depth_slack)
+        chosen = allocate(
+            offers, capacity_type, market, excluded=excluded,
+            depth_slack=depth_slack,
+        )
         if chosen is None:
-            # No purchasable pool: price at the best advertised offering so an
-            # infeasible plan still costs rather than silently zeroes.
+            # No purchasable pool: price at the best advertised offering that
+            # is still purchasable, so an infeasible plan costs rather than
+            # silently zeroes. Excluded pools don't advertise — a packing
+            # whose every pool is blacked out prices at inf (pricing it at
+            # the best ADVERTISED offering silently under-reported storm-
+            # time cost).
             chosen_price = min(
-                (it.min_price() for it in packing.instance_type_options),
+                (
+                    offering.price
+                    for it in packing.instance_type_options
+                    for offering in it.offerings
+                    if (it.name, offering.zone) not in excluded
+                ),
                 default=float("inf"),
             )
             total += packing.node_quantity * chosen_price

@@ -110,12 +110,24 @@ class InterruptionController:
         termination: TerminationController,
         escalate_fraction: float = DEFAULT_ESCALATE_FRACTION,
         cluster_state=None,
+        price_book=None,
     ):
         self.cluster = cluster
         self.cloud = cloud
         self.provisioning = provisioning
         self.termination = termination
         self.escalate_fraction = escalate_fraction
+        # The Manager's own PriceBook (market/pricebook.py): interruptions
+        # raise the reclaimed pool's forecast hazard. Injected — never read
+        # from the process-global active_book(), which with two Managers
+        # alive (restart harnesses, parity suites) would attribute THIS
+        # manager's interruptions to the OTHER's market state. None = no
+        # live market attached (unit harnesses), hazard not tracked.
+        self.price_book = price_book
+        # Event ids whose hazard was already noted (at-least-once dedup for
+        # note_interruption; see _ingest). In-memory: a restart may re-note
+        # a redelivered event once, which the half-life decay absorbs.
+        self._noted: set = set()
         # Incremental encoder: the drain's replaceable-pod listing reads its
         # O(delta)-maintained per-node index instead of filtering the whole
         # store per node per sweep; displacement itself re-reads the store
@@ -175,6 +187,22 @@ class InterruptionController:
         self.cloud.blackout_offering(
             node.instance_type, node.zone, node.capacity_type
         )
+        # And raise the pool's interruption hazard: the forecast penalty
+        # (market/forecast.py) steers FUTURE packing away from this pool
+        # even after the blackout TTL lapses, decaying on a half-life.
+        # Deduped per event id: the feed is at-least-once (an ack that
+        # fails after retries redelivers), and note_interruption is a
+        # counted increment — without the dedup one physical interruption
+        # would double its hazard contribution on every redelivery. The
+        # blackout above is naturally idempotent; this is not.
+        if self.price_book is not None and event.event_id not in self._noted:
+            if len(self._noted) >= 4096:
+                # Bounded: clear BEFORE adding so the current id survives
+                # the flush (old ids never redeliver; the one being
+                # processed right now absolutely can — its ack is next).
+                self._noted.clear()
+            self._noted.add(event.event_id)
+            self.price_book.note_interruption((node.instance_type, node.zone))
         crashpoint("interruption.after-annotate")
         self.cloud.ack_interruption(event)
 

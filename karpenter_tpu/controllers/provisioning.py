@@ -371,7 +371,14 @@ class ProvisionerWorker:
                 # flush, so the envelope cache invalidates on ordinary
                 # pod/node churn, not just full re-uploads; None while
                 # deltas are pending (compile reads the live store).
-                epoch = self.cluster_state.compile_tag()
+                # stamp_epoch folds in the market generation — a reprice
+                # (live price drift past --reprice-threshold, ICE churn)
+                # invalidates the compiled envelopes the same way cluster
+                # churn does, so constrained solves never pack against a
+                # stale price surface (docs/design/market.md).
+                from karpenter_tpu.market.pricebook import stamp_epoch
+
+                epoch = stamp_epoch(self.cluster_state.compile_tag())
             except Exception:  # noqa: BLE001 — cache tag only, never fatal
                 epoch = None
         for schedule in constrained:
@@ -538,6 +545,11 @@ class ProvisionerWorker:
             # The flight-recorder's launch decision: WHAT is being bought
             # (first-choice type + price), for whom, under which idempotency
             # token — the record a breach/crash dump correlates against.
+            # market_generation names the price state the purchase was made
+            # under: a breach dump's launches line up against its reprice
+            # events by generation (None = no live market attached).
+            from karpenter_tpu.market.pricebook import active_generation
+
             first_pool = (packing.pool_options or [None])[0]
             RECORDER.record(
                 "launch",
@@ -552,6 +564,7 @@ class ProvisionerWorker:
                 price=getattr(first_pool, "price", None),
                 zone=getattr(first_pool, "zone", None),
                 launch_id=launch_id,
+                market_generation=active_generation(),
                 trace=TRACER.current_trace() or "",
             )
             errors = self.cloud.create(

@@ -42,6 +42,7 @@ NODES = "/api/v1/nodes"
 DAEMONSETS = "/apis/apps/v1/daemonsets"
 PROVISIONERS = f"/apis/{convert.GROUP}/{convert.VERSION}/provisioners"
 LEASES = "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases"
+PDBS = "/apis/policy/v1/namespaces/default/poddisruptionbudgets"
 
 
 def _pod_path(namespace: str, name: str = "") -> str:
@@ -83,6 +84,11 @@ class ApiServerCluster(Cluster):
         # DeletedFinalStateUnknown tombstones).
         self._tombstones: Dict[Tuple[str, object], Tuple[int, float]] = {}  # vet: guarded-by(self._rv_lock)
         self._rv_lock = threading.Lock()
+        # Serializes the PDB gate + displacement write (reschedule_pod):
+        # the interruption and consolidation drain loops displace
+        # concurrently, and two gates passing on the same budget instant
+        # would jointly overspend it.
+        self._disruption_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
         self.resync_count = 0  # 410-triggered re-LISTs (observability + tests)
@@ -107,6 +113,20 @@ class ApiServerCluster(Cluster):
             )
             thread.start()
             self._threads.append(thread)
+        # PDBs seed from the server too: a RESTARTED controller that only
+        # re-listed pods/nodes would hold an empty budget table, and every
+        # post-restart drain would displace unbudgeted (the market-storm
+        # smoke caught exactly this — one interruption sweep took all four
+        # replicas behind a PDB down at once).
+        for item in self.api.list(PDBS):
+            spec = item.get("spec") or {}
+            selector = (spec.get("selector") or {}).get("matchLabels") or {}
+            Cluster.apply_pdb(
+                self,
+                (item.get("metadata") or {}).get("name", ""),
+                selector,
+                int(spec.get("minAvailable", 0)),
+            )
         return self
 
     def close(self) -> None:
@@ -420,13 +440,85 @@ class ApiServerCluster(Cluster):
             pod.deletion_timestamp = self.clock.now()
             self._notify("pod", pod, verb="update")
 
+    def reschedule_pod(self, namespace: str, name: str, override_pdb: bool = False):
+        # One displacement in flight at a time: the server-truth gate below
+        # reads a fresh LIST, and two concurrent drains passing on the same
+        # healthy count would jointly overspend the budget. The gate runs
+        # ONLY here, on the actual displacement — nomination pre-checks
+        # (consolidation's _drainable_pods) keep the cache-based
+        # _pdb_allows, or every sweep would pay O(candidates x pods) full
+        # server LISTs.
+        with self._disruption_lock:
+            if not override_pdb:
+                pod = self.try_get_pod(namespace, name)
+                if (
+                    pod is not None
+                    and pod.node_name is not None
+                    and not self._pdb_allows_server(pod)
+                ):
+                    from karpenter_tpu.controllers.errors import PDBViolationError
+
+                    raise PDBViolationError(
+                        f"pod {namespace}/{name} blocked by PDB"
+                    )
+            return super().reschedule_pod(namespace, name, override_pdb)
+
+    def _pdb_allows_server(self, pod) -> bool:
+        """Server-truth budget check — the displacement analogue of the
+        server-gated Eviction subresource. The cache-based _pdb_allows
+        rides the chaos-mangled watch streams: a duplicated/reordered event
+        from BEFORE a displacement can resurrect the victim's bound state,
+        the stale count over-reports, and one polite drain sweep displaces
+        every replica behind the PDB (the market-storm smoke caught exactly
+        this). So the budget is counted from a fresh server LIST — the
+        un-mangled truth — with the victim's own bound state read from the
+        same snapshot."""
+        with self._lock:
+            pdbs = list(self._pdbs.values())
+        matching = [
+            (labels, min_available)
+            for labels, min_available in pdbs
+            if all(pod.labels.get(k) == v for k, v in labels.items())
+        ]
+        if not matching:
+            return True
+        healthy_labels, victim_counts = self._server_healthy_pods(pod)
+        for match_labels, min_available in matching:
+            healthy = sum(
+                1
+                for labels in healthy_labels
+                if all(labels.get(k) == v for k, v in match_labels.items())
+            )
+            if healthy - (1 if victim_counts else 0) < min_available:
+                return False
+        return True
+
+    def _server_healthy_pods(self, victim):
+        """One fresh server LIST -> (label dicts of every healthy BOUND
+        non-terminating pod, whether the victim itself is among them)."""
+        victim_counts = False
+        healthy_labels = []
+        for item in self.api.list(PODS):
+            meta = item.get("metadata") or {}
+            if meta.get("deletionTimestamp"):
+                continue
+            if not (item.get("spec") or {}).get("nodeName"):
+                continue
+            healthy_labels.append(meta.get("labels") or {})
+            if (
+                meta.get("namespace", "default") == victim.namespace
+                and meta.get("name") == victim.name
+            ):
+                victim_counts = True
+        return healthy_labels, victim_counts
+
     def _reschedule_local(self, namespace: str, name: str):
         """Write-through displacement: clear spec.nodeName (merge-patch null
         removes the key), restore the Unschedulable condition so a re-list
         sees the pod as provisionable again, and persist the bumped
         reschedule epoch (launch-identity input); then update the cache. The
-        PDB gate already ran in reschedule_pod against the cache (PDBs write
-        through both sides)."""
+        PDB gate already ran in reschedule_pod against the SERVER's pod list
+        (_pdb_allows above; PDBs write through both sides)."""
         from karpenter_tpu.controllers.cluster import reschedule_epoch
 
         pod = self.try_get_pod(namespace, name)

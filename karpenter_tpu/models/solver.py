@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.api.provisioner import Constraints
 from karpenter_tpu.cloudprovider import InstanceType
@@ -706,7 +707,10 @@ def _pool_zones(fleet: InstanceFleet) -> List[str]:
 def _pool_price_matrix(fleet: InstanceFleet) -> Tuple[List[str], np.ndarray]:
     """[T, Z] price of each type's pool per zone at the fleet's capacity type
     (inf where not offered), computed once per solve so per-round option
-    ranking is pure vectorized numpy."""
+    ranking is pure vectorized numpy. Spot matrices carry the interruption-
+    forecast penalty per POOL (price += price * risk * weight), so pinned
+    launch rows rank away from pools trending toward interruption — the
+    [T, Z] analogue of build_fleet's [T] penalty column."""
     zones = _pool_zones(fleet)
     matrix = np.full((fleet.num_types, len(zones)), np.inf, dtype=np.float64)
     zone_index = {zone: j for j, zone in enumerate(zones)}
@@ -717,6 +721,22 @@ def _pool_price_matrix(fleet: InstanceFleet) -> Tuple[List[str], np.ndarray]:
             j = zone_index.get(offering.zone)
             if j is not None:
                 matrix[ti, j] = min(matrix[ti, j], offering.price)
+    if fleet.capacity_type == wellknown.CAPACITY_TYPE_SPOT:
+        from karpenter_tpu.market.pricebook import active_book
+
+        book = active_book()
+        if book is not None and book.has_risk():
+            from karpenter_tpu.market.forecast import (
+                RISK_PRICE_WEIGHT,
+                risk_matrix,
+            )
+
+            risks = risk_matrix(
+                [it.name for it in fleet.instance_types], zones, book
+            )
+            # Multiplicative form so inf (unoffered) rows stay inf — the
+            # additive prices + prices*risk*w form would produce inf*0=nan.
+            matrix = matrix * (1.0 + risks * RISK_PRICE_WEIGHT)
     return zones, matrix
 
 

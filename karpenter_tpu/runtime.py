@@ -23,6 +23,7 @@ from karpenter_tpu.controllers.metrics import MetricsController, POLL_SECONDS
 from karpenter_tpu.controllers.node import NodeController
 from karpenter_tpu.controllers.instancegc import InstanceGcController
 from karpenter_tpu.controllers.interruption import InterruptionController
+from karpenter_tpu.controllers.market import MarketController
 from karpenter_tpu.controllers.podgc import PodGcController
 from karpenter_tpu.controllers.provisioning import (
     BATCH_IDLE_SECONDS,
@@ -484,6 +485,14 @@ class Manager:
         self.metrics = MetricsController(cluster)
         self.podgc = PodGcController(cluster)
         self.instancegc = InstanceGcController(cluster, cloud)
+        # Live market (karpenter_tpu/market): ONE PriceBook per controller
+        # process, built BEFORE the controllers that feed or read it.
+        from karpenter_tpu.market.pricebook import PriceBook, set_active_book
+
+        self.price_book = PriceBook(
+            clock=cluster.clock,
+            reprice_threshold=options.reprice_threshold,
+        )
         self.interruption = InterruptionController(
             cluster,
             cloud,
@@ -491,6 +500,7 @@ class Manager:
             self.termination,
             escalate_fraction=options.interruption_escalate_fraction,
             cluster_state=self.cluster_state,
+            price_book=self.price_book,
         )
         self.consolidation = ConsolidationController(
             cluster,
@@ -501,6 +511,28 @@ class Manager:
             cooldown_seconds=options.consolidation_cooldown,
             cluster_state=self.cluster_state,
         )
+        # The book (built above, before the controllers that feed it) folds
+        # the provider's tick stream; set_active_book makes it the book the
+        # solver-layer penalty/cache hooks read, attach_market makes the
+        # provider's ADVERTISED spot prices track it, and the market sweep
+        # requeues the cost controllers on debounced reprices. A restarted
+        # Manager builds a fresh book and re-folds the provider's replayable
+        # history from seq 0 — reconstructing the exact pre-crash state AND
+        # generation (docs/design/market.md).
+        set_active_book(self.price_book)
+        cloud.attach_market(self.price_book)
+        self.market = MarketController(
+            cluster,
+            cloud,
+            self.price_book,
+            debounce_seconds=options.reprice_debounce,
+            # 0 = auto: the provider knows its own safe cadence (1s for the
+            # in-memory fake, 15s on EC2 where a sweep is a paginated
+            # DescribeSpotPriceHistory).
+            sweep_seconds=options.market_poll_interval
+            or getattr(cloud, "MARKET_POLL_DEFAULT_S", 1.0),
+        )
+        self.market.requeue = self._reprice_requeue
         self.ready = threading.Event()
         # Set once the solver's compile debt is paid (immediately for host
         # solvers). Gates /readyz AND the batch loop: a batch window that
@@ -572,6 +604,12 @@ class Manager:
             "consolidation": ReconcileLoop(
                 "consolidation", self.consolidation.reconcile, concurrency=1
             ),
+            # Market sweep: poll the provider's price/ICE feed, fold ticks
+            # into the PriceBook, requeue cost decisions on debounced
+            # reprices — the dynamic analogue of the 5-minute drift requeue.
+            "market": ReconcileLoop(
+                "market", self.market.reconcile, concurrency=1
+            ),
         }
 
     # --- watch fan-out (ref: controller Register() watch wiring) ------------
@@ -636,6 +674,15 @@ class Manager:
             for provisioner in self.cluster.list_provisioners():
                 self.loops["provisioning"].enqueue(provisioner.name)
 
+    def _reprice_requeue(self) -> None:
+        """The market sweep's requeue hook: a debounced reprice pulls every
+        provisioner refresh AND a consolidation sweep forward NOW (enqueue
+        at delay 0 supersedes the poll interval) — the dynamic analogue of
+        _requeue_loop's 5-minute drift timer."""
+        for provisioner in self.cluster.list_provisioners():
+            self.loops["provisioning"].enqueue(provisioner.name)
+        self.loops["consolidation"].enqueue("sweep")
+
     # --- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
@@ -659,6 +706,7 @@ class Manager:
         self.loops["instancegc"].enqueue("sweep")
         self.loops["interruption"].enqueue("sweep")
         self.loops["consolidation"].enqueue("sweep")
+        self.loops["market"].enqueue("sweep")
         if getattr(self.solver, "needs_device_warmup", False):
             from karpenter_tpu.utils import backend_health
 

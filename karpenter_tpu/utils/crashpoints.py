@@ -76,6 +76,15 @@ CONSOLIDATION_SITES = (
     "consolidation.before-delete",
 )
 
+# Market-fold commit point (docs/design/market.md):
+# - ``market.mid-tick``  fires between folded market ticks (arm with at=N)
+#   — a kill mid-fold leaves the PriceBook partially folded; the restart
+#   re-polls the replayable feed from seq 0 and must reconstruct the
+#   IDENTICAL book state and generation (the fold is a pure idempotent
+#   function of the tick sequence; tests/test_market_feed.py asserts it on
+#   both store backends).
+MARKET_SITES = ("market.mid-tick",)
+
 # Incremental-encode commit point (docs/design/incremental-encode.md):
 # - ``encode.mid-apply``  fires inside DeviceClusterState's two-phase pod
 #   sync, after the old contribution was removed and before the new one is

@@ -34,6 +34,11 @@ instrumentation):
 - ``watch.stall``   consulted by the fake apiserver's HTTP watch handler:
                     hold events without closing the socket — the fault the
                     HttpTransport read-deadline exists to bound
+- ``market.feed``   crossed by the market controller's feed poll
+                    (controllers/market.py): ``stale`` holds back the
+                    newest ticks (they redeliver), ``reorder`` scrambles
+                    the batch (the seq-sorted fold absorbs it), and
+                    ``blackout`` skips the poll — staleness climbs
 """
 
 from __future__ import annotations
@@ -52,6 +57,7 @@ SITES = (
     "watch.open",
     "watch.event",
     "watch.stall",
+    "market.feed",
 )
 
 REQUEST_SITES = tuple(s for s in SITES if s.startswith("api.request."))
@@ -66,6 +72,7 @@ KINDS_BY_SITE = {
     "watch.open": ("tear", "gone"),
     "watch.event": ("latency", "tear", "duplicate", "reorder", "drop-410"),
     "watch.stall": ("stall",),
+    "market.feed": ("stale", "reorder", "blackout"),
 }
 
 

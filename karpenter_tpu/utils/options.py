@@ -84,6 +84,23 @@ class Options:
     # — the gauges still publish. See docs/design/observability.md.
     slo_pending_p99: float = 0.0
     slo_ttfl: float = 0.0
+    # Live market dynamics (karpenter_tpu/market): relative spot-discount
+    # drift (vs the pool's anchor at its last reprice) that bumps the
+    # PriceBook generation — invalidating the compiled-envelope and fleet
+    # caches and requeueing provisioning + consolidation. Smaller = more
+    # responsive to drift, more re-solves. See docs/design/market.md and
+    # the operations.md "price storm" runbook.
+    reprice_threshold: float = 0.1
+    # Per-pool floor between reprice-triggered requeues (seconds): bumps
+    # inside the window coalesce, so a price storm costs at most one
+    # re-solve per pool per window and cannot melt the sweep loops.
+    reprice_debounce: float = 5.0
+    # Market feed poll cadence (seconds). 0 (the default) = auto: the
+    # provider's own MARKET_POLL_DEFAULT_S — 1s for the in-memory fake,
+    # 15s on EC2 where each sweep is a paginated DescribeSpotPriceHistory
+    # (the reference's drift requeue runs at 5 MINUTES). Set explicitly to
+    # override either.
+    market_poll_interval: float = 0.0
     # Tombstone-density trigger for the incremental encoder's masked
     # compaction (models/cluster_state.py): when freed-but-unreused slot
     # rows exceed this fraction of the high-water mark, live rows are
@@ -133,21 +150,7 @@ class Options:
                 "interruption-escalate-fraction must be in (0, 1], got "
                 f"{self.interruption_escalate_fraction}"
             )
-        # Non-negative scalars where 0 means "disabled": one data-driven
-        # check so each new knob costs a row, not a branch.
-        for flag, value in (
-            ("slo-pending-p99", self.slo_pending_p99),
-            ("slo-ttfl", self.slo_ttfl),
-            ("consolidation-max-disruption", self.consolidation_max_disruption),
-            ("consolidation-cooldown", self.consolidation_cooldown),
-        ):
-            if value < 0:
-                errors.append(f"{flag} must be >= 0 (0 disables), got {value}")
-        if not 0.0 < self.encode_compaction_threshold <= 1.0:
-            errors.append(
-                "encode-compaction-threshold must be in (0, 1], got "
-                f"{self.encode_compaction_threshold}"
-            )
+        errors.extend(self._scalar_errors())
         if self.cluster_store != "memory" and self.cluster_store != "incluster" and not self.cluster_store.startswith(
             ("http://", "https://")
         ):
@@ -156,6 +159,35 @@ class Options:
             )
         if errors:
             raise OptionsError("; ".join(errors))
+
+    def _scalar_errors(self) -> List[str]:
+        errors: List[str] = []
+        # Non-negative scalars where 0 means "disabled": one data-driven
+        # check so each new knob costs a row, not a branch.
+        for flag, value in (
+            ("slo-pending-p99", self.slo_pending_p99),
+            ("slo-ttfl", self.slo_ttfl),
+            ("consolidation-max-disruption", self.consolidation_max_disruption),
+            ("consolidation-cooldown", self.consolidation_cooldown),
+            ("reprice-debounce", self.reprice_debounce),
+        ):
+            if value < 0:
+                errors.append(f"{flag} must be >= 0 (0 disables), got {value}")
+        if self.reprice_threshold <= 0:
+            errors.append(
+                f"reprice-threshold must be > 0, got {self.reprice_threshold}"
+            )
+        if self.market_poll_interval < 0:
+            errors.append(
+                "market-poll-interval must be >= 0 (0 = provider default), "
+                f"got {self.market_poll_interval}"
+            )
+        if not 0.0 < self.encode_compaction_threshold <= 1.0:
+            errors.append(
+                "encode-compaction-threshold must be in (0, 1], got "
+                f"{self.encode_compaction_threshold}"
+            )
+        return errors
 
 
 def parse(argv: Optional[List[str]] = None) -> Options:
@@ -222,6 +254,18 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         default=float(_env("ENCODE_COMPACTION_THRESHOLD", "0.5")),
     )
     parser.add_argument(
+        "--reprice-threshold", type=float,
+        default=float(_env("REPRICE_THRESHOLD", "0.1")),
+    )
+    parser.add_argument(
+        "--reprice-debounce", type=float,
+        default=float(_env("REPRICE_DEBOUNCE", "5")),
+    )
+    parser.add_argument(
+        "--market-poll-interval", type=float,
+        default=float(_env("MARKET_POLL_INTERVAL", "0")),
+    )
+    parser.add_argument(
         "--slo-pending-p99", type=float,
         default=float(_env("SLO_PENDING_P99", "0")),
     )
@@ -254,6 +298,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         encode_compaction_threshold=args.encode_compaction_threshold,
         slo_pending_p99=args.slo_pending_p99,
         slo_ttfl=args.slo_ttfl,
+        reprice_threshold=args.reprice_threshold,
+        reprice_debounce=args.reprice_debounce,
+        market_poll_interval=args.market_poll_interval,
     )
     options.validate()
     return options

@@ -31,6 +31,19 @@ def _crashpoints_disarmed():
 
 
 @pytest.fixture(autouse=True)
+def _market_book_reset():
+    """The market PriceBook is process-global-active (market/pricebook.py
+    set_active_book — Manager sets it at boot): a book leaking across tests
+    would silently reprice every solver-layer fleet build. Tests that want
+    one set it themselves."""
+    from karpenter_tpu.market.pricebook import set_active_book
+
+    set_active_book(None)
+    yield
+    set_active_book(None)
+
+
+@pytest.fixture(autouse=True)
 def _faultpoints_disarmed():
     """Same isolation for chaos faults (tests/test_chaos.py and the parity
     re-runs arm them): every apiserver-backed Harness routes through

@@ -620,6 +620,12 @@ class TestPoolPinnedLaunchOverWire(_suite.TestPoolPinnedLaunch):
     pass
 
 
+class TestMarketPollOverWire(_suite.TestMarketPoll):
+    """The market feed's EC2 leg over real bytes: injected spot-price rows
+    serialize through the wire fake's DescribeSpotPriceHistory XML (ISO
+    timestamps and all) and come back as the identical tick stream."""
+
+
 class TestUrllibTransportOverRealSockets:
     """The PRODUCTION transport (urllib) against a real HTTP server fronting
     the wire fake: signing, pagination, error mapping, and throttle retry all

@@ -17,6 +17,7 @@ from tests import test_chaos as chaos
 from tests import test_consolidation as consolidation
 from tests import test_crash_consistency as crash
 from tests import test_interruption as interruption
+from tests import test_market_feed as market_feed
 from tests import test_node_lifecycle as lifecycle
 from tests import test_provisioning as provisioning
 from tests import test_scheduling as scheduling
@@ -147,6 +148,18 @@ class TestConsolidationChurnOnApiserver(
     consolidation.TestConsolidationChurnConvergence
 ):
     pass
+
+
+class TestMarketCrashRestartOnApiserver(market_feed.TestMarketCrashRestart):
+    """The market-fold determinism clause on the apiserver backend: a
+    controller killed at market.mid-tick restarts over the write-through
+    store, re-folds the provider's replayable tick history from seq 0, and
+    reconstructs the identical PriceBook state and generation."""
+
+
+class TestMarketControllerOnApiserver(market_feed.TestMarketController):
+    """The market sweep (feed fold, chaos legs, debounce) must be backend-
+    blind: it reads only the provider feed and the store's clock."""
 
 
 class TestProvisioningUnderApiFaultsOnApiserver(chaos.TestProvisioningUnderApiFaults):

@@ -121,7 +121,9 @@ class TestFaultpointFacility:
         scanned = list((Path(karpenter_tpu.__file__).parent).rglob("*.py")) + [
             Path(__file__).parent / "fake_apiserver.py"
         ]
-        pattern = re.compile(r'"((?:api\.request|watch)\.[a-z0-9-]+)"')
+        pattern = re.compile(
+            r'"((?:api\.request|watch)\.[a-z0-9-]+|market\.feed)"'
+        )
         found = set()
         for path in scanned:
             if path.name == "faultpoints.py":

@@ -797,3 +797,130 @@ class TestInterruptionFeed:
                 o.zone == zone and o.capacity_type == "spot"
                 for o in it.offerings
             ), "blacked-out pool still offered"
+
+
+def _raw_fake(api):
+    """The underlying FakeEc2 regardless of backend — the wire binding
+    (tests/test_aws_http.py) exposes it as .fake, so market-history
+    injection works when this class re-runs over real bytes."""
+    return getattr(api, "fake", api)
+
+
+class TestMarketPoll:
+    """DescribeSpotPriceHistory -> poll_market_events: rows become a
+    strictly-ordered tick stream with seqs that stay stable when the API's
+    sliding history window drops old rows (design/market.md)."""
+
+    def test_rows_become_ordered_ticks_with_catalog_discounts(self):
+        cloud, api, clock = make_provider()
+        fake = _raw_fake(api)
+        zone = fake.zones[0]
+        # Injected newest-first: the poll's total order sorts them back.
+        fake.inject_spot_price("m5.large", zone, 0.060, timestamp=20.0)
+        fake.inject_spot_price("m5.large", zone, 0.048, timestamp=10.0)
+        ticks = cloud.poll_market_events()
+        assert [t.seq for t in ticks] == [1, 2]
+        assert [t.at for t in ticks] == [10.0, 20.0]
+        # Discounts anchor on the catalog's on-demand price (0.096).
+        assert ticks[0].discount == pytest.approx(0.048 / 0.096)
+        assert ticks[1].discount == pytest.approx(0.060 / 0.096)
+        assert all(t.kind == "price" for t in ticks)
+        # Cursor semantics: nothing new past the high-water mark, and a
+        # re-fold from 0 replays the identical sequence.
+        assert cloud.poll_market_events(after_seq=2) == []
+        assert [t.encode() for t in cloud.poll_market_events(0)] == [
+            t.encode() for t in ticks
+        ]
+
+    def test_window_slide_keeps_seqs_stable(self):
+        """The regression the rank-derived numbering had: rows aging out of
+        the sliding window must not renumber (and so re-deliver or hide)
+        later rows."""
+        cloud, api, clock = make_provider()
+        fake = _raw_fake(api)
+        zone = fake.zones[0]
+        fake.inject_spot_price("m5.large", zone, 0.048, timestamp=10.0)
+        fake.inject_spot_price("m5.large", zone, 0.050, timestamp=20.0)
+        assert [t.seq for t in cloud.poll_market_events()] == [1, 2]
+        # The window slides: the oldest row ages out while a new one lands.
+        fake.spot_price_history.pop(0)
+        fake.inject_spot_price("m5.large", zone, 0.052, timestamp=30.0)
+        fresh = cloud.poll_market_events(after_seq=2)
+        assert [t.seq for t in fresh] == [3]
+        assert fresh[0].discount == pytest.approx(0.052 / 0.096)
+
+    def test_stale_and_unanchored_rows_are_dropped(self):
+        cloud, api, clock = make_provider()
+        fake = _raw_fake(api)
+        zone = fake.zones[0]
+        fake.inject_spot_price("m5.large", zone, 0.048, timestamp=10.0)
+        assert len(cloud.poll_market_events()) == 1
+        # A late row sorting BELOW the cursor is stale information (the
+        # book only folds forward) — dropped, never renumbered.
+        fake.inject_spot_price("m5.large", zone, 0.040, timestamp=5.0)
+        # A row with no on-demand anchor advances the cursor but emits no
+        # tick; seqs stay dense.
+        fake.inject_spot_price("unknown.type", zone, 0.020, timestamp=40.0)
+        assert cloud.poll_market_events(after_seq=1) == []
+        fake.inject_spot_price("m5.large", zone, 0.060, timestamp=50.0)
+        assert [t.seq for t in cloud.poll_market_events(after_seq=1)] == [2]
+
+    def test_late_row_for_quiet_pool_is_not_shadowed(self):
+        """Cursors are PER POOL: DescribeSpotPriceHistory is eventually
+        consistent, so a late-published row for pool B must fold even when
+        pool A's cursor has already advanced past its timestamp."""
+        cloud, api, clock = make_provider()
+        fake = _raw_fake(api)
+        za, zb = fake.zones[0], fake.zones[1]
+        fake.inject_spot_price("m5.large", za, 0.048, timestamp=180.0)
+        assert [t.seq for t in cloud.poll_market_events()] == [1]
+        # The late row for a DIFFERENT pool, older than A's cursor.
+        fake.inject_spot_price("m5.large", zb, 0.050, timestamp=150.0)
+        late = cloud.poll_market_events(after_seq=1)
+        assert [(t.seq, t.zone, t.at) for t in late] == [(2, zb, 150.0)]
+        # But a late row for the SAME pool below its own cursor stays stale.
+        fake.inject_spot_price("m5.large", za, 0.040, timestamp=100.0)
+        assert cloud.poll_market_events(after_seq=2) == []
+
+    def test_history_compaction_keeps_snapshot_and_seqs(self):
+        """Past the retained-tick budget the oldest half collapses to its
+        newest tick per pool; seqs survive compaction (ordered, not dense)
+        and a re-fold from 0 still anchors every pool."""
+        cloud, api, clock = make_provider()
+        fake = _raw_fake(api)
+        za, zb = fake.zones[0], fake.zones[1]
+        cloud.MARKET_HISTORY_MAX = 4
+        # Pool B ticks once early, then only pool A keeps ticking.
+        fake.inject_spot_price("m5.large", zb, 0.050, timestamp=1.0)
+        for i in range(6):
+            fake.inject_spot_price("m5.large", za, 0.048 + 0.001 * i,
+                                   timestamp=10.0 + i)
+        replay = cloud.poll_market_events(0)
+        seqs = [t.seq for t in replay]
+        assert seqs == sorted(seqs) and len(seqs) < 7
+        # B's newest (only) tick survived compaction as its snapshot...
+        assert [t.zone for t in replay if t.zone == zb] == [zb]
+        # ...and A's latest price is the stream's last word on A.
+        a_ticks = [t for t in replay if t.zone == za]
+        assert a_ticks[-1].discount == pytest.approx(0.053 / 0.096)
+        # The cursor still rejects anything at or below the folded window.
+        assert cloud.poll_market_events(after_seq=seqs[-1]) == []
+
+    def test_rising_price_raises_forecast_hazard_via_depth_proxy(self):
+        """EC2 never reveals pool depth; ticks proxy it as 1/discount so a
+        sustained price climb (the pool being bought out) fires the
+        forecast's trend leg BEFORE any interruption lands — folding the
+        polled ticks into a PriceBook must yield nonzero risk."""
+        from karpenter_tpu.market.pricebook import PriceBook
+        from karpenter_tpu.utils.clock import FakeClock
+
+        cloud, api, clock = make_provider()
+        fake = _raw_fake(api)
+        zone = fake.zones[0]
+        for i, price in enumerate((0.048, 0.060, 0.075, 0.090)):
+            fake.inject_spot_price("m5.large", zone, price, timestamp=float(i))
+        book = PriceBook(clock=FakeClock())
+        for tick in cloud.poll_market_events():
+            assert tick.depth == pytest.approx(1.0 / tick.discount)
+            book.apply(tick)
+        assert book.pool_risk(("m5.large", zone)) > 0.0

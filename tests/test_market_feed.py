@@ -1,0 +1,882 @@
+"""The live market subsystem (karpenter_tpu/market): feed determinism, the
+PriceBook fold + generation protocol, the market sweep's chaos legs and
+debounce, cache invalidation on reprice, and the forecast penalty's
+kernel/numpy bit-parity.
+
+The crash/restart class (TestMarketCrashRestart) re-runs on the apiserver
+backend via tests/test_backend_parity.py — a restarted controller re-folds
+the provider's replayable tick history from seq 0 and must reconstruct the
+IDENTICAL book state and generation, whichever store it rides.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.market import MarketController
+from karpenter_tpu.market import forecast
+from karpenter_tpu.market.feed import (
+    TICK_ICE_CLOSE,
+    TICK_ICE_OPEN,
+    TICK_PRICE,
+    MarketFeed,
+    MarketTick,
+    catalog_pools,
+)
+from karpenter_tpu.market.pricebook import (
+    REASON_ICE,
+    REASON_PRICE,
+    PriceBook,
+    active_book,
+    set_active_book,
+    stamp_epoch,
+)
+from karpenter_tpu.utils import crashpoints, faultpoints
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.crashpoints import SimulatedCrash
+from tests import fixtures
+from tests.harness import Harness
+
+POOLS = [("a.large", "test-zone-1"), ("b.large", "test-zone-2")]
+
+
+def price_tick(seq, pool=POOLS[0], discount=0.5, depth=1.0, at=0.0):
+    return MarketTick(
+        seq=seq,
+        kind=TICK_PRICE,
+        instance_type=pool[0],
+        zone=pool[1],
+        discount=discount,
+        depth=depth,
+        at=at,
+    )
+
+
+class TestMarketFeed:
+    def test_same_seed_same_steps_byte_identical(self):
+        """The determinism contract: the tick sequence is a pure function of
+        (pools, seed, steps) — compared on the canonical wire encoding."""
+        a = MarketFeed(POOLS, seed=7, ice_close_rate=0.1)
+        b = MarketFeed(POOLS, seed=7, ice_close_rate=0.1)
+        a.advance(25.0)
+        b.advance(25.0)
+        assert a.encode_history() == b.encode_history()
+        assert a.last_seq == b.last_seq > len(POOLS)  # snapshot + steps
+
+    def test_different_seed_diverges(self):
+        a = MarketFeed(POOLS, seed=1)
+        b = MarketFeed(POOLS, seed=2)
+        a.advance(25.0)
+        b.advance(25.0)
+        assert a.encode_history() != b.encode_history()
+
+    def test_advance_is_incremental_and_idempotent(self):
+        """advance(now) emits exactly the elapsed steps; re-advancing to the
+        same now emits nothing; a fold from 0 equals the concatenation."""
+        whole = MarketFeed(POOLS, seed=3)
+        whole.advance(10.0)
+        pieces = MarketFeed(POOLS, seed=3)
+        pieces.advance(4.0)
+        cut = pieces.last_seq
+        assert pieces.advance(4.0) == 0
+        pieces.advance(10.0)
+        assert pieces.encode_history() == whole.encode_history()
+        assert [t.seq for t in pieces.ticks_after(cut)] == list(
+            range(cut + 1, pieces.last_seq + 1)
+        )
+
+    def test_forced_spike_is_an_ordinary_tick(self):
+        """A scripted spike lands as a recorded price tick at the next step
+        (replay determinism untouched) and ratchets discount up, depth down."""
+        feed = MarketFeed(POOLS, seed=5)
+        before = feed.ticks_after(0)[0]  # snapshot tick for POOLS[0]
+        feed.force_spike([POOLS[0]], factor=1.8)
+        feed.advance(1.0)
+        spiked = [
+            t
+            for t in feed.ticks_after(len(POOLS))
+            if t.pool == POOLS[0] and t.kind == TICK_PRICE
+        ]
+        assert spiked and spiked[0].discount > before.discount
+        assert spiked[0].depth < before.depth
+
+    def test_forced_ice_close_and_reopen(self):
+        feed = MarketFeed(POOLS, seed=5, ice_reopen_rate=0.0)
+        feed.force_ice([POOLS[1]], close=True)
+        feed.advance(1.0)
+        kinds = [t.kind for t in feed.ticks_after(0) if t.pool == POOLS[1]]
+        assert TICK_ICE_CLOSE in kinds
+        feed.force_ice([POOLS[1]], close=False)
+        feed.advance(2.0)
+        kinds = [t.kind for t in feed.ticks_after(0) if t.pool == POOLS[1]]
+        assert TICK_ICE_OPEN in kinds
+
+    def test_catalog_pools(self):
+        pools = catalog_pools(fixtures.default_catalog())
+        assert ("small-instance-type", "test-zone-1") in pools
+        assert len(pools) == len(set(pools))
+
+
+class TestPriceBook:
+    def test_first_sighting_anchors_silently(self):
+        """The initial market snapshot is not a reprice — boot must not
+        storm one generation bump per pool."""
+        book = PriceBook(clock=FakeClock())
+        assert book.apply(price_tick(1, discount=0.5)) is None
+        assert book.generation == 0
+        assert book.spot_discount(POOLS[0]) == 0.5
+
+    def test_threshold_crossing_reprices(self):
+        book = PriceBook(clock=FakeClock(), reprice_threshold=0.1)
+        book.apply(price_tick(1, discount=0.5))
+        # 6% drift: below the 10% relative threshold.
+        assert book.apply(price_tick(2, discount=0.53)) is None
+        assert book.generation == 0
+        reprice = book.apply(price_tick(3, discount=0.56))
+        assert reprice is not None and reprice.reason == REASON_PRICE
+        assert reprice.old_discount == 0.5 and reprice.new_discount == 0.56
+        assert book.generation == reprice.generation == 1
+
+    def test_cumulative_subthreshold_drift_reprices(self):
+        """Many tiny ticks that cumulatively cross the threshold DO reprice:
+        the anchor is the discount at the last bump, not the last tick."""
+        book = PriceBook(clock=FakeClock(), reprice_threshold=0.1)
+        book.apply(price_tick(1, discount=0.5))
+        discount, seq = 0.5, 1
+        while book.generation == 0 and seq < 50:
+            seq += 1
+            discount *= 1.02  # 2% per tick, far under 10%
+            book.apply(price_tick(seq, discount=discount))
+        assert book.generation == 1
+        assert seq < 50
+
+    def test_ice_always_reprices(self):
+        book = PriceBook(clock=FakeClock())
+        tick = MarketTick(
+            seq=1, kind=TICK_ICE_CLOSE,
+            instance_type=POOLS[0][0], zone=POOLS[0][1],
+        )
+        reprice = book.apply(tick)
+        assert reprice is not None and reprice.reason == REASON_ICE
+        assert book.is_closed(POOLS[0])
+        reopened = book.apply(
+            MarketTick(
+                seq=2, kind=TICK_ICE_OPEN,
+                instance_type=POOLS[0][0], zone=POOLS[0][1],
+            )
+        )
+        assert reopened is not None and not book.is_closed(POOLS[0])
+        assert book.generation == 2
+
+    def test_replay_is_idempotent(self):
+        """At-least-once delivery: a tick at or below the high-water mark is
+        a no-op — the restart re-fold and redelivering providers lean on it."""
+        book = PriceBook(clock=FakeClock(), reprice_threshold=0.1)
+        ticks = [
+            price_tick(1, discount=0.5),
+            price_tick(2, discount=0.7),
+            price_tick(3, discount=0.9),
+        ]
+        for t in ticks:
+            book.apply(t)
+        state = (book.generation, book.spot_discount(POOLS[0]), book.last_seq)
+        for t in ticks:  # full redelivery
+            assert book.apply(t) is None
+        assert (
+            book.generation, book.spot_discount(POOLS[0]), book.last_seq
+        ) == state
+
+    def test_staleness_tracks_newest_applied_tick(self):
+        clock = FakeClock()
+        book = PriceBook(clock=clock)
+        assert book.staleness_s() == 0.0
+        book.apply(price_tick(1, at=clock.now()))
+        clock.advance(7.0)
+        assert book.staleness_s() == pytest.approx(7.0)
+
+    def test_interruption_raises_quantized_risk(self):
+        clock = FakeClock()
+        book = PriceBook(clock=clock)
+        assert book.pool_risk(POOLS[0]) == 0.0 and not book.has_risk()
+        before = book.risk_generation
+        book.note_interruption(POOLS[0])
+        risk = book.pool_risk(POOLS[0])
+        assert 0.0 < risk < 1.0
+        assert risk % (1.0 / 32.0) == pytest.approx(0.0)  # quantized
+        assert book.has_risk() and book.risk_generation > before
+        # Decay: half-life 300s halves the pressure, lowering the risk.
+        clock.advance(900.0)
+        assert book.pool_risk(POOLS[0]) < risk
+
+    def test_depth_decline_trend_raises_risk(self):
+        book = PriceBook(clock=FakeClock())
+        book.apply(price_tick(1, depth=2.0))
+        for seq in range(2, 8):
+            book.apply(price_tick(seq, depth=2.0 * 0.6 ** (seq - 1)))
+        assert book.pool_risk(POOLS[0]) > 0.0
+        # A stable pool stays at zero.
+        book.apply(price_tick(8, pool=POOLS[1], depth=1.0))
+        book.apply(price_tick(9, pool=POOLS[1], depth=1.0))
+        assert book.pool_risk(POOLS[1]) == 0.0
+
+
+def build_market(clock=None, threshold=0.1, debounce=5.0, seed=11, harness=None):
+    """A Harness + fed FakeCloudProvider + MarketController triple."""
+    harness = harness or Harness(clock=clock)
+    feed = MarketFeed(
+        catalog_pools(fixtures.default_catalog()),
+        seed=seed,
+        start_at=harness.clock.now(),
+    )
+    harness.cloud.attach_market_feed(feed)
+    book = PriceBook(clock=harness.clock, reprice_threshold=threshold)
+    harness.cloud.attach_market(book)
+    controller = MarketController(
+        harness.cluster, harness.cloud, book, debounce_seconds=debounce
+    )
+    return harness, feed, controller
+
+
+class TestMarketController:
+    def test_sweep_folds_feed_into_book(self):
+        harness, feed, controller = build_market()
+        harness.clock.advance(5.0)
+        controller.reconcile()
+        assert controller.book.last_seq == feed.last_seq > 0
+        for pool in catalog_pools(fixtures.default_catalog()):
+            assert controller.book.spot_discount(pool) is not None
+
+    def test_advertised_prices_track_the_folded_market(self):
+        """attach_market: the catalog's spot offering prices follow the
+        book (on-demand anchor x live discount); ICE-closed pools drop
+        their spot offering."""
+        harness, feed, controller = build_market()
+        pool = ("small-instance-type", "test-zone-1")
+        feed.force_spike([pool], factor=1.3)
+        harness.clock.advance(2.0)
+        controller.reconcile()
+        discount = controller.book.spot_discount(pool)
+        it = {t.name: t for t in harness.cloud.get_instance_types()}[pool[0]]
+        spot = [
+            o for o in it.offerings
+            if o.zone == pool[1] and o.capacity_type == "spot"
+        ]
+        od = [
+            o for o in it.offerings
+            if o.zone == pool[1] and o.capacity_type == "on-demand"
+        ]
+        assert spot[0].price == pytest.approx(od[0].price * discount)
+        # ICE-close: the pool's spot offering vanishes from the catalog.
+        feed.force_ice([pool], close=True)
+        harness.clock.advance(1.0)
+        controller.reconcile()
+        it = {t.name: t for t in harness.cloud.get_instance_types()}[pool[0]]
+        assert not [
+            o for o in it.offerings
+            if o.zone == pool[1] and o.capacity_type == "spot"
+        ]
+
+    def test_reprice_requeues_and_flight_records(self):
+        from karpenter_tpu.utils.obs import RECORDER
+
+        harness, feed, controller = build_market(threshold=0.05)
+        requeues = []
+        controller.requeue = lambda: requeues.append(True)
+        baseline = RECORDER.count("reprice")
+        feed.force_spike(
+            [("small-instance-type", "test-zone-1")], factor=1.5
+        )
+        harness.clock.advance(2.0)
+        controller.reconcile()
+        assert requeues, "an above-threshold spike never requeued"
+        assert RECORDER.count("reprice") > baseline
+
+    def test_subthreshold_storm_never_requeues(self):
+        """The debounce test's stronger sibling: a storm of ticks that never
+        crosses the threshold leaves the sweep cadence untouched — zero
+        requeues, zero generation bumps."""
+        harness, feed, controller = build_market(threshold=0.9)
+        requeues = []
+        controller.requeue = lambda: requeues.append(True)
+        for _ in range(20):
+            harness.clock.advance(1.0)
+            controller.reconcile()
+        assert controller.book.last_seq > 20  # the storm was real
+        assert controller.book.generation == 0
+        assert requeues == []
+
+    def test_debounce_coalesces_reprices_per_pool(self):
+        """A repricing pool requeues at most once per debounce window; bumps
+        inside the window coalesce into the pending set and requeue when the
+        window reopens (driven with scripted Reprices so the seeded walk's
+        own drift on OTHER pools can't confound the count)."""
+        from karpenter_tpu.market.pricebook import Reprice
+
+        harness, feed, controller = build_market(debounce=30.0)
+        requeues = []
+        controller.requeue = lambda: requeues.append(harness.clock.now())
+        pool = ("small-instance-type", "test-zone-1")
+
+        def bump(generation):
+            return Reprice(
+                pool=pool, reason=REASON_PRICE,
+                old_discount=0.5, new_discount=0.6, generation=generation,
+            )
+
+        controller._requeue_due([bump(1)])
+        assert len(requeues) == 1
+        # More bumps inside the window: coalesced into pending, NOT requeued.
+        for generation in (2, 3, 4):
+            harness.clock.advance(1.0)
+            controller._requeue_due([bump(generation)])
+        assert len(requeues) == 1
+        assert pool in controller._pending
+        # Window reopens: the coalesced pending reprice requeues once.
+        harness.clock.advance(31.0)
+        controller._requeue_due([])
+        assert len(requeues) == 2
+        assert pool not in controller._pending
+
+    def test_blackout_fault_skips_poll_and_staleness_climbs(self):
+        from karpenter_tpu.controllers.market import MARKET_FEED_STALENESS
+
+        harness, feed, controller = build_market()
+        harness.clock.advance(2.0)
+        controller.reconcile()
+        folded = controller.book.last_seq
+        faultpoints.seed(4)
+        faultpoints.arm("market.feed", "blackout", rate=1.0)
+        harness.clock.advance(10.0)
+        controller.reconcile()
+        assert controller.book.last_seq == folded  # nothing delivered
+        assert MARKET_FEED_STALENESS.get() >= 10.0
+        faultpoints.disarm_all()
+        controller.reconcile()  # blackout lifts: history catches us up
+        assert controller.book.last_seq == feed.last_seq > folded
+
+    def test_stale_fault_redelivers_next_sweep(self):
+        harness, feed, controller = build_market()
+        faultpoints.seed(4)
+        faultpoints.arm("market.feed", "stale", rate=1.0)
+        harness.clock.advance(3.0)
+        controller.reconcile()
+        held_back = feed.last_seq - controller.book.last_seq
+        assert held_back > 0  # the newest half was held
+        faultpoints.disarm_all()
+        controller.reconcile()
+        assert controller.book.last_seq == feed.last_seq
+
+    def test_reorder_fault_absorbed_by_sorted_fold(self):
+        """Two controllers over byte-identical feeds — one through a
+        reordering fault — fold to the same book state and generation."""
+        ha, feed_a, ca = build_market(seed=21, threshold=0.02)
+        hb, feed_b, cb = build_market(seed=21, threshold=0.02)
+        faultpoints.seed(4)
+        faultpoints.arm("market.feed", "reorder", rate=1.0)
+        ha.clock.advance(20.0)
+        ca.reconcile()
+        faultpoints.disarm_all()
+        hb.clock.advance(20.0)
+        cb.reconcile()
+        assert feed_a.encode_history() == feed_b.encode_history()
+        assert ca.book.generation == cb.book.generation
+        assert ca.book.fingerprint() == cb.book.fingerprint()
+        for pool in ca.book.pools():
+            assert ca.book.spot_discount(pool) == cb.book.spot_discount(pool)
+
+
+class TestMarketCrashRestart:
+    """market.mid-tick: a controller killed between folded ticks restarts,
+    re-polls the replayable feed from seq 0, and reconstructs the IDENTICAL
+    book state and generation. Re-run on the apiserver backend via
+    tests/test_backend_parity.py."""
+
+    def test_mid_tick_crash_refolds_identically(self):
+        harness, feed, controller = build_market(seed=31, threshold=0.02)
+        harness.clock.advance(15.0)
+        crashpoints.arm("market.mid-tick", at=4)
+        with pytest.raises(SimulatedCrash):
+            controller.reconcile()
+        crashpoints.disarm_all()
+        torn = controller.book.last_seq
+        assert 0 < torn < feed.last_seq  # died mid-fold, partially folded
+
+        # "Restart": a fresh book + controller over the SURVIVING provider
+        # (the feed is the durable history), re-folding from seq 0.
+        restarted = MarketController(
+            harness.cluster,
+            harness.cloud,
+            PriceBook(clock=harness.clock, reprice_threshold=0.02),
+        )
+        restarted.reconcile()
+
+        # Control: the same walk folded straight through, no crash.
+        control_h, control_feed, control = build_market(
+            seed=31, threshold=0.02
+        )
+        control_h.clock.advance(15.0)
+        control.reconcile()
+        assert feed.encode_history() == control_feed.encode_history()
+        assert restarted.book.generation == control.book.generation
+        assert restarted.book.last_seq == control.book.last_seq
+        for pool in control.book.pools():
+            assert restarted.book.spot_discount(
+                pool
+            ) == control.book.spot_discount(pool)
+
+
+class TestCacheInvalidation:
+    def test_stamp_epoch_changes_on_generation_bump(self):
+        """The compiled-envelope cache keys on stamp_epoch(tag): a reprice
+        must change it, a quiet market must not, and None tags (no caching)
+        stay None."""
+        book = PriceBook(clock=FakeClock(), reprice_threshold=0.1)
+        set_active_book(book)
+        tag = (3, 17)
+        book.apply(price_tick(1, discount=0.5))
+        before = stamp_epoch(tag)
+        assert stamp_epoch(tag) == before  # quiet market: stable key
+        assert stamp_epoch(None) is None
+        book.apply(price_tick(2, discount=0.9))  # reprice
+        assert book.generation == 1
+        assert stamp_epoch(tag) != before
+
+    def test_stamp_epoch_passthrough_without_book(self):
+        assert active_book() is None
+        assert stamp_epoch((1, 2)) == (1, 2)
+
+    def test_fleet_cache_invalidates_on_reprice_and_risk(self):
+        """DeviceClusterState.encode_fleet keys on the book's fingerprint:
+        a generation bump (reprice) and a risk_generation bump (observed
+        interruption) each force a rebuild; a quiet market serves the
+        cached fleet."""
+        from karpenter_tpu.controllers.cluster import Cluster
+        from karpenter_tpu.models.cluster_state import DeviceClusterState
+
+        clock = FakeClock()
+        book = PriceBook(clock=clock, reprice_threshold=0.1)
+        set_active_book(book)
+        state = DeviceClusterState(Cluster(clock=clock))
+        catalog = fixtures.default_catalog()
+        constraints = ProvisionerSpec().constraints
+
+        first = state.encode_fleet(catalog, constraints, (), None)
+        assert state.encode_fleet(catalog, constraints, (), None) is first
+        book.apply(price_tick(1, discount=0.5))
+        book.apply(price_tick(2, discount=0.9))  # generation bump
+        second = state.encode_fleet(catalog, constraints, (), None)
+        assert second is not first
+        assert state.encode_fleet(catalog, constraints, (), None) is second
+        book.note_interruption(POOLS[0])  # risk_generation bump
+        assert state.encode_fleet(catalog, constraints, (), None) is not second
+
+
+class TestForecastPenalty:
+    def test_numpy_jax_mirror_bit_identical(self):
+        """The acceptance gate's parity clause: penalize_prices (numpy) and
+        penalize_prices_jnp (jax) agree to the last bit across magnitudes."""
+        rng = np.random.default_rng(9)
+        prices = (rng.uniform(0.01, 64.0, size=257)).astype(np.float32)
+        risks = (
+            np.floor(rng.uniform(0.0, 1.0, size=257) * 32.0) / 32.0
+        ).astype(np.float32)
+        host = forecast.penalize_prices(prices, risks)
+        device = np.asarray(forecast.penalize_prices_jnp(prices, risks))
+        assert host.dtype == device.dtype == np.float32
+        assert np.array_equal(host, device)  # bit-identical, not approx
+
+    def test_penalty_column_shape_and_zero_risk_identity(self):
+        prices = np.array([1.0, 2.0, 4.0], np.float32)
+        zero = np.zeros(3, np.float32)
+        assert np.array_equal(forecast.penalize_prices(prices, zero), prices)
+        column = forecast.penalty_column(prices, np.full(3, 0.5, np.float32))
+        assert np.array_equal(column, prices * 0.5)
+
+    def test_build_fleet_penalizes_spot_prices(self):
+        """A risky pool's type prices out of cheapest: build_fleet's [T]
+        column carries the penalty exactly as forecast.penalize_prices
+        computes it, and with no risk (or no book) is bit-identical to the
+        pre-market behavior."""
+        from karpenter_tpu.ops.encode import build_fleet
+
+        catalog = fixtures.default_catalog()
+        constraints = ProvisionerSpec().constraints
+        baseline = build_fleet(catalog, constraints, pods=[])
+        assert baseline.capacity_type == "spot"
+
+        clock = FakeClock()
+        book = PriceBook(clock=clock)
+        set_active_book(book)
+        calm = build_fleet(catalog, constraints, pods=[])
+        assert np.array_equal(calm.prices, baseline.prices)  # no risk = no-op
+
+        risky = "small-instance-type"
+        for zone in fixtures.ZONES:
+            book.note_interruption((risky, zone))
+        penalized = build_fleet(catalog, constraints, pods=[])
+        index = [it.name for it in penalized.instance_types].index(risky)
+        risk = book.pool_risk((risky, fixtures.ZONES[0]))
+        expected = np.array(baseline.prices)
+        expected[index] = np.float32(
+            baseline.prices[index]
+            + baseline.prices[index]
+            * np.float32(risk)
+            * np.float32(forecast.RISK_PRICE_WEIGHT)
+        )
+        assert np.array_equal(penalized.prices, expected)
+
+    def test_pool_price_matrix_penalizes_risky_pools_only(self):
+        from karpenter_tpu.models.solver import _pool_price_matrix
+        from karpenter_tpu.ops.encode import build_fleet
+
+        catalog = fixtures.default_catalog()
+        constraints = ProvisionerSpec().constraints
+        fleet = build_fleet(catalog, constraints, pods=[])
+        zones, baseline = _pool_price_matrix(fleet)
+
+        book = PriceBook(clock=FakeClock())
+        set_active_book(book)
+        risky = ("small-instance-type", zones[0])
+        book.note_interruption(risky)
+        _, penalized = _pool_price_matrix(fleet)
+        ti = [it.name for it in fleet.instance_types].index(risky[0])
+        assert penalized[ti, 0] > baseline[ti, 0]
+        untouched = np.ones_like(baseline, dtype=bool)
+        untouched[ti, 0] = False
+        assert np.array_equal(penalized[untouched], baseline[untouched])
+        assert np.isinf(penalized).sum() == np.isinf(baseline).sum()
+
+    def test_packing_avoids_risky_pool_before_it_interrupts(self):
+        """End to end through the fused cost dispatch: with the forecast
+        armed, a provision pass routes away from the hazardous (cheapest)
+        type BEFORE any blackout exists. (The greedy FFD baseline is size-
+        windowed and price-blind by reference fidelity — the steering lives
+        in the cost solver's penalized [T] price column.)"""
+        from karpenter_tpu.models.solver import CostSolver
+
+        catalog = [
+            fixtures.cpu_instance("risky.large", cpu=4, mem_gib=16, price=0.2),
+            fixtures.cpu_instance("calm.large", cpu=4, mem_gib=16, price=0.21),
+        ]
+        book = PriceBook(clock=FakeClock())
+        set_active_book(book)
+        for zone in fixtures.ZONES:
+            book.note_interruption(("risky.large", zone))
+            book.note_interruption(("risky.large", zone))
+        harness = Harness(instance_types=catalog, solver=CostSolver())
+        harness.apply_provisioner(
+            Provisioner(name="default", spec=ProvisionerSpec())
+        )
+        harness.provision(fixtures.pod(cpu="2"))
+        nodes = harness.cluster.list_nodes()
+        assert nodes and all(n.instance_type == "calm.large" for n in nodes)
+
+
+class TestSimulatePlanCostExcluded:
+    def test_infeasible_fallback_respects_excluded(self):
+        """Satellite regression: a packing whose EVERY pool is excluded must
+        price at inf, not at its best advertised offering (which silently
+        under-reported storm-time cost)."""
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.cloudprovider.market import simulate_plan_cost
+        from karpenter_tpu.models.solver import GreedySolver
+
+        catalog = [fixtures.cpu_instance("only.large", cpu=8, mem_gib=32)]
+        result = GreedySolver().solve(
+            [fixtures.pod(cpu="2")], catalog, Constraints(), []
+        )
+        assert result.packings
+        every_pool = [
+            ("only.large", zone) for zone in fixtures.ZONES
+        ]
+        healthy = simulate_plan_cost(
+            result, Constraints(), None, fixtures.ZONES
+        )
+        assert np.isfinite(healthy) and healthy > 0
+        blacked_out = simulate_plan_cost(
+            result, Constraints(), None, fixtures.ZONES, excluded=every_pool
+        )
+        assert blacked_out == float("inf")
+
+    def test_partial_exclusion_prices_at_best_survivor(self):
+        from karpenter_tpu.api.provisioner import Constraints
+        from karpenter_tpu.cloudprovider.market import simulate_plan_cost
+        from karpenter_tpu.models.solver import GreedySolver
+
+        catalog = [fixtures.cpu_instance("only.large", cpu=8, mem_gib=32)]
+        result = GreedySolver().solve(
+            [fixtures.pod(cpu="2")], catalog, Constraints(), []
+        )
+        # Exclude every pool in the plan's zone filter; the fallback must
+        # price at the cheapest offering of the SURVIVING zone.
+        excluded = [("only.large", z) for z in fixtures.ZONES[:2]]
+        cost = simulate_plan_cost(
+            result,
+            Constraints(),
+            None,
+            fixtures.ZONES[:2],
+            excluded=excluded,
+        )
+        it = catalog[0]
+        survivor_prices = [
+            o.price
+            for o in it.offerings
+            if ("only.large", o.zone) not in excluded
+        ]
+        nodes = sum(p.node_quantity for p in result.packings)
+        assert cost == pytest.approx(min(survivor_prices) * nodes)
+
+
+class TestDisplacementPdbGateServerTruth:
+    def test_stale_informer_cache_cannot_overspend_the_budget(self):
+        """The market-storm regression: under watch chaos a duplicated
+        pre-displacement event can resurrect a victim's bound state in the
+        informer cache; the displacement gate must count the budget from
+        the SERVER, not the cache, or one drain sweep displaces every
+        replica behind the PDB."""
+        from karpenter_tpu.controllers.errors import PDBViolationError
+
+        harness = Harness(backend="apiserver")
+        harness.apply_provisioner(
+            Provisioner(name="default", spec=ProvisionerSpec())
+        )
+        pods = [fixtures.pod(name=f"guarded-{i}") for i in range(2)]
+        for pod in pods:
+            pod.labels["app"] = "guarded"
+        harness.cluster.apply_pdb("guarded", {"app": "guarded"}, 1)
+        harness.provision(*pods)
+        assert all(
+            p.node_name for p in harness.cluster.list_pods()
+        )
+        # First displacement: allowed (2 healthy - 1 >= minAvailable 1).
+        harness.cluster.reschedule_pod("default", "guarded-0")
+        # Simulate the chaos race: a duplicated stale watch event re-binds
+        # the displaced pod IN THE CACHE ONLY (the server still says
+        # unbound).
+        cached = harness.cluster.try_get_pod("default", "guarded-0")
+        cached.node_name = "phantom-node"
+        # Second displacement must refuse on server truth (1 healthy - 1 <
+        # minAvailable 1) even though the cache claims 2 healthy.
+        with pytest.raises(PDBViolationError):
+            harness.cluster.reschedule_pod("default", "guarded-1")
+        harness.cluster.close()
+
+    def test_restarted_cluster_relists_pdbs(self):
+        """The other market-storm regression: a RESTARTED controller's
+        cluster must re-seed its PDB table from the server — with an empty
+        table every post-restart drain displaces unbudgeted (one
+        interruption sweep took all four replicas behind a PDB down)."""
+        from karpenter_tpu.controllers.errors import PDBViolationError
+        from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+        from karpenter_tpu.kubeapi.chaos import ChaosTransport
+        from tests.fake_apiserver import DirectTransport
+
+        harness = Harness(backend="apiserver")
+        harness.apply_provisioner(
+            Provisioner(name="default", spec=ProvisionerSpec())
+        )
+        pods = [fixtures.pod(name=f"guarded-{i}") for i in range(2)]
+        for pod in pods:
+            pod.labels["app"] = "guarded"
+        harness.cluster.apply_pdb("guarded", {"app": "guarded"}, 2)
+        harness.provision(*pods)
+        # The "restart": a fresh cluster over the surviving apiserver.
+        restarted = ApiServerCluster(
+            KubeClient(
+                ChaosTransport(
+                    DirectTransport(harness.apiserver), clock=harness.clock
+                ),
+                qps=1e6,
+                burst=10**6,
+                clock=harness.clock,
+            ),
+            clock=harness.clock,
+        ).start()
+        try:
+            with pytest.raises(PDBViolationError):
+                restarted.reschedule_pod("default", "guarded-0")
+        finally:
+            restarted.close()
+            harness.cluster.close()
+
+
+class TestLaunchGenerationStamp:
+    def test_launch_flight_record_names_market_generation(self):
+        from karpenter_tpu.utils.obs import RECORDER
+
+        book = PriceBook(clock=FakeClock(), reprice_threshold=0.1)
+        set_active_book(book)
+        book.apply(price_tick(1, discount=0.5))
+        book.apply(price_tick(2, discount=0.9))
+        assert book.generation == 1
+        harness = Harness()
+        harness.apply_provisioner(
+            Provisioner(name="default", spec=ProvisionerSpec())
+        )
+        harness.provision(fixtures.pod())
+        launches = [
+            e
+            for e in RECORDER.snapshot()["events"]
+            if e["kind"] == "launch"
+        ]
+        assert launches
+        assert launches[-1]["market_generation"] == 1
+
+
+class TestRiskDecayRequantization:
+    def test_decay_requantizes_and_bumps_risk_generation(self):
+        """Hazard decay must reach the fleet-cache fingerprint, not just
+        ad-hoc pool_risk() reads: the sweep's requantized_risks() bumps
+        risk_generation on any quantum crossing — including DOWNWARD, for
+        pools that never tick again — so the packer stops paying a stale
+        penalty and the published gauge matches what it pays."""
+        from karpenter_tpu.market.pricebook import INTERRUPTION_HALF_LIFE_S
+
+        clock = FakeClock()
+        book = PriceBook(clock=clock)
+        book.apply(price_tick(1))
+        book.note_interruption(POOLS[0])
+        spiked = book.requantized_risks()[POOLS[0]]
+        assert spiked > 0.0
+        rg = book.risk_generation
+        fp = book.fingerprint()
+        # A sweep with no decay movement is quiet: no generation churn.
+        assert book.requantized_risks()[POOLS[0]] == spiked
+        assert book.risk_generation == rg
+        # Ten half-lives later the hazard is gone; the sweep's read must
+        # requantize to 0 AND invalidate (fingerprint change).
+        clock.advance(10 * INTERRUPTION_HALF_LIFE_S)
+        assert book.requantized_risks()[POOLS[0]] == 0.0
+        assert book.risk_generation > rg
+        assert book.fingerprint() != fp
+        assert not book.has_risk()
+
+    def test_sweep_publishes_decayed_risk(self):
+        """The market sweep's gauge rides the requantizing read: after the
+        hazard decays, a sweep with NO ticks at all (quiet feed) publishes
+        the decayed 0 and invalidates the fingerprint. A feed-free cloud
+        isolates the interruption leg from walk-generated trend noise."""
+        from karpenter_tpu.controllers.market import FORECAST_RISK_SCORE
+        from karpenter_tpu.market.pricebook import INTERRUPTION_HALF_LIFE_S
+
+        harness = Harness()
+        book = PriceBook(clock=harness.clock)
+        controller = MarketController(harness.cluster, harness.cloud, book)
+        pool = catalog_pools(fixtures.default_catalog())[0]
+        book.apply(price_tick(1, pool=pool))
+        book.note_interruption(pool)
+        controller.reconcile()
+        label = f"{pool[0]}/{pool[1]}"
+        assert FORECAST_RISK_SCORE.get(label) > 0.0
+        fp = book.fingerprint()
+        harness.clock.advance(10 * INTERRUPTION_HALF_LIFE_S)
+        controller.reconcile()
+        assert FORECAST_RISK_SCORE.get(label) == 0.0
+        assert book.fingerprint() != fp
+
+
+class TestFeedRebase:
+    def test_attach_rebases_epoch_anchored_feed(self):
+        """A feed built with the default start_at=0.0 attached to a provider
+        whose clock sits at 1e6 must NOT owe a million steps at the first
+        poll — attach re-anchors it to the provider clock."""
+        harness = Harness()
+        feed = MarketFeed(catalog_pools(fixtures.default_catalog()), seed=3)
+        harness.cloud.attach_market_feed(feed)
+        # Only the initial per-pool snapshot exists; its stamps moved to
+        # the provider clock (staleness starts near zero, not at 1e6).
+        snapshot = harness.cloud.poll_market_events()
+        assert {t.at for t in snapshot} == {harness.clock.now()}
+        before = feed.last_seq
+        harness.clock.advance(3.0)
+        ticks = harness.cloud.poll_market_events(after_seq=before)
+        pools = len(catalog_pools(fixtures.default_catalog()))
+        assert 0 < len(ticks) <= 3 * pools + before
+
+    def test_rebase_is_a_noop_once_stepped(self):
+        feed = MarketFeed(POOLS, seed=4, start_at=10.0)
+        feed.advance(12.0)
+        history = feed.encode_history()
+        feed.rebase(500.0)
+        assert feed.encode_history() == history
+
+
+class TestFakeMarketPricingParity:
+    def test_spot_only_zone_keeps_catalog_price(self):
+        """A zone with no on-demand offering has no anchor: the fake must
+        serve the catalog spot price untouched (the EC2 backend's od<=0
+        behavior) — applying the discount to an already-discounted spot
+        price would systematically over-prefer the pool."""
+        from karpenter_tpu.cloudprovider import InstanceType, Offering
+
+        clock = FakeClock()
+        catalog = [
+            InstanceType(
+                name="spotonly.large",
+                capacity={"cpu": 16, "memory": "64Gi", "pods": 110},
+                architecture="amd64",
+                offerings=[
+                    Offering(zone="solo-z", capacity_type="spot", price=0.6)
+                ],
+            )
+        ]
+        cloud = FakeCloudProvider(catalog, clock=clock)
+        book = PriceBook(clock=clock)
+        book.apply(
+            price_tick(1, pool=("spotonly.large", "solo-z"), discount=0.55)
+        )
+        cloud.attach_market(book)
+        it = cloud.get_instance_types()[0]
+        assert [o.price for o in it.offerings] == [0.6]
+
+
+class TestInterruptionHazardDedup:
+    def test_redelivered_event_notes_hazard_once(self):
+        """The interruption feed is at-least-once (a failed ack redelivers);
+        note_interruption is a counted increment, so the ingest dedups it
+        per event id — one physical interruption must not double its
+        hazard contribution."""
+        from karpenter_tpu.api.pods import PodSpec
+        from karpenter_tpu.api.provisioner import Provisioner
+
+        harness = Harness()
+        book = PriceBook(clock=harness.clock)
+        harness.interruption.price_book = book
+        harness.apply_provisioner(Provisioner(name="default"))
+        [pod] = harness.provision(
+            PodSpec(name="hz-pod", unschedulable=True, requests={"cpu": "100m"})
+        )
+        node = harness.expect_scheduled(pod)
+        event = harness.cloud.inject_interruption(node, deadline_in=120.0)
+        harness.interruption._ingest(event)
+        once = book.pool_risk((node.instance_type, node.zone))
+        assert once > 0.0
+        # Redelivery of the SAME event (ack lost): hazard unchanged.
+        rg = book.risk_generation
+        harness.interruption._ingest(event)
+        assert book.pool_risk((node.instance_type, node.zone)) == once
+        assert book.risk_generation == rg
+
+
+class TestClosedPoolPriceGauge:
+    def test_ice_close_drops_the_price_series(self):
+        """An ICE-closed pool advertises NO spot offering: its
+        market_price_dollars series must drop (not freeze at the last
+        price), and the reopen tick republishes it."""
+        from karpenter_tpu.controllers.market import MARKET_PRICE_DOLLARS
+
+        harness, feed, controller = build_market()
+        harness.clock.advance(2.0)
+        controller.reconcile()
+        pool = catalog_pools(fixtures.default_catalog())[0]
+        kind = f"{pool[0]}/{pool[1]}"
+        assert MARKET_PRICE_DOLLARS.get(kind) > 0.0
+        feed.force_ice([pool], close=True)
+        harness.clock.advance(1.0)
+        controller.reconcile()
+        assert MARKET_PRICE_DOLLARS.get(kind) == 0.0  # series dropped
+        feed.force_ice([pool], close=False)
+        harness.clock.advance(1.0)
+        controller.reconcile()
+        assert MARKET_PRICE_DOLLARS.get(kind) > 0.0

@@ -770,7 +770,11 @@ class TestBootWarmup:
             ), "batch stalled behind warmup despite host fallback"
             assert not mgr.ready.is_set()  # still warming
             release.set()
-            assert wait_until(mgr.ready.is_set, timeout=10.0)
+            # After release the warmup thread still runs the break-even
+            # calibration (a cold XLA compile + fetch-floor probes, ~3s on
+            # an idle rig) before flipping ready — give it headroom for a
+            # loaded full-suite run.
+            assert wait_until(mgr.ready.is_set, timeout=30.0)
             assert mgr.warm.is_set()
             assert not solver_models._WARMING_HOST_PREFERENCE.is_set()
         finally:
@@ -803,9 +807,12 @@ class TestBootWarmup:
                 timeout=30.0,
             )
             first_s = _time.perf_counter() - start
-            # Batch window floor is ~1s; a cold compile adds multiple
-            # seconds on top. Warmed, the full pipeline stays under ~3s.
-            assert first_s < 3.0, f"first solve took {first_s:.1f}s"
+            # Batch window floor is ~1s; a COLD compile of this bucket adds
+            # ~10s+ on top (the ladder itself takes ~10s at boot). Warmed,
+            # the pipeline runs ~1-3s idle — the 8s ceiling keeps the
+            # no-compile-on-a-live-batch guard while absorbing loaded-CI
+            # scheduling noise (observed 5.5s under a busy box).
+            assert first_s < 8.0, f"first solve took {first_s:.1f}s"
         finally:
             mgr.stop()
 

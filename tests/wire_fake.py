@@ -199,6 +199,28 @@ class WireFakeTransport(HttpTransport):
             "DescribeInstanceTypeOfferings", params, "instanceTypeOfferingSet", items
         )
 
+    def _do_describe_spot_price_history(self, params) -> HttpResponse:
+        assert params.get("ProductDescription.1") == "Linux/UNIX"
+        import datetime
+
+        items = []
+        for row in self.fake.describe_spot_price_history():
+            stamp = datetime.datetime.fromtimestamp(
+                row.timestamp, datetime.timezone.utc
+            ).isoformat().replace("+00:00", "Z")
+            items.append(
+                "<item>"
+                f"<instanceType>{row.instance_type}</instanceType>"
+                f"<availabilityZone>{row.zone}</availabilityZone>"
+                f"<spotPrice>{row.price}</spotPrice>"
+                "<productDescription>Linux/UNIX</productDescription>"
+                f"<timestamp>{stamp}</timestamp>"
+                "</item>"
+            )
+        return self._paginate(
+            "DescribeSpotPriceHistory", params, "spotPriceHistorySet", items
+        )
+
     def _do_describe_subnets(self, params) -> HttpResponse:
         subnets = self.fake.describe_subnets(self._tag_filters(params))
         items = [

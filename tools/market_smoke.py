@@ -1,0 +1,629 @@
+"""Market capstone (`make market-smoke`): the compound market storm — the
+first scenario that runs every subsystem simultaneously (ROADMAP item 3).
+
+Over the REAL threaded Manager (fake apiserver through ChaosTransport, fake
+cloud with a live seeded MarketFeed), the smoke composes:
+
+1. a **price spike** on every pool the running fleet occupies (scripted
+   through the replayable feed, so it is just ticks): the market sweep folds
+   it, reprices past --reprice-threshold, invalidates the compiled-envelope
+   and fleet caches, and requeues provisioning + consolidation — which answer
+   with a **replace-wave** onto the now-cheaper pools;
+2. racing a **spot-interruption storm** (loaded nodes reclaimed one after
+   another, raising the pools' forecast hazard as they land);
+3. racing an **API fault storm** (latency/reset/timeout/5xx/conflict on
+   every verb, watch duplicates/reorders/tears) plus `market.feed` chaos
+   (stale polls, reordered batches, blackouts);
+4. with the controller process **killed and rebuilt twice mid-storm** — once
+   at `market.mid-tick` (the restarted book re-folds the feed from seq 0),
+   once at `consolidation.after-nominate` (mid replace-wave).
+
+At the end, the oracles:
+
+- realized fleet cost converges within COST_RATIO_CEILING of the post-spike
+  optimum from `simulate_plan_cost` (a fresh solve against the post-spike
+  market);
+- ZERO PDB violations (server-side watch oracle, immune to the torn client
+  streams) and ZERO leaked instances after the GC grace;
+- the flight record is gap-free (dropped == 0) and carries the storm's
+  `reprice` events plus launches stamped with the market generation they
+  were priced under;
+- the p99 pending SLO held (no breach episodes).
+
+Wall-clock waits are real; the FakeClock drives TTL/deadline/market-tick
+logic so backoffs and debounce windows cost no wall time.
+"""
+
+import queue
+import sys
+import threading
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+NODES = 6
+PODS_PER_NODE = 4
+GUARDED = 4
+MIN_AVAILABLE = 2
+INTERRUPTIONS = 2
+INTERRUPTION_DEADLINE_S = 600.0
+SPIKE_FACTOR = 2.0  # clamps at the feed's MAX_DISCOUNT (spot -> ~on-demand)
+COST_RATIO_CEILING = 1.1
+MIN_INJECTED = 40
+SLO_PENDING_P99_S = 240.0
+SLO_TTFL_S = 240.0
+ZONES = ("mz-a", "mz-b")
+
+
+def catalog():
+    """Two same-shape types so the storm is purely a PRICE story: whichever
+    is cheaper on the live market wins the launch ranking, and a spike on
+    the occupied pools makes the other strictly cheaper."""
+    from karpenter_tpu.cloudprovider import InstanceType, Offering
+
+    def instance(name, od_price):
+        return InstanceType(
+            name=name,
+            capacity={"cpu": 16, "memory": "64Gi", "pods": 110},
+            architecture="amd64",
+            offerings=[
+                Offering(zone=z, capacity_type=ct, price=p)
+                for z in ZONES
+                for ct, p in (("on-demand", od_price), ("spot", od_price * 0.6))
+            ],
+        )
+
+    return [instance("exp.large", 0.38), instance("alt.large", 0.42)]
+
+
+def build_process(state):
+    """One 'controller process': fresh ApiServerCluster + Manager over the
+    SURVIVING apiserver + cloud + market feed. The Manager builds its own
+    PriceBook, attaches it to the cloud, and re-folds the feed from seq 0 —
+    a restart reconstructs the exact pre-crash market state and generation."""
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient, RetryPolicy
+    from karpenter_tpu.kubeapi.chaos import ChaosTransport
+    from karpenter_tpu.runtime import Manager
+    from karpenter_tpu.utils.options import Options
+    from tests.fake_apiserver import DirectTransport
+
+    client = KubeClient(
+        ChaosTransport(DirectTransport(state["server"]), clock=state["clock"]),
+        qps=1e6,
+        burst=10**6,
+        clock=state["clock"],
+        retry=RetryPolicy(max_attempts=6, backoff_base_s=0.01, backoff_cap_s=0.1),
+    )
+    client.WATCH_BACKOFF_BASE_S = 0.02
+    client.WATCH_BACKOFF_CAP_S = 0.5
+    cluster = ApiServerCluster(client, clock=state["clock"]).start()
+    manager = Manager(
+        cluster,
+        state["cloud"],
+        Options(
+            cluster_name="market",
+            solver="greedy",
+            leader_election=False,
+            reprice_threshold=0.1,
+            reprice_debounce=1.0,
+            consolidation_cooldown=2.0,
+            slo_pending_p99=SLO_PENDING_P99_S,
+            slo_ttfl=SLO_TTFL_S,
+        ),
+    )
+    manager.start()
+    state["cluster"], state["manager"] = cluster, manager
+
+
+def stop_process(state):
+    state["manager"].stop()
+    state["cluster"].close()
+
+
+def nudge(state):
+    """Advance the fake clock (market ticks, debounce windows, drain
+    deadlines, consolidation cooldowns all pace on it) and pull the periodic
+    sweeps forward so the storm converges in smoke time."""
+    from karpenter_tpu.kubeapi import ApiError, TransportError
+
+    state["clock"].advance(0.5)
+    manager = state["manager"]
+    manager.loops["market"].enqueue("sweep")
+    manager.loops["interruption"].enqueue("sweep")
+    manager.loops["consolidation"].enqueue("sweep")
+    for node in state["cluster"].list_nodes():
+        if not node.ready:
+            node.ready = True
+            node.status_reported_at = state["clock"].now()
+            try:
+                state["cluster"].update_node(node)
+            except (ApiError, TransportError):
+                node.ready = False  # storm ate the heartbeat; next beat
+        manager.loops["node"].enqueue(node.name)
+        manager.loops["termination"].enqueue(node.name)
+    for pod in state["cluster"].list_pods():
+        if pod.is_provisionable():
+            manager.loops["selection"].enqueue((pod.namespace, pod.name))
+
+
+def wait_for(state, predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        nudge(state)
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class PdbOracle:
+    """Every pod event on the SERVER must leave the guarded group at or
+    above minAvailable — the un-mangled truth, not the chaos-torn client."""
+
+    def __init__(self, server, match_labels, min_available):
+        self.server = server
+        self.match = dict(match_labels)
+        self.min = min_available
+        self.violations = []
+        self.q = server.subscribe("pods")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _healthy(self) -> int:
+        _, payload = self.server.handle("GET", "/api/v1/pods")
+        return sum(
+            1
+            for p in payload.get("items", [])
+            if not (p.get("metadata") or {}).get("deletionTimestamp")
+            and (p.get("spec") or {}).get("nodeName")
+            and all(
+                ((p.get("metadata") or {}).get("labels") or {}).get(k) == v
+                for k, v in self.match.items()
+            )
+        )
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            healthy = self._healthy()
+            if healthy < self.min:
+                self.violations.append(healthy)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.server.unsubscribe("pods", self.q)
+
+
+def arm_storms():
+    """The API fault storm (reduced chaos-smoke rates) plus the market
+    feed's own chaos legs. Seeded: the storm replays."""
+    from karpenter_tpu.utils import faultpoints
+
+    faultpoints.seed(1402)
+    for site in faultpoints.REQUEST_SITES:
+        faultpoints.arm(site, "latency", rate=0.04, delay_s=0.02)
+        faultpoints.arm(site, "reset", rate=0.03)
+        faultpoints.arm(site, "timeout", rate=0.02)
+        faultpoints.arm(site, "server-error", rate=0.02)
+    for site in ("api.request.post", "api.request.put", "api.request.patch"):
+        faultpoints.arm(site, "conflict", rate=0.03)
+    faultpoints.arm("watch.event", "duplicate", rate=0.04)
+    faultpoints.arm("watch.event", "reorder", rate=0.04)
+    faultpoints.arm("watch.open", "tear", rate=0.04)
+    faultpoints.arm("market.feed", "stale", rate=0.15)
+    faultpoints.arm("market.feed", "reorder", rate=0.15)
+    faultpoints.arm("market.feed", "blackout", rate=0.1)
+
+
+def build(state):
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.market.feed import MarketFeed, catalog_pools
+    from karpenter_tpu.utils.clock import FakeClock
+    from tests.fake_apiserver import FakeApiServer
+
+    state["clock"] = FakeClock()
+    state["server"] = FakeApiServer(clock=state["clock"], history_limit=65536)
+    state["cloud"] = FakeCloudProvider(
+        instance_types=catalog(), clock=state["clock"]
+    )
+    state["feed"] = MarketFeed(
+        catalog_pools(catalog()),
+        seed=1402,
+        start_at=state["clock"].now(),
+        tick_interval_s=1.0,
+    )
+    state["cloud"].attach_market_feed(state["feed"])
+    build_process(state)
+    state["cluster"].apply_provisioner(
+        Provisioner(name="default", spec=ProvisionerSpec())
+    )
+
+
+def load(state):
+    from tests import fixtures
+
+    pods = fixtures.pods(NODES * PODS_PER_NODE, cpu="4")
+    for pod in pods[:GUARDED]:
+        pod.labels["app"] = "guarded"
+    state["cluster"].apply_pdb("guarded", {"app": "guarded"}, MIN_AVAILABLE)
+    for pod in pods:
+        state["cluster"].apply_pod(pod)
+
+    def all_bound():
+        live = state["cluster"].list_pods()
+        return len(live) == len(pods) and all(
+            p.node_name is not None for p in live
+        )
+
+    wait_for(state, all_bound, 30.0, "initial fleet to bind")
+    return pods
+
+
+def crash_and_restart(state, site, at=1):
+    from karpenter_tpu.utils import crashpoints
+
+    crashpoints.arm(site, at=at)
+    wait_for(
+        state,
+        lambda: site not in crashpoints.armed(),
+        20.0,
+        f"crashpoint {site} to fire",
+    )
+    crashpoints.disarm_all()
+    print(f"  killed at {site}; restarting the controller process")
+    stop_process(state)
+    build_process(state)
+
+
+def spike(state):
+    """The price spike, scripted through the feed on every pool of every
+    occupied TYPE (so the replace-wave must cross types, not just zones) —
+    recorded as ordinary ticks, so the restarted book re-folds it too."""
+    pools = sorted(
+        {
+            (n.instance_type, zone)
+            for n in state["cluster"].list_nodes()
+            for zone in ZONES
+        }
+    )
+    state["feed"].force_spike(pools, SPIKE_FACTOR)
+    book = state["manager"].price_book
+    before = book.generation
+
+    def repriced():
+        return book.generation > before
+
+    wait_for(state, repriced, 20.0, "the spike to reprice the book")
+    print(
+        f"  spiked {len(pools)} occupied pool(s); book generation "
+        f"{book.generation}"
+    )
+    return pools
+
+
+def interruption_storm(state, interrupted):
+    """Reclaim loaded nodes one after another. The SECOND victim's drain is
+    where crash 2 lands: `interruption.mid-drain` is armed before the event
+    is injected, so the kill is deterministic — the restarted controller
+    resumes the drain from the annotated intent."""
+    from karpenter_tpu.utils import crashpoints
+
+    crashes = 0
+    for round_index in range(INTERRUPTIONS):
+        victims = [
+            n
+            for n in sorted(
+                state["cluster"].list_nodes(), key=lambda n: n.name
+            )
+            if n.deletion_timestamp is None
+            and n.name not in interrupted
+            and state["cluster"].list_pods(node_name=n.name)
+        ]
+        if not victims:
+            break
+        victim = victims[0]
+        interrupted.add(victim.name)
+        if round_index == 1:
+            crashpoints.arm("interruption.mid-drain")
+        state["cloud"].inject_interruption(
+            victim, deadline_in=INTERRUPTION_DEADLINE_S
+        )
+        if round_index == 1:
+            wait_for(
+                state,
+                lambda: "interruption.mid-drain" not in crashpoints.armed(),
+                20.0,
+                "crashpoint interruption.mid-drain to fire",
+            )
+            crashpoints.disarm_all()
+            print("  killed at interruption.mid-drain; restarting the "
+                  "controller process")
+            stop_process(state)
+            build_process(state)
+            crashes += 1
+
+        def reclaimed(name=victim.name):
+            server_nodes = {
+                key[1] for key in state["server"]._objects.get("nodes", {})
+            }
+            return name not in server_nodes
+
+        wait_for(state, reclaimed, 45.0, f"reclaim of {victim.name}")
+        print(f"  interrupted + reclaimed {victim.name}")
+    return crashes
+
+
+def live_market(state):
+    return state["manager"].price_book.market()
+
+
+def realized_cost(state) -> float:
+    """What the CURRENT fleet costs per hour on the post-spike market."""
+    market = live_market(state)
+    statics = {it.name: it for it in catalog()}
+    total = 0.0
+    for node in state["cluster"].list_nodes():
+        if node.deletion_timestamp is not None:
+            continue
+        it = statics[node.instance_type]
+        od = next(
+            o.price
+            for o in it.offerings
+            if o.zone == node.zone and o.capacity_type == "on-demand"
+        )
+        if node.capacity_type == "spot":
+            total += market.spot_price((node.instance_type, node.zone), od)
+        else:
+            total += od
+    return total
+
+
+def optimum_cost(state) -> float:
+    """The post-spike optimum: a fresh solve of the whole workload against
+    the live catalog, priced by the fleet-allocation simulator against the
+    book's market — the capstone's cost oracle."""
+    from karpenter_tpu.api.provisioner import Constraints
+    from karpenter_tpu.cloudprovider.market import simulate_plan_cost
+    from karpenter_tpu.models.solver import GreedySolver
+
+    pods = [p for p in state["cluster"].list_pods()]
+    result = GreedySolver().solve(
+        pods, state["cloud"].get_instance_types(), Constraints(), []
+    )
+    assert not result.unschedulable, "cost oracle could not place every pod"
+    return simulate_plan_cost(
+        result, Constraints(), live_market(state), ZONES
+    )
+
+
+def wait_cost_converged(state):
+    """The replace-wave's finish line: consolidation keeps swapping spiked
+    capacity for the now-cheaper pools (one node per sweep) until the live
+    fleet prices within COST_RATIO_CEILING of the post-spike optimum."""
+    last = [None]
+
+    def converged():
+        optimum = optimum_cost(state)
+        realized = realized_cost(state)
+        last[0] = (realized, optimum)
+        bound = all(
+            p.node_name is not None for p in state["cluster"].list_pods()
+        )
+        return bound and realized <= COST_RATIO_CEILING * optimum
+
+    try:
+        wait_for(state, converged, 90.0, "cost convergence")
+    except AssertionError:
+        realized, optimum = last[0] or (float("nan"), float("nan"))
+        raise AssertionError(
+            f"cost never converged: realized ${realized:.4f}/hr vs "
+            f"post-spike optimum ${optimum:.4f}/hr "
+            f"(ratio {realized / optimum:.3f} > {COST_RATIO_CEILING})"
+        )
+    realized, optimum = last[0]
+    print(
+        f"  cost converged: realized ${realized:.4f}/hr vs optimum "
+        f"${optimum:.4f}/hr (ratio {realized / optimum:.3f} <= "
+        f"{COST_RATIO_CEILING})"
+    )
+    return realized, optimum
+
+
+def apply_with_retry(state, pod, attempts=30):
+    from karpenter_tpu.kubeapi import ApiError, TransportError
+
+    for _ in range(attempts):
+        try:
+            return state["cluster"].apply_pod(pod)
+        except (ApiError, TransportError):
+            time.sleep(0.02)
+    raise AssertionError(f"apply of {pod.name} never landed under the storm")
+
+
+def sustain(state, extras):
+    """Keep arrival waves riding the armed storm (binding onto the POST-
+    spike market) until the fault count proves it was sustained."""
+    from karpenter_tpu.utils import faultpoints
+    from tests import fixtures
+
+    wave = 0
+    while faultpoints.total_fired() < MIN_INJECTED and wave < 10:
+        names = [f"wave{wave}-{i}" for i in range(6)]
+        for name in names:
+            extra = fixtures.pod(cpu="2", name=name)
+            apply_with_retry(state, extra)
+            extras.append(extra)
+
+        def wave_bound():
+            _, payload = state["server"].handle("GET", "/api/v1/pods")
+            by_name = {
+                p["metadata"]["name"]: p for p in payload.get("items", [])
+            }
+            return all(
+                (by_name.get(n, {}).get("spec") or {}).get("nodeName")
+                for n in names
+            )
+
+        wait_for(state, wave_bound, 30.0, f"sustain wave {wave} to bind")
+        wave += 1
+    print(f"  sustained: {faultpoints.total_fired()} faults injected")
+
+
+def wait_converged(state, expected_pods):
+    server = state["server"]
+
+    def converged():
+        _, payload = server.handle("GET", "/api/v1/pods")
+        items = payload.get("items", [])
+        if len(items) != expected_pods:
+            return False
+        _, node_payload = server.handle("GET", "/api/v1/nodes")
+        live = {
+            (n.get("metadata") or {}).get("name")
+            for n in node_payload.get("items", [])
+            if not (n.get("metadata") or {}).get("deletionTimestamp")
+        }
+        return (
+            all((p.get("spec") or {}).get("nodeName") in live for p in items)
+            and state["cloud"].poll_interruptions() == []
+        )
+
+    wait_for(state, converged, 45.0, "post-storm convergence")
+
+
+def assert_flight_record(state):
+    """Gap-free, and carrying the market storm's forensics: reprice events
+    with pool/old/new/generation, launches stamped with market_generation."""
+    from karpenter_tpu.utils.obs import RECORDER
+
+    flight = RECORDER.snapshot()
+    assert flight["dropped"] == 0, (
+        f"flight recorder dropped {flight['dropped']} events — gaps"
+    )
+    seqs = [e["seq"] for e in flight["events"]]
+    assert seqs == list(range(1, flight["seq"] + 1)), "seq gap in the ring"
+    reprices = _checked_reprices(flight["events"])
+    launches = [e for e in flight["events"] if e["kind"] == "launch"]
+    assert launches, "no launch decisions flight-recorded"
+    stamped = [
+        e for e in launches if e.get("market_generation") is not None
+    ]
+    assert stamped, "no launch carries the market generation it priced under"
+    return len(reprices), len(stamped)
+
+
+def _checked_reprices(events):
+    reprices = [e for e in events if e["kind"] == "reprice"]
+    assert reprices, "the price storm never flight-recorded a reprice"
+    for event in reprices:
+        for field in ("pool", "reason", "old_discount", "new_discount",
+                      "generation", "affected"):
+            assert field in event, f"reprice event missing {field!r}"
+    return reprices
+
+
+def assert_slo_held(state):
+    from karpenter_tpu.utils.obs import OBS
+
+    snapshot = OBS.slo_snapshot()
+    p99 = snapshot["pending"]["p99"]
+    assert OBS.evaluator.breaches == {}, (
+        f"SLO breached under the storm: {OBS.evaluator.breaches} "
+        f"(pending p99 {p99:.1f}s vs target {SLO_PENDING_P99_S}s)"
+    )
+    return p99
+
+
+def assert_no_leaks_after_grace(state):
+    from karpenter_tpu.controllers.instancegc import LAUNCH_GRACE_SECONDS
+
+    manager = state["manager"]
+    stop_process(state)
+    state["clock"].advance(LAUNCH_GRACE_SECONDS + 1)
+    manager.instancegc.reconcile()
+    manager.instancegc.reconcile()
+    leaked = set(state["cloud"].instances) - {
+        n.provider_id for n in state["cluster"].list_nodes()
+    }
+    assert not leaked, f"leaked instances after GC grace: {sorted(leaked)}"
+
+
+def main() -> int:
+    from karpenter_tpu.utils import faultpoints
+
+    began = time.time()
+    state = {}
+    try:
+        build(state)
+        pods = load(state)
+        print(
+            f"market-smoke: {len(pods)} pods bound on "
+            f"{len(state['cluster'].list_nodes())} nodes; arming the fault "
+            "storm, spiking the market, starting the interruption storm"
+        )
+        state["oracle"] = PdbOracle(
+            state["server"], {"app": "guarded"}, MIN_AVAILABLE
+        )
+        arm_storms()
+        # Crash 1: kill the controller mid-market-fold — the restarted book
+        # re-folds the (spiked) feed from seq 0.
+        spiked_pools = spike(state)
+        crash_and_restart(state, "market.mid-tick", at=3)
+
+        def respiked():
+            book = state["manager"].price_book
+            return book.generation > 0 and book.last_seq > 0
+
+        wait_for(state, respiked, 20.0, "the restarted book to re-fold")
+        # Crash 2 lands inside the interruption storm: the second victim's
+        # drain is killed at interruption.mid-drain and the rebuilt process
+        # resumes it — while the replace-wave races on the repriced market.
+        interrupted = set()
+        crashes = 1 + interruption_storm(state, interrupted)
+        assert crashes >= 2, f"needed >=2 mid-storm crashes, got {crashes}"
+        realized, optimum = wait_cost_converged(state)
+        extras = []
+        sustain(state, extras)
+        injected = faultpoints.total_fired()
+        assert injected >= MIN_INJECTED, (
+            f"the storm barely stormed ({injected} faults)"
+        )
+        faultpoints.disarm_all()  # quiet skies for the convergence audit
+        wait_converged(state, len(pods) + len(extras))
+        for name, loop in state["manager"].loops.items():
+            assert loop._threads and all(
+                t.is_alive() for t in loop._threads
+            ), f"sweep loop {name!r} has a dead worker thread at exit"
+        state["oracle"].stop()
+        assert state["oracle"].violations == [], (
+            f"PDB dipped below minAvailable: {state['oracle'].violations}"
+        )
+        reprices, stamped = assert_flight_record(state)
+        pending_p99 = assert_slo_held(state)
+        assert_no_leaks_after_grace(state)
+    except AssertionError as failure:
+        print(f"market-smoke: FAIL in {time.time() - began:.1f}s: {failure}")
+        return 1
+    print(
+        f"market-smoke: OK in {time.time() - began:.1f}s "
+        f"(spiked {len(spiked_pools)} pools, {len(interrupted)} reclaims, "
+        f"{injected} injected faults, 2 mid-storm crash+restarts; realized "
+        f"${realized:.4f}/hr vs post-spike optimum ${optimum:.4f}/hr = "
+        f"{realized / optimum:.3f}x <= {COST_RATIO_CEILING}x; "
+        f"{reprices} reprice events + {stamped} generation-stamped launches "
+        f"in a gap-free flight record; 0 PDB violations, 0 leaked "
+        f"instances; pending p99 {pending_p99:.1f}s inside the "
+        f"{SLO_PENDING_P99_S:.0f}s SLO)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
