@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke ha-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -139,6 +139,19 @@ obs-smoke:
 market-smoke:
 	timeout -k 10 180 python tools/market_smoke.py
 
+# The HA leader-kill storm (tools/ha_smoke.py): two replicas (leader-elected
+# active + warm standby) over one fake apiserver through an arrival/
+# interruption/API-fault storm, with the leader SIGKILLed at rotating
+# crashpoints twice (leader.before-renew, then the successor at
+# leader.after-acquire — a dead process holding a fresh lease) and
+# separately PAUSED past the lease TTL, plus bounded lease.cas flaps on the
+# lease verb itself. Asserts every takeover inside TTL+grace, every pod
+# bound exactly once with zero double-launches, zero PDB violations, zero
+# leaked instances, the stale leader's writes refused by the write fence,
+# and the full acquire/takeover/lose/fence-reject flight record.
+ha-smoke:
+	timeout -k 10 240 python tools/ha_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -154,6 +167,7 @@ smoke:
 	$(MAKE) constraints-smoke || rc=1; \
 	$(MAKE) obs-smoke || rc=1; \
 	$(MAKE) market-smoke || rc=1; \
+	$(MAKE) ha-smoke || rc=1; \
 	exit $$rc
 
 proto:

@@ -86,18 +86,33 @@ def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     # real coordination.k8s.io Lease and lifts that restriction.
     file_lock = LeaderLock()
     elector = LeaderElector(cluster, identity, on_lost=on_lost_lease)
-    if options.leader_election:
-        log.info("campaigning for leadership as %s", identity)
-        file_lock.acquire(blocking=True)
-        elector.acquire(blocking=True)
-        holder = cluster.get_lease(LeaderElector.LEASE_NAME)
-        log.info("leadership acquired; lease holder %s", holder and holder[0])
-
-    manager.start()
+    # Probe + metrics servers come up BEFORE the campaign: a campaigning
+    # standby must answer /healthz 200 and /readyz 503 "standby", or the
+    # liveness probe kills every replica that isn't currently leader and
+    # there is never a warm standby to fail over to.
     serve_http(manager, options.metrics_port)
     # Separate probe port, matching the reference's split (manager.go:52-57)
     # and the chart's liveness/readiness wiring.
     serve_http(manager, options.health_probe_port)
+    if options.leader_election:
+        log.info("campaigning for leadership as %s", identity)
+        # Warm standby while waiting: watch pump + informer cache +
+        # DeviceClusterState sync are already live (cluster built above);
+        # this pre-pays the solver compile debt so takeover has bounded
+        # time-to-first-launch.
+        manager.start_standby()
+        file_lock.acquire(blocking=True)
+        campaign_began = cluster.clock.now()
+        elector.acquire(blocking=True)
+        lease = cluster.get_lease(LeaderElector.LEASE_NAME)
+        log.info(
+            "leadership acquired after %.1fs; holder %s generation %s",
+            cluster.clock.now() - campaign_began,
+            lease and lease[0],
+            elector.generation,
+        )
+
+    manager.start()
     log.info(
         "controller ready: metrics on :%d, health on :%d, solver=%s, cloud=%s",
         options.metrics_port,
@@ -109,6 +124,18 @@ def main(argv=None, cluster: Cluster = None, block: bool = True) -> Manager:
     if block:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+        def on_sighup(*_):
+            # Live reload of the RELOADABLE subset (log level, SLO targets):
+            # re-parse the original argv, which re-reads env fallbacks too.
+            try:
+                fresh = options_pkg.parse(argv)
+            except Exception:  # noqa: BLE001 — a bad env edit must not kill us
+                log.exception("SIGHUP reload failed; keeping current options")
+                return
+            manager.reload_options(options_pkg.apply_reload(options, fresh))
+
+        signal.signal(signal.SIGHUP, on_sighup)
         stop.wait()
         manager.stop()
         elector.release()

@@ -19,6 +19,7 @@ from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.cloudprovider import NodeSpec
 from karpenter_tpu.utils.clock import Clock, SYSTEM_CLOCK
+from karpenter_tpu.utils.fence import WriteFence
 
 PodKey = Tuple[str, str]  # (namespace, name)
 
@@ -54,9 +55,22 @@ class Cluster:
         self._provisioners: Dict[str, Provisioner] = {}  # vet: guarded-by(self._lock)
         self._daemonsets: Dict[str, PodSpec] = {}  # vet: guarded-by(self._lock) — name -> pod template
         self._pdbs: Dict[str, Tuple[Dict[str, str], int]] = {}  # vet: guarded-by(self._lock) — selector, minAvailable
-        self._leases: Dict[str, Tuple[str, float]] = {}  # vet: guarded-by(self._lock) — name -> (holder, expiry)
+        self._leases: Dict[str, Tuple[str, float, int]] = {}  # vet: guarded-by(self._lock) — name -> (holder, expiry, transitions)
         self._watchers: List[Callable[[str, object], None]] = []
         self._delta_watchers: List[Callable[[str, str, object], None]] = []
+        # Write fence: armed with the lease generation by the LeaderElector,
+        # revoked the instant leadership is lost. Standalone in-memory this
+        # store IS the shared state, so every origin write is fenced here;
+        # the apiserver backend flips _fence_is_store off because this layer
+        # is then only the informer cache — a deposed leader's watch pump
+        # must keep syncing it — and moves the fence to the write-through
+        # verbs (kubeapi/cluster.py).
+        self.fence = WriteFence()
+        self._fence_is_store = True
+
+    def _fence_check(self, verb: str) -> None:
+        if self._fence_is_store:
+            self.fence.check(verb)
 
     # --- watch plumbing ----------------------------------------------------
 
@@ -89,6 +103,7 @@ class Cluster:
     # --- pods --------------------------------------------------------------
 
     def apply_pod(self, pod: PodSpec) -> PodSpec:
+        self._fence_check("apply_pod")
         with self._lock:
             if pod.created_at is None:
                 # Stamp creationTimestamp on first apply; an update arriving
@@ -137,6 +152,7 @@ class Cluster:
         return pods
 
     def bind_pod(self, pod: PodSpec, node: NodeSpec) -> None:
+        self._fence_check("bind_pod")
         with self._lock:
             stored = self._pods.get((pod.namespace, pod.name))
             if stored is None:
@@ -152,6 +168,7 @@ class Cluster:
         semantics): a same-name pod re-created since the caller observed the
         victim is left alone (compare-and-pop under the lock). Returns True
         iff this call removed the pod."""
+        self._fence_check("delete_pod")
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -165,6 +182,7 @@ class Cluster:
     def evict_pod(self, namespace: str, name: str) -> None:
         """Eviction-API analogue: honors PDBs (429-equivalent refusal)
         (ref: termination/eviction.go:90-109)."""
+        self._fence_check("evict_pod")
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -188,6 +206,7 @@ class Cluster:
         deadline-escalation path, which prefers a budget violation over
         losing the pod uncleanly). Returns the displaced pod, or None when it
         no longer exists; a pod already unbound is returned unchanged."""
+        self._fence_check("reschedule_pod")
         pod = self.try_get_pod(namespace, name)
         if pod is None or pod.node_name is None:
             return pod
@@ -220,6 +239,7 @@ class Cluster:
     # --- pod disruption budgets (simplified) --------------------------------
 
     def apply_pdb(self, name: str, match_labels: Dict[str, str], min_available: int):
+        self._fence_check("apply_pdb")
         with self._lock:
             self._pdbs[name] = (dict(match_labels), min_available)
 
@@ -257,6 +277,7 @@ class Cluster:
         a silent overwrite — the provisioning adopt-on-409 path depends on
         creates failing loudly. Remote-sourced state (watch events) goes
         through `apply_node` instead."""
+        self._fence_check("create_node")
         with self._lock:
             if node.name in self._nodes:
                 raise AlreadyExistsError(f"node {node.name} already exists")
@@ -297,6 +318,7 @@ class Cluster:
         return nodes
 
     def update_node(self, node: NodeSpec) -> None:
+        self._fence_check("update_node")
         self._notify("node", node, verb="update")
 
     def remove_node_annotation(self, node: NodeSpec, key: str) -> None:
@@ -305,6 +327,7 @@ class Cluster:
         the annotations map, and RFC 7386 keeps server keys absent from the
         patch — the popped key would resurrect through the watch pump. The
         apiserver override patches the key to null explicitly."""
+        self._fence_check("remove_node_annotation")
         with self._lock:
             node.annotations.pop(key, None)
         self._notify("node", node, verb="update")
@@ -312,6 +335,7 @@ class Cluster:
     def delete_node(self, name: str) -> None:
         """Marks deletion; the object lingers while finalizers remain
         (ref: the apiserver finalizer protocol driving termination §3.4)."""
+        self._fence_check("delete_node")
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
@@ -324,6 +348,7 @@ class Cluster:
         self._notify("node", node, verb="delete" if removed else "update")
 
     def remove_finalizer(self, node: NodeSpec, finalizer: str) -> None:
+        self._fence_check("remove_finalizer")
         with self._lock:
             if finalizer in node.finalizers:
                 node.finalizers.remove(finalizer)
@@ -335,6 +360,7 @@ class Cluster:
     # --- provisioners ------------------------------------------------------
 
     def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:
+        self._fence_check("apply_provisioner")
         with self._lock:
             self._provisioners[provisioner.name] = provisioner
         self._notify("provisioner", provisioner)
@@ -360,9 +386,11 @@ class Cluster:
         In-memory the object IS the store so this only notifies; the
         apiserver backend PATCHes the CRD status subresource — controllers
         must route status writes through here to survive either backend."""
+        self._fence_check("update_provisioner_status")
         self._notify("provisioner", provisioner)
 
     def delete_provisioner(self, name: str) -> None:
+        self._fence_check("delete_provisioner")
         with self._lock:
             provisioner = self._provisioners.pop(name, None)
         if provisioner is not None:
@@ -372,6 +400,7 @@ class Cluster:
     # --- daemonsets ---------------------------------------------------------
 
     def apply_daemonset(self, name: str, pod_template: PodSpec) -> None:
+        self._fence_check("apply_daemonset")
         with self._lock:
             self._daemonsets[name] = pod_template
         self._notify("daemonset", pod_template)
@@ -382,33 +411,66 @@ class Cluster:
 
     # --- leases (coordination.k8s.io Lease analogue) -----------------------
 
-    def acquire_lease(self, name: str, holder: str, duration_s: float) -> bool:
+    def acquire_lease(
+        self,
+        name: str,
+        holder: str,
+        duration_s: float,
+        *,
+        transitions: Optional[int] = None,
+    ) -> int:
         """Compare-and-swap acquire/renew: succeeds when the lease is free,
         expired, or already held by `holder` (renewal). The store-side
         analogue of the Lease object the reference's leader election uses
-        (ref: cmd/controller/main.go:80-81)."""
+        (ref: cmd/controller/main.go:80-81).
+
+        Returns the lease's ``transitions`` counter (>= 1) on success and 0
+        on a lost CAS, so callers keep their old truthiness checks while the
+        elector learns its generation. The counter bumps only on a holder
+        CHANGE (kube leaseTransitions semantics): renewals — and a holder
+        re-acquiring its own expired or committed-then-lost lease — keep the
+        prior value, which is what makes the generation a fencing token: it
+        moves exactly when writes may have interleaved with a rival's.
+
+        ``transitions`` (keyword-only) lets the apiserver backend mirror the
+        SERVER's committed counter into this cache instead of recomputing it
+        locally — the mirror must never drift from the store of record.
+        """
         with self._lock:
             now = self.clock.now()
             current = self._leases.get(name)
+            prior_holder: Optional[str] = None
+            prior_transitions = 0
             if current is not None:
-                current_holder, expiry = current
-                if current_holder != holder and now < expiry:
-                    return False
-            self._leases[name] = (holder, now + duration_s)
-            return True
+                prior_holder, expiry, prior_transitions = current
+                if prior_holder != holder and now < expiry:
+                    return 0
+            if transitions is not None:
+                committed = int(transitions)
+            elif prior_holder == holder:
+                committed = prior_transitions
+            else:
+                committed = prior_transitions + 1
+            self._leases[name] = (holder, now + duration_s, committed)
+            return committed
 
     def release_lease(self, name: str, holder: str) -> bool:
         with self._lock:
             current = self._leases.get(name)
             if current is None or current[0] != holder:
                 return False
-            del self._leases[name]
+            # Keep the transitions counter under the tombstoned name so the
+            # next holder still observes a bump — dropping it would reissue
+            # generation 1 and alias the first holder's fence token.
+            _, _, prior_transitions = current
+            self._leases[name] = ("", 0.0, prior_transitions)
             return True
 
-    def get_lease(self, name: str) -> Optional[Tuple[str, float]]:
-        """(holder, expiry) or None; expired leases read as None."""
+    def get_lease(self, name: str) -> Optional[Tuple[str, float, int]]:
+        """(holder, expiry, transitions) or None; expired or released leases
+        read as None."""
         with self._lock:
             current = self._leases.get(name)
-            if current is None or self.clock.now() >= current[1]:
+            if current is None or not current[0] or self.clock.now() >= current[1]:
                 return None
             return current

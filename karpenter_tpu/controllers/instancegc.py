@@ -103,6 +103,10 @@ class InstanceGcController:
                 next_suspects[provider_id] = first_seen
                 continue
             try:
+                # Fenced like every other provider mutation: a deposed
+                # leader must not reap capacity the successor may have just
+                # registered (utils/fence.py).
+                self.cluster.fence.check("cloud.terminate")
                 self.cloud.terminate_instance(instance)
             except Exception:  # noqa: BLE001 — transient provider failure:
                 # STAY a suspect so the very next sweep retries.

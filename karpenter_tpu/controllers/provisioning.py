@@ -468,7 +468,9 @@ class ProvisionerWorker:
             return False
 
     @staticmethod
-    def _launch_identity(provisioner_name: str, packing) -> str:
+    def _launch_identity(
+        provisioner_name: str, packing, lease_generation=None
+    ) -> str:
         """Stable identity of one logical launch, derived from the batch
         CONTENT: (provisioner, node count, the sorted uids of every pod the
         packing serves, and WHAT is being bought — the instance-type options
@@ -492,7 +494,14 @@ class ProvisionerWorker:
         displaced pods must NOT alias the purchase that backed their dying
         node — with a bare uid it would, and the provider's idempotent
         replay would adopt the reclaimed instance and rebind the pods onto
-        the very node being drained."""
+        the very node being drained.
+
+        `lease_generation` (the write fence's leaseTransitions value, None
+        when leader election is off) folds leadership into the token: a
+        stale leader re-solving the same pods under its OLD generation can
+        neither alias nor adopt the successor's purchase — its orphan is
+        the leaked-capacity GC's job, like any other cross-identity
+        orphan."""
         from karpenter_tpu.controllers.cluster import reschedule_epoch
 
         pod_uids = sorted(
@@ -511,6 +520,11 @@ class ProvisionerWorker:
             + type_names
             + ["pools"]
             + pools
+            + (
+                ["lease-gen", str(lease_generation)]
+                if lease_generation is not None
+                else []
+            )
         )
         return hashlib.sha256(payload.encode()).hexdigest()[:32]
 
@@ -541,7 +555,14 @@ class ProvisionerWorker:
                 stats.launched_nodes += 1
                 stats.scheduled_pods += len(pods)
 
-            launch_id = self._launch_identity(self.provisioner.name, packing)
+            # Fence the purchase itself: the cloud provider is outside the
+            # store, so the deposed-leader check runs here, at the caller —
+            # and the launch identity carries the generation so even a check
+            # that races the revocation can't alias the successor's token.
+            self.cluster.fence.check("cloud.create")
+            launch_id = self._launch_identity(
+                self.provisioner.name, packing, self.cluster.fence.generation
+            )
             # The flight-recorder's launch decision: WHAT is being bought
             # (first-choice type + price), for whom, under which idempotency
             # token — the record a breach/crash dump correlates against.

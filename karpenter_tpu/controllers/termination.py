@@ -166,6 +166,9 @@ class Terminator:
 
     def terminate(self, node: NodeSpec) -> None:
         """Cloud delete then strip the finalizer (ref: terminate.go:84-100)."""
+        # The provider call is outside the store, so the deposed-leader
+        # fence check runs here at the caller (utils/fence.py).
+        self.cluster.fence.check("cloud.delete")
         self.cloud.delete(node)
         self.cluster.remove_finalizer(node, wellknown.TERMINATION_FINALIZER)
         started = self._drain_started.pop(node.name, None)

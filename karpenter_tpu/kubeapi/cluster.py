@@ -32,6 +32,7 @@ from karpenter_tpu.controllers.errors import PDBViolationError
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.kubeapi import convert
 from karpenter_tpu.kubeapi.client import ApiError, KubeClient
+from karpenter_tpu.utils import faultpoints
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.clock import Clock
 
@@ -92,6 +93,11 @@ class ApiServerCluster(Cluster):
         self._stop = threading.Event()
         self._threads: list = []
         self.resync_count = 0  # 410-triggered re-LISTs (observability + tests)
+        # On this backend the inherited store is ONLY the informer cache —
+        # the watch pump must keep syncing it even for a deposed leader —
+        # so the write fence moves from the base verbs to the write-through
+        # verbs below (checked before the remote call goes out).
+        self._fence_is_store = False
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -366,6 +372,7 @@ class ApiServerCluster(Cluster):
         return self.api.update(obj_path, body)
 
     def apply_pod(self, pod: PodSpec) -> PodSpec:
+        self.fence.check("apply_pod")
         created = self._create_or_update(
             _pod_path(pod.namespace),
             _pod_path(pod.namespace, pod.name),
@@ -375,6 +382,7 @@ class ApiServerCluster(Cluster):
         return super().apply_pod(pod)
 
     def bind_pod(self, pod: PodSpec, node: NodeSpec) -> None:
+        self.fence.check("bind_pod")
         # The actual Binding RPC the reference issues per pod
         # (provisioner.go:239-247 → coreV1Client.Pods(...).Bind).
         try:
@@ -403,6 +411,7 @@ class ApiServerCluster(Cluster):
     def delete_pod(
         self, namespace: str, name: str, uid: Optional[str] = None
     ) -> bool:
+        self.fence.check("delete_pod")
         try:
             self.api.delete(_pod_path(namespace, name), uid=uid)
         except ApiError as error:
@@ -420,6 +429,7 @@ class ApiServerCluster(Cluster):
     def evict_pod(self, namespace: str, name: str) -> None:
         """POST the Eviction subresource; the apiserver enforces PDBs and
         answers 429 (ref: termination/eviction.go:90-109)."""
+        self.fence.check("evict_pod")
         try:
             self.api.create(
                 _pod_path(namespace, name) + "/eviction",
@@ -441,6 +451,7 @@ class ApiServerCluster(Cluster):
             self._notify("pod", pod, verb="update")
 
     def reschedule_pod(self, namespace: str, name: str, override_pdb: bool = False):
+        self.fence.check("reschedule_pod")
         # One displacement in flight at a time: the server-truth gate below
         # reads a fresh LIST, and two concurrent drains passing on the same
         # healthy count would jointly overspend the budget. The gate runs
@@ -551,6 +562,7 @@ class ApiServerCluster(Cluster):
         return super()._reschedule_local(namespace, name)
 
     def apply_pdb(self, name: str, match_labels, min_available: int):
+        self.fence.check("apply_pdb")
         path = "/apis/policy/v1/namespaces/default/poddisruptionbudgets"
         body = {
             "apiVersion": "policy/v1",
@@ -567,6 +579,7 @@ class ApiServerCluster(Cluster):
     # --- nodes --------------------------------------------------------------
 
     def create_node(self, node: NodeSpec) -> NodeSpec:
+        self.fence.check("create_node")
         if not node.created_at:
             node.created_at = self.clock.now()
         # The apiserver is the strictness authority here (duplicate names
@@ -590,6 +603,7 @@ class ApiServerCluster(Cluster):
         return super().apply_node(node)
 
     def update_node(self, node: NodeSpec) -> None:
+        self.fence.check("update_node")
         # PATCH (merge) only the fields controllers own; a full PUT would
         # clobber concurrent kubelet status updates.
         patch = {
@@ -615,6 +629,7 @@ class ApiServerCluster(Cluster):
         super().update_node(node)
 
     def remove_node_annotation(self, node: NodeSpec, key: str) -> None:
+        self.fence.check("remove_node_annotation")
         # Merge-patch null is the only way to DELETE a key server-side
         # (RFC 7386); sending the remaining map would leave it in place and
         # the watch pump would resurrect it into the cache.
@@ -629,6 +644,7 @@ class ApiServerCluster(Cluster):
         super().remove_node_annotation(node, key)
 
     def delete_node(self, name: str) -> None:
+        self.fence.check("delete_node")
         try:
             self.api.delete(f"{NODES}/{name}")
         except ApiError as error:
@@ -637,6 +653,7 @@ class ApiServerCluster(Cluster):
         super().delete_node(name)
 
     def remove_finalizer(self, node: NodeSpec, finalizer: str) -> None:
+        self.fence.check("remove_finalizer")
         remaining = [f for f in node.finalizers if f != finalizer]
         try:
             updated = self.api.patch(
@@ -651,6 +668,7 @@ class ApiServerCluster(Cluster):
     # --- provisioners --------------------------------------------------------
 
     def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:
+        self.fence.check("apply_provisioner")
         created = self._create_or_update(
             PROVISIONERS,
             f"{PROVISIONERS}/{provisioner.name}",
@@ -660,6 +678,7 @@ class ApiServerCluster(Cluster):
         return super().apply_provisioner(provisioner)
 
     def update_provisioner_status(self, provisioner: Provisioner) -> None:
+        self.fence.check("update_provisioner_status")
         status = convert.provisioner_to_kube(provisioner).get("status", {})
         try:
             updated = self.api.patch(
@@ -672,6 +691,7 @@ class ApiServerCluster(Cluster):
         super().update_provisioner_status(provisioner)
 
     def delete_provisioner(self, name: str) -> None:
+        self.fence.check("delete_provisioner")
         try:
             self.api.delete(f"{PROVISIONERS}/{name}")
         except ApiError as error:
@@ -682,6 +702,7 @@ class ApiServerCluster(Cluster):
     # --- daemonsets -----------------------------------------------------------
 
     def apply_daemonset(self, name: str, pod_template: PodSpec) -> None:
+        self.fence.check("apply_daemonset")
         body = {
             "apiVersion": "apps/v1",
             "kind": "DaemonSet",
@@ -694,48 +715,109 @@ class ApiServerCluster(Cluster):
 
     # --- leases ---------------------------------------------------------------
 
-    def acquire_lease(self, name: str, holder: str, duration_s: float) -> bool:
+    def acquire_lease(
+        self,
+        name: str,
+        holder: str,
+        duration_s: float,
+        *,
+        transitions: Optional[int] = None,
+    ) -> int:
         """CAS over a real coordination.k8s.io Lease: optimistic-concurrency
-        update keyed on resourceVersion; a 409 means a rival won the race."""
+        update keyed on resourceVersion; a 409 means a rival won the race.
+
+        Returns the committed ``leaseTransitions`` (the fencing generation,
+        bumped only on holder change) or 0 on a lost CAS, mirroring the
+        server's counter into the in-memory cache so both backends report
+        the identical generation. The ``lease.cas`` faultpoint flaps this
+        verb for the chaos smokes: ``conflict`` loses the CAS outright,
+        ``commit-lost`` performs the server write but reports it lost —
+        the split-brain seed, which the next campaign must absorb by
+        observing itself as holder WITHOUT a transitions bump.
+        """
+        fault = faultpoints.draw("lease.cas")
+        if fault is not None and fault.kind == "conflict":
+            return 0
+        commit_lost = fault is not None and fault.kind == "commit-lost"
         now = self.clock.now()
+        current = self.api.try_get(f"{LEASES}/{name}")
+        if current is None:
+            committed = int(transitions) if transitions is not None else 1
+            won = self._lease_create(name, holder, duration_s, now, committed)
+        else:
+            committed = self._lease_next_transitions(
+                current, holder, now, transitions
+            )
+            won = committed > 0 and self._lease_update(
+                name, holder, duration_s, now, committed, current
+            )
+        if not won or commit_lost:
+            return 0
+        return super().acquire_lease(name, holder, duration_s, transitions=committed)
+
+    def _lease_create(self, name, holder, duration_s, now, committed) -> bool:
+        try:
+            self.api.create(
+                LEASES,
+                convert.lease_to_kube(name, holder, duration_s, now, committed),
+            )
+        except ApiError as error:
+            if error.status == 409:
+                return False
+            raise
+        return True
+
+    def _lease_next_transitions(self, current, holder, now, transitions):
+        """The generation this acquire would commit, or 0 when the CAS is
+        already lost (a rival holds an unexpired term)."""
+        state = convert.lease_from_kube(current)
+        # A vacated Lease (released holder) still carries its counter; read
+        # it from the raw spec so the next generation doesn't restart at 1.
+        prior_transitions = int(
+            (current.get("spec") or {}).get("leaseTransitions", 0)
+        )
+        same_holder = False
+        if state is not None:
+            current_holder, renew, held_duration, prior_transitions = state
+            if current_holder != holder and now < renew + held_duration:
+                return 0
+            same_holder = current_holder == holder
+        if transitions is not None:
+            return int(transitions)
+        return prior_transitions if same_holder else prior_transitions + 1
+
+    def _lease_update(
+        self, name, holder, duration_s, now, committed, current
+    ) -> bool:
+        body = convert.lease_to_kube(name, holder, duration_s, now, committed)
+        body["metadata"]["resourceVersion"] = current.get("metadata", {}).get(
+            "resourceVersion"
+        )
+        try:
+            self.api.update(f"{LEASES}/{name}", body)
+        except ApiError as error:
+            if error.status == 409:
+                return False  # rival CAS'd first
+            raise
+        return True
+
+    def release_lease(self, name: str, holder: str) -> bool:
         path = f"{LEASES}/{name}"
         current = self.api.try_get(path)
-        if current is None:
-            try:
-                self.api.create(
-                    LEASES, convert.lease_to_kube(name, holder, duration_s, now)
-                )
-            except ApiError as error:
-                if error.status == 409:
-                    return False
-                raise
-            return super().acquire_lease(name, holder, duration_s)
-        state = convert.lease_from_kube(current)
-        if state is not None:
-            current_holder, renew, held_duration = state
-            if current_holder != holder and now < renew + held_duration:
-                return False
-        body = convert.lease_to_kube(name, holder, duration_s, now)
+        state = convert.lease_from_kube(current) if current else None
+        if state is None or state[0] != holder:
+            return False
+        # Vacate by clearing holderIdentity instead of deleting the object:
+        # leaseTransitions must survive a voluntary release, or the next
+        # holder's generation would alias the first one's fence token.
+        body = convert.lease_to_kube(name, "", 0, self.clock.now(), state[3])
         body["metadata"]["resourceVersion"] = current.get("metadata", {}).get(
             "resourceVersion"
         )
         try:
             self.api.update(path, body)
         except ApiError as error:
-            if error.status == 409:
-                return False  # rival CAS'd first
-            raise
-        return super().acquire_lease(name, holder, duration_s)
-
-    def release_lease(self, name: str, holder: str) -> bool:
-        current = self.api.try_get(f"{LEASES}/{name}")
-        state = convert.lease_from_kube(current) if current else None
-        if state is None or state[0] != holder:
-            return False
-        try:
-            self.api.delete(f"{LEASES}/{name}")
-        except ApiError as error:
-            if error.status != 404:
+            if error.status not in (404, 409):
                 raise
         return super().release_lease(name, holder)
 
@@ -744,7 +826,7 @@ class ApiServerCluster(Cluster):
         state = convert.lease_from_kube(current) if current else None
         if state is None:
             return None
-        holder, renew, duration = state
+        holder, renew, duration, lease_transitions = state
         if self.clock.now() >= renew + duration:
             return None
-        return holder, renew + duration
+        return holder, renew + duration, lease_transitions

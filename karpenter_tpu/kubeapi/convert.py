@@ -514,7 +514,13 @@ def daemonset_template_from_kube(obj: dict) -> PodSpec:
 # --- leases (coordination.k8s.io/v1) ---------------------------------------
 
 
-def lease_to_kube(name: str, holder: str, duration_s: float, acquired_at: float) -> dict:
+def lease_to_kube(
+    name: str,
+    holder: str,
+    duration_s: float,
+    acquired_at: float,
+    transitions: int = 1,
+) -> dict:
     return {
         "apiVersion": "coordination.k8s.io/v1",
         "kind": "Lease",
@@ -523,15 +529,25 @@ def lease_to_kube(name: str, holder: str, duration_s: float, acquired_at: float)
             "holderIdentity": holder,
             "leaseDurationSeconds": int(duration_s),
             "renewTime": rfc3339(acquired_at),
+            # The real coordination.k8s.io field: bumped only on holder
+            # change. This IS the fencing token (utils/fence.py) — a stale
+            # leader's generation can never equal its successor's.
+            "leaseTransitions": int(transitions),
         },
     }
 
 
 def lease_from_kube(obj: dict) -> Optional[tuple]:
-    """(holder, renew_epoch, duration_s) or None for a vacant lease."""
+    """(holder, renew_epoch, duration_s, transitions) or None for a vacant
+    lease."""
     spec = obj.get("spec") or {}
     holder = spec.get("holderIdentity")
     if not holder:
         return None
     renew = from_rfc3339(spec.get("renewTime")) or 0.0
-    return holder, renew, float(spec.get("leaseDurationSeconds", 15))
+    return (
+        holder,
+        renew,
+        float(spec.get("leaseDurationSeconds", 15)),
+        int(spec.get("leaseTransitions", 1)),
+    )

@@ -12,6 +12,7 @@ from __future__ import annotations
 import heapq
 import http.server
 import json
+import random
 import threading
 from typing import Callable, Optional
 
@@ -38,6 +39,9 @@ from karpenter_tpu.models.solver import (
     TPUSolver,
 )
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.backoff import jittered_s
+from karpenter_tpu.utils.crashpoints import crashpoint
+from karpenter_tpu.utils.fence import WriteFence, bind_thread
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.obs import OBS, RECORDER, stacks_snapshot
 from karpenter_tpu.utils.options import Options
@@ -66,6 +70,18 @@ SWEEP_FAILURES_TOTAL = REGISTRY.counter(
     "Failed reconcile sweeps by loop and exception class",
     ["controller", "reason"],
 )
+# Leader-election health (docs/operations.md HA runbook): transitions count
+# observed generation bumps (a handoff — alert on a flapping rate), and the
+# takeover histogram is the campaign wait from first refused CAS to the win
+# (the availability gap a standby actually closes). The fence-rejection
+# counter lives with the fence itself (utils/fence.py).
+LEADER_TRANSITIONS_TOTAL = REGISTRY.counter(
+    "leader_transitions_total", "Observed lease-generation bumps (handoffs)"
+)
+LEADER_TAKEOVER_SECONDS = REGISTRY.histogram(
+    "leader_takeover_seconds",
+    "Campaign wait from first refused lease CAS to acquisition",
+)
 
 
 class ReconcileLoop:
@@ -74,11 +90,20 @@ class ReconcileLoop:
     seconds to requeue."""
 
     def __init__(
-        self, name: str, reconcile: Callable, concurrency: int = 1, chunk: int = 1
+        self,
+        name: str,
+        reconcile: Callable,
+        concurrency: int = 1,
+        chunk: int = 1,
+        fence: Optional[WriteFence] = None,
     ):
         self.name = name
         self.reconcile = reconcile
         self.concurrency = concurrency
+        # The cluster's write fence, bound to each worker thread so the
+        # crashpoint abort gate (utils/fence.py) can kill a deposed leader's
+        # in-flight sweep at its next commit point.
+        self.fence = fence
         # Keys popped per wake-up. The default 1 preserves strict one-at-a-
         # time dispatch (right for loops whose reconciles block on RPCs);
         # CPU-bound high-volume loops (selection) set it higher so a pod
@@ -209,6 +234,8 @@ class ReconcileLoop:
     def _run(self) -> None:
         import time as _time
 
+        if self.fence is not None:
+            bind_thread(self.fence)
         while True:
             with self._cv:
                 while not self._stop and (
@@ -315,23 +342,67 @@ class LeaderElector:
     LEASE_SECONDS = 15.0
     RENEW_SECONDS = 5.0
 
-    def __init__(self, cluster, identity: str, on_lost=None):
+    def __init__(self, cluster, identity: str, on_lost=None, rng=None):
         self.cluster = cluster
         self.identity = identity
         self.on_lost = on_lost
         self.is_leader = threading.Event()
+        # The lease generation (its transitions counter) captured at
+        # acquire — the fencing token. None until the first win.
+        self.generation: Optional[int] = None
+        # Renew/campaign waits are jittered (utils/backoff.jittered_s) so
+        # replicas sharing the 5s cadence don't CAS the lease in lockstep;
+        # tests inject a seeded rng.
+        self._rng = rng if rng is not None else random.Random()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_renew: Optional[float] = None
+        # Stamped at the first refused CAS of a campaign; a win after a
+        # non-None stamp is a TAKEOVER (someone held the lease when we
+        # started wanting it) and observes leader_takeover_seconds.
+        self._campaign_began: Optional[float] = None
 
     def try_acquire(self) -> bool:
         won = self.cluster.acquire_lease(
             self.LEASE_NAME, self.identity, self.LEASE_SECONDS
         )
-        if won:
-            self._last_renew = self.cluster.clock.now()
+        now = self.cluster.clock.now()
+        if not won:
+            if self._campaign_began is None:
+                self._campaign_began = now
+            return False
+        generation = int(won)
+        self._last_renew = now
+        fresh = not self.is_leader.is_set()
+        if generation != (self.generation or 0):
+            LEADER_TRANSITIONS_TOTAL.inc()
+        self.generation = generation
+        # Arm BEFORE is_leader flips: the moment a waiter sees leadership
+        # it may start mutating, and those writes must already carry the
+        # new generation.
+        self.cluster.fence.arm(self.identity, generation)
+        if fresh:
+            if self._campaign_began is not None:
+                waited = max(0.0, now - self._campaign_began)
+                LEADER_TAKEOVER_SECONDS.observe(waited)
+                RECORDER.record(
+                    "leader",
+                    action="takeover",
+                    holder=self.identity,
+                    generation=generation,
+                    waited_s=round(waited, 3),
+                )
+            else:
+                RECORDER.record(
+                    "leader",
+                    action="acquire",
+                    holder=self.identity,
+                    generation=generation,
+                )
+            self._campaign_began = None
             self.is_leader.set()
-        return won
+            crashpoint("leader.after-acquire")
+        return True
 
     def acquire(self, blocking: bool = True, poll_s: float = 1.0) -> bool:
         """Campaign until leadership (blocking) or one attempt; then keep
@@ -339,7 +410,7 @@ class LeaderElector:
         while not self.try_acquire():
             if not blocking:
                 return False
-            if self._stop.wait(timeout=poll_s):
+            if self._stop.wait(timeout=jittered_s(poll_s, rng=self._rng)):
                 return False
         self._thread = threading.Thread(target=self._renew_loop, daemon=True)
         self._thread.start()
@@ -355,24 +426,39 @@ class LeaderElector:
         could steal it back mid-term, so leadership is declared lost WITHOUT
         attempting the CAS. The reference's leaderelection library likewise
         treats a missed renew deadline as lost leadership."""
+        crashpoint("leader.before-renew")
         now = self.cluster.clock.now()
         if self._last_renew is None or now - self._last_renew > self.LEASE_SECONDS:
-            self.is_leader.clear()
-            if self.on_lost is not None:
-                self.on_lost()
+            self._lose()
             return False
-        if self.cluster.acquire_lease(
+        won = self.cluster.acquire_lease(
             self.LEASE_NAME, self.identity, self.LEASE_SECONDS
-        ):
+        )
+        if won:
             self._last_renew = self.cluster.clock.now()
             return True
-        self.is_leader.clear()
-        if self.on_lost is not None:
-            self.on_lost()
+        self._lose()
         return False
 
+    def _lose(self) -> None:
+        """Leadership is gone: revoke the write fence FIRST — before on_lost
+        and before is_leader clears — so no in-flight sweep can slip a write
+        out between the loss and the manager stopping."""
+        self.cluster.fence.revoke(self.identity)
+        self.is_leader.clear()
+        RECORDER.record(
+            "leader",
+            action="lose",
+            holder=self.identity,
+            generation=self.generation,
+        )
+        if self.on_lost is not None:
+            self.on_lost()
+
     def _renew_loop(self) -> None:
-        while not self._stop.wait(timeout=self.RENEW_SECONDS):
+        while not self._stop.wait(
+            timeout=jittered_s(self.RENEW_SECONDS, rng=self._rng)
+        ):
             if not self._renew_once():
                 return
 
@@ -383,6 +469,7 @@ class LeaderElector:
             self._thread = None
         if self.is_leader.is_set():
             self.cluster.release_lease(self.LEASE_NAME, self.identity)
+            self.cluster.fence.disarm(self.identity)
             self.is_leader.clear()
 
 
@@ -550,6 +637,13 @@ class Manager:
         self._batch_full = threading.Event()
         self.provisioning.batch_full = self._batch_full
         self._stop = threading.Event()
+        # Warm-standby mode (start_standby): the informer cache and the
+        # DeviceClusterState sync run (both ride the store's watch feed,
+        # wired at construction), the solver warmup ladder compiles, but no
+        # reconcile loop starts and /readyz answers 503 "standby" until
+        # start() activates on takeover.
+        self.standby = threading.Event()
+        self._warmup_kicked = False
 
         # Reconcile loops. The reference runs selection at
         # MaxConcurrentReconciles=10,000 (selection/controller.go:166) where
@@ -611,6 +705,11 @@ class Manager:
                 "market", self.market.reconcile, concurrency=1
             ),
         }
+        # Every loop worker binds the cluster's write fence so a deposed
+        # leader's in-flight sweep aborts at its next crashpoint site
+        # (cooperative abort; utils/fence.py).
+        for loop in self.loops.values():
+            loop.fence = cluster.fence
 
     # --- watch fan-out (ref: controller Register() watch wiring) ------------
 
@@ -641,6 +740,10 @@ class Manager:
     # --- batch loop ---------------------------------------------------------
 
     def _batch_loop(self) -> None:
+        # The batch loop launches capacity, so its thread binds the fence
+        # too: a provision pass caught mid-flight by a leadership loss
+        # aborts at its next crashpoint site (utils/fence.py).
+        bind_thread(self.cluster.fence)
         while not self._stop.is_set():
             # Wake on the next poll tick OR the instant a window fills —
             # a storm's full batches provision without paying up to a poll
@@ -685,7 +788,17 @@ class Manager:
 
     # --- lifecycle ----------------------------------------------------------
 
+    def start_standby(self) -> None:
+        """Warm standby: everything read-only a takeover would otherwise pay
+        for. The informer cache and DeviceClusterState sync already ride the
+        store's watch feed (wired at construction), so this only kicks the
+        solver warmup ladder — the XLA compile debt — leaving /readyz at 503
+        "standby" and every reconcile loop parked until start()."""
+        self.standby.set()
+        self._kick_warmup()
+
     def start(self) -> None:
+        self.standby.clear()
         self.cluster.watch(self._on_event)
         for loop in self.loops.values():
             loop.start()
@@ -707,6 +820,19 @@ class Manager:
         self.loops["interruption"].enqueue("sweep")
         self.loops["consolidation"].enqueue("sweep")
         self.loops["market"].enqueue("sweep")
+        self._kick_warmup()
+        if self.warm.is_set() and not self._stop.is_set():
+            # Activating from a standby whose warmup already finished: the
+            # warmup thread set `warm` while `standby` held readiness back.
+            self.ready.set()
+
+    def _kick_warmup(self) -> None:
+        """Start the solver warmup exactly once per Manager — standby kicks
+        it early, activation reuses the result (bounded time-to-first-launch:
+        a takeover never pays XLA compile on a live batch)."""
+        if self._warmup_kicked:
+            return
+        self._warmup_kicked = True
         if getattr(self.solver, "needs_device_warmup", False):
             from karpenter_tpu.utils import backend_health
 
@@ -723,13 +849,20 @@ class Manager:
                     boot.reason,
                 )
                 self.warm.set()
-                self.ready.set()
+                self._assert_ready()
             else:
                 threading.Thread(
                     target=self._warmup, name="solver-warmup", daemon=True
                 ).start()
         else:
             self.warm.set()
+            self._assert_ready()
+
+    def _assert_ready(self) -> None:
+        """warm -> ready, unless stopped (a deposed leader's loops are all
+        down — /readyz must not flip back to 200) or still a standby (ready
+        means 'routable for work'; a standby is warm but not active)."""
+        if not self._stop.is_set() and not self.standby.is_set():
             self.ready.set()
 
     def _warmup(self) -> None:
@@ -743,11 +876,23 @@ class Manager:
         except Exception:  # noqa: BLE001 — warmup must never wedge boot
             self.log.exception("solver warmup failed; serving anyway")
         self.warm.set()
-        if not self._stop.is_set():
-            # A manager stopped mid-warmup (deposed leader) must stay
-            # not-ready — re-asserting readiness here would flip /readyz
-            # back to 200 on a replica whose loops are all stopped.
-            self.ready.set()
+        self._assert_ready()
+
+    def reload_options(self, changed: dict) -> None:
+        """Apply a re-parsed reloadable Options subset (options.RELOADABLE)
+        live — the SIGHUP / POST /debug/loglevel path. `changed` maps field
+        name to new value (options.apply_reload's return)."""
+        if not changed:
+            return
+        if "log_level" in changed:
+            klog.set_level(changed["log_level"])
+        if "slo_pending_p99" in changed or "slo_ttfl" in changed:
+            OBS.configure(
+                clock=self.cluster.clock,
+                slo_pending_p99=self.options.slo_pending_p99,
+                slo_ttfl=self.options.slo_ttfl,
+            )
+        self.log.info("reloaded options: %s", sorted(changed))
 
     def stop(self) -> None:
         self._stop.set()
@@ -798,15 +943,65 @@ class _HTTPHandler(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Type", "text/plain")
         elif self.path == "/readyz":
             ready = self.manager is not None and self.manager.ready.is_set()
-            body = b"ok" if ready else b"not ready"
-            self.send_response(200 if ready else 503)
+            if ready:
+                body, status = b"ok", 200
+            elif self.manager is not None and self.manager.standby.is_set():
+                # A campaigning standby is healthy-but-not-routable: the
+                # distinct body lets probes (and operators) tell a warm
+                # standby from a replica that is genuinely not up yet.
+                body, status = b"standby", 503
+            else:
+                body, status = b"not ready", 503
+            self.send_response(status)
             self.send_header("Content-Type", "text/plain")
+        elif self.path == "/debug/loglevel":
+            body = json.dumps({"level": klog.get_level()}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         else:
             body = b"not found"
             self.send_response(404)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        """POST /debug/loglevel with `debug` or `{"level": "debug"}` flips
+        the root logger live — the remote half of the SIGHUP reload path
+        (cmd/controller.py); both route through Manager.reload_options."""
+        if self.path != "/debug/loglevel":
+            body = b"not found"
+            self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length).decode("utf-8", "replace").strip()
+        level = raw
+        if raw.startswith("{"):
+            try:
+                level = str(json.loads(raw).get("level", ""))
+            except ValueError:
+                level = ""
+        level = level.strip().strip('"').lower()
+        if level not in ("debug", "info", "warning", "error"):
+            body = json.dumps({"error": f"unknown level {level!r}"}).encode()
+            self.send_response(400)
+        else:
+            if self.manager is not None:
+                self.manager.options.log_level = level
+                self.manager.reload_options({"log_level": level})
+            else:
+                klog.set_level(level)
+            body = json.dumps({"level": level}).encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_PUT = do_POST  # noqa: N815 — same semantics either verb
 
     def log_message(self, *args):  # silence per-request logging
         pass

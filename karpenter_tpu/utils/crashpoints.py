@@ -94,6 +94,17 @@ MARKET_SITES = ("market.mid-tick",)
 #   fresh snapshot encode.
 ENCODE_SITES = ("encode.mid-apply",)
 
+# Leader-election commit points (docs/operations.md, HA runbook):
+# - ``leader.after-acquire``  the lease CAS committed and the fence armed,
+#   but the Manager has not activated yet — a kill here leaves a held lease
+#   that the standby can only take over after the TTL expires.
+# - ``leader.before-renew``   fires at the top of each renewal attempt — a
+#   kill here models the classic "died holding the lease mid-term" case.
+LEADER_SITES = (
+    "leader.after-acquire",
+    "leader.before-renew",
+)
+
 
 class SimulatedCrash(BaseException):
     """The controller process 'died' at a named site. BaseException so no
@@ -118,6 +129,20 @@ _passages: Dict[str, int] = {}  # every passage ever, armed or not
 # registers a dump here so even an action="exit" kill — which skips atexit —
 # leaves a forensic record). Append-only from module init; never under _lock.
 _crash_callbacks: List = []
+# Optional gate consulted on EVERY passage (armed or not). utils.fence
+# installs one that aborts a deposed leader's sweep at the next site — the
+# crashpoint inventory doubles as the set of cooperative-abort sites, so a
+# long sweep straddling a leadership loss dies at its next commit point
+# instead of draining to completion against the successor. Written once at
+# module init (fence import); read lock-free like the armed map.
+_abort_gate = None
+
+
+def set_abort_gate(gate) -> None:
+    """Install ``gate(site)`` to run at every crashpoint passage. The gate
+    may raise to abort the sweep (utils.fence raises FencedWriteError)."""
+    global _abort_gate
+    _abort_gate = gate
 
 
 def on_crash(callback) -> None:
@@ -127,6 +152,9 @@ def on_crash(callback) -> None:
 
 def crashpoint(name: str) -> None:
     """A named injection site. No-op unless a test armed `name`."""
+    gate = _abort_gate
+    if gate is not None:
+        gate(name)
     # Lock-free fast path: dict reads are GIL-atomic and the armed map is
     # only written from tests, so production passes cost one lookup.
     if not _armed:

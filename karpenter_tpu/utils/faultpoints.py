@@ -39,6 +39,12 @@ instrumentation):
                     newest ticks (they redeliver), ``reorder`` scrambles
                     the batch (the seq-sorted fold absorbs it), and
                     ``blackout`` skips the poll — staleness climbs
+- ``lease.cas``     crossed by the apiserver backend's lease CAS
+                    (kubeapi/cluster.py acquire_lease): ``conflict`` loses
+                    the CAS outright (a rival's update raced ours), while
+                    ``commit-lost`` commits the server write but reports
+                    the attempt lost — the classic split-brain seed, where
+                    the holder must re-observe itself on the next campaign
 """
 
 from __future__ import annotations
@@ -58,6 +64,7 @@ SITES = (
     "watch.event",
     "watch.stall",
     "market.feed",
+    "lease.cas",
 )
 
 REQUEST_SITES = tuple(s for s in SITES if s.startswith("api.request."))
@@ -73,6 +80,7 @@ KINDS_BY_SITE = {
     "watch.event": ("latency", "tear", "duplicate", "reorder", "drop-410"),
     "watch.stall": ("stall",),
     "market.feed": ("stale", "reorder", "blackout"),
+    "lease.cas": ("conflict", "commit-lost"),
 }
 
 

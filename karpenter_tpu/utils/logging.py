@@ -39,6 +39,15 @@ def set_level(level: str) -> None:
     )
 
 
+def get_level() -> str:
+    """The current root level name, lowercased — what /debug/loglevel GETs.
+    An unset root (no setup() yet) reads as the effective default, info."""
+    level = logging.getLogger(_ROOT_NAME).level
+    if level == logging.NOTSET:
+        return "info"
+    return logging.getLevelName(level).lower()
+
+
 def named(name: str) -> logging.Logger:
     """Named sub-logger per controller (ref: provisioning/controller.go:65)."""
     return logging.getLogger(f"{_ROOT_NAME}.{name}")

@@ -304,3 +304,26 @@ def parse(argv: Optional[List[str]] = None) -> Options:
     )
     options.validate()
     return options
+
+
+# The subset of Options safe to change on a LIVE process: fields that are
+# read at use time rather than baked into constructed objects. Everything
+# else (ports, store backend, solver, concurrency envelopes) is wired into
+# threads and sockets at boot and only a restart can change it. SIGHUP and
+# POST /debug/loglevel both route through apply_reload so the two paths
+# can't drift (cmd/controller.py, runtime._HTTPHandler).
+RELOADABLE = ("log_level", "slo_pending_p99", "slo_ttfl")
+
+
+def apply_reload(live: Options, fresh: Options) -> dict:
+    """Copy the RELOADABLE fields of `fresh` (a re-parse of the original
+    argv, which re-reads env fallbacks too) onto the live Options; returns
+    {field: new_value} for what actually changed — the input
+    Manager.reload_options applies."""
+    changed = {}
+    for name in RELOADABLE:
+        new = getattr(fresh, name)
+        if getattr(live, name) != new:
+            setattr(live, name, new)
+            changed[name] = new
+    return changed

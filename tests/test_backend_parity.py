@@ -168,3 +168,85 @@ class TestProvisioningUnderApiFaultsOnApiserver(chaos.TestProvisioningUnderApiFa
     actually fire — the 409-create → GET → retry-once path and the
     committed-timeout re-POST must converge with zero leaked instances,
     indistinguishable (to the controllers) from the quiet in-memory run."""
+
+
+class TestLeaseCasUnderChaos:
+    """Lease CAS over the REAL apiserver backend under chaos (HA satellite):
+    the ``lease.cas`` faultpoint flaps the lease verb itself. The nasty leg
+    is ``commit-lost`` — the server write lands but the caller is told it
+    lost (timeout after commit). The next campaign by the same holder sees
+    itself already holding and must re-acquire with NO transitions bump
+    (same fencing generation: it never actually stopped being leader), while
+    a rival stays blocked for the remainder of the committed term."""
+
+    def _frontends(self, count=2):
+        from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+        from karpenter_tpu.utils.clock import FakeClock
+
+        from tests.fake_apiserver import DirectTransport, FakeApiServer
+
+        clock = FakeClock()
+        server = FakeApiServer(clock=clock)
+        clusters = [
+            ApiServerCluster(
+                KubeClient(DirectTransport(server), qps=1e6, burst=10**6),
+                clock=clock,
+            )
+            for _ in range(count)
+        ]
+        return clock, server, clusters
+
+    def test_commit_lost_is_absorbed_without_a_generation_bump(self):
+        from karpenter_tpu.utils import faultpoints
+
+        clock, server, (a, b) = self._frontends()
+        fault = faultpoints.arm("lease.cas", "commit-lost", rate=1.0, count=1)
+        try:
+            # The write COMMITTED server-side but the caller saw a loss.
+            assert a.acquire_lease("leader", "a", 15.0) == 0
+            assert fault.fires == 1
+            stored = server.get_object("leases", "kube-system", "leader")
+            assert stored["spec"]["holderIdentity"] == "a"
+            assert stored["spec"]["leaseTransitions"] == 1
+            # Split-brain seed absorbed: the re-campaign observes itself as
+            # holder — same generation, no phantom handoff.
+            assert a.acquire_lease("leader", "a", 15.0) == 1
+            assert a.get_lease("leader")[2] == 1
+            # The committed term really does exclude the rival.
+            assert b.acquire_lease("leader", "b", 15.0) == 0
+            clock.advance(16.0)
+            assert b.acquire_lease("leader", "b", 15.0) == 2
+        finally:
+            faultpoints.disarm_all()
+
+    def test_conflict_loses_the_cas_without_touching_the_server(self):
+        from karpenter_tpu.utils import faultpoints
+
+        clock, server, (a, b) = self._frontends()
+        fault = faultpoints.arm("lease.cas", "conflict", rate=1.0, count=1)
+        try:
+            assert a.acquire_lease("leader", "a", 15.0) == 0
+            assert fault.fires == 1
+            # Conflict fires at entry: nothing reached the server, so the
+            # very next attempt (fault exhausted) wins cleanly.
+            assert server.get_object("leases", "kube-system", "leader") is None
+            assert a.acquire_lease("leader", "a", 15.0) == 1
+        finally:
+            faultpoints.disarm_all()
+
+    def test_commit_lost_on_renewal_keeps_the_holder_in_office(self):
+        from karpenter_tpu.utils import faultpoints
+
+        clock, server, (a, b) = self._frontends()
+        assert a.acquire_lease("leader", "a", 15.0) == 1
+        clock.advance(5.0)
+        fault = faultpoints.arm("lease.cas", "commit-lost", rate=1.0, count=1)
+        try:
+            # Renewal reported lost, but the server term WAS extended.
+            assert a.acquire_lease("leader", "a", 15.0) == 0
+            assert fault.fires == 1
+            clock.advance(11.0)  # past the ORIGINAL expiry, inside the renewed
+            assert b.acquire_lease("leader", "b", 15.0) == 0
+            assert a.acquire_lease("leader", "a", 15.0) == 1  # still gen 1
+        finally:
+            faultpoints.disarm_all()

@@ -122,7 +122,7 @@ class TestFaultpointFacility:
             Path(__file__).parent / "fake_apiserver.py"
         ]
         pattern = re.compile(
-            r'"((?:api\.request|watch)\.[a-z0-9-]+|market\.feed)"'
+            r'"((?:api\.request|watch)\.[a-z0-9-]+|market\.feed|lease\.cas)"'
         )
         found = set()
         for path in scanned:

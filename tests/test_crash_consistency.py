@@ -300,4 +300,4 @@ class TestCrashpointFacility:
             crashpoints.INTERRUPTION_SITES
         ) | set(crashpoints.CONSOLIDATION_SITES) | set(
             crashpoints.ENCODE_SITES
-        ) | set(crashpoints.MARKET_SITES)
+        ) | set(crashpoints.MARKET_SITES) | set(crashpoints.LEADER_SITES)

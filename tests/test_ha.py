@@ -1,0 +1,412 @@
+"""HA control plane: write fencing by lease generation, lease transitions
+on both store backends, the warm standby, cooperative sweep abort, and the
+live-reload path (SIGHUP / POST /debug/loglevel).
+
+Ref: cmd/controller/main.go:80-81 (controller-runtime leader election) and
+the coordination.k8s.io Lease's ``leaseTransitions`` field, which this repo
+uses as the fencing token.
+"""
+
+import json
+import types
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api.pods import PodSpec
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.controllers.provisioning import ProvisionerWorker
+from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+from karpenter_tpu.runtime import LeaderElector, Manager, serve_http
+from karpenter_tpu.utils import crashpoints
+from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils import options as options_pkg
+from karpenter_tpu.utils.backoff import jittered_s
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.fence import (
+    LEADER_FENCE_REJECTED_TOTAL,
+    FencedWriteError,
+    WriteFence,
+    bind_thread,
+)
+from karpenter_tpu.utils.options import Options
+
+from tests.fake_apiserver import DirectTransport, FakeApiServer
+
+
+class TestWriteFence:
+    def test_unarmed_passes_and_reports_no_generation(self):
+        fence = WriteFence()
+        fence.check("bind_pod")  # pass-through: no leadership machinery wired
+        assert fence.generation is None
+        assert not fence.revoked()
+
+    def test_active_passes_and_exposes_generation(self):
+        fence = WriteFence()
+        fence.arm("a", 3)
+        fence.check("bind_pod")
+        assert fence.generation == 3
+
+    def test_revoked_raises_counts_and_is_a_plain_exception(self):
+        fence = WriteFence()
+        fence.arm("a", 2)
+        fence.revoke("a")
+        before = LEADER_FENCE_REJECTED_TOTAL.get("bind_pod")
+        with pytest.raises(FencedWriteError) as info:
+            fence.check("bind_pod")
+        assert info.value.verb == "bind_pod"
+        assert info.value.generation == 2
+        # Must travel ordinary recovery paths (reconcile error handling),
+        # so it cannot be a BaseException-only escape hatch.
+        assert isinstance(info.value, Exception)
+        assert LEADER_FENCE_REJECTED_TOTAL.get("bind_pod") == before + 1
+        # Revoked fence reports no usable generation: a launch identity
+        # minted after revocation must not carry the stale token.
+        assert fence.generation is None
+
+    def test_revoke_is_keyed_by_holder(self):
+        fence = WriteFence()
+        fence.arm("a", 1)
+        fence.revoke("b")  # a rival cannot revoke a fence it never armed
+        fence.check("bind_pod")
+        assert fence.generation == 1
+
+    def test_rearm_after_revocation_restores_writes(self):
+        fence = WriteFence()
+        fence.arm("a", 1)
+        fence.revoke("a")
+        fence.arm("b", 2)  # the successor arms at the bumped generation
+        fence.check("bind_pod")
+        assert fence.generation == 2
+
+    def test_disarm_returns_to_passthrough(self):
+        fence = WriteFence()
+        fence.arm("a", 1)
+        fence.disarm("a")
+        fence.check("bind_pod")
+        assert fence.generation is None
+
+
+class TestLeaseTransitionsInMemory:
+    def test_holder_change_bumps_renewal_does_not(self):
+        clock = FakeClock()
+        cluster = Cluster(clock=clock)
+        assert cluster.acquire_lease("leader", "a", 15.0) == 1
+        clock.advance(5.0)
+        assert cluster.acquire_lease("leader", "a", 15.0) == 1  # renewal
+        clock.advance(16.0)
+        assert cluster.acquire_lease("leader", "b", 15.0) == 2  # handoff
+        assert cluster.get_lease("leader")[2] == 2
+
+    def test_same_holder_reacquire_after_expiry_keeps_generation(self):
+        clock = FakeClock()
+        cluster = Cluster(clock=clock)
+        assert cluster.acquire_lease("leader", "a", 15.0) == 1
+        clock.advance(30.0)  # expired with no rival: not a handoff
+        assert cluster.acquire_lease("leader", "a", 15.0) == 1
+
+    def test_release_preserves_the_counter(self):
+        """The tombstoned release keeps transitions so the next holder's
+        generation cannot alias the previous one's."""
+        clock = FakeClock()
+        cluster = Cluster(clock=clock)
+        assert cluster.acquire_lease("leader", "a", 15.0) == 1
+        assert cluster.release_lease("leader", "a")
+        assert cluster.get_lease("leader") is None
+        assert cluster.acquire_lease("leader", "b", 15.0) == 2
+
+    def test_refused_cas_returns_zero(self):
+        clock = FakeClock()
+        cluster = Cluster(clock=clock)
+        assert cluster.acquire_lease("leader", "a", 15.0) == 1
+        assert cluster.acquire_lease("leader", "b", 15.0) == 0
+
+
+class TestLeaseTransitionsOnApiServer:
+    def _clusters(self, count=2):
+        clock = FakeClock()
+        server = FakeApiServer(clock=clock)
+        clusters = [
+            ApiServerCluster(
+                KubeClient(DirectTransport(server), qps=1e6, burst=10**6),
+                clock=clock,
+            )
+            for _ in range(count)
+        ]
+        return clock, server, clusters
+
+    def test_lease_transitions_survive_handoff_and_release(self):
+        clock, server, (a, b) = self._clusters()
+        assert a.acquire_lease("leader", "a", 15.0) == 1
+        stored = server.get_object("leases", "kube-system", "leader")
+        assert stored["spec"]["leaseTransitions"] == 1
+        clock.advance(16.0)
+        assert b.acquire_lease("leader", "b", 15.0) == 2
+        stored = server.get_object("leases", "kube-system", "leader")
+        assert stored["spec"]["leaseTransitions"] == 2
+        # Release tombstones (holder cleared, counter kept) instead of
+        # deleting, so the NEXT acquire still bumps past 2.
+        assert b.release_lease("leader", "b")
+        stored = server.get_object("leases", "kube-system", "leader")
+        assert stored["spec"]["holderIdentity"] == ""
+        assert stored["spec"]["leaseTransitions"] == 2
+        assert a.acquire_lease("leader", "a", 15.0) == 3
+
+    def test_renewal_keeps_generation(self):
+        clock, server, (a,) = self._clusters(count=1)
+        assert a.acquire_lease("leader", "a", 15.0) == 1
+        clock.advance(5.0)
+        assert a.acquire_lease("leader", "a", 15.0) == 1
+        assert a.get_lease("leader")[2] == 1
+
+
+class TestElectorFencing:
+    def _cluster(self):
+        clock = FakeClock()
+        return Cluster(clock=clock), clock
+
+    def test_acquire_arms_fence_with_lease_generation(self):
+        cluster, clock = self._cluster()
+        elector = LeaderElector(cluster, "a")
+        assert elector.try_acquire()
+        assert elector.generation == 1
+        assert cluster.fence.generation == 1
+        cluster.apply_pod(PodSpec(name="p1", uid="u1"))  # writes pass
+
+    def test_missed_renew_deadline_revokes_and_rejects_writes(self):
+        cluster, clock = self._cluster()
+        lost = []
+        elector = LeaderElector(cluster, "a", on_lost=lambda: lost.append("a"))
+        assert elector.try_acquire()
+        clock.advance(LeaderElector.LEASE_SECONDS + 1)
+        assert elector._renew_once() is False
+        assert lost == ["a"]
+        assert cluster.fence.revoked()
+        with pytest.raises(FencedWriteError):
+            cluster.apply_pod(PodSpec(name="p1", uid="u1"))
+        with pytest.raises(FencedWriteError):
+            cluster.fence.check("cloud.create")
+
+    def test_takeover_bumps_generation_and_rearms_successor(self):
+        cluster, clock = self._cluster()
+        a = LeaderElector(cluster, "a")
+        b = LeaderElector(cluster, "b")
+        assert a.try_acquire()
+        assert not b.try_acquire()  # stamps b's campaign
+        clock.advance(LeaderElector.LEASE_SECONDS + 1)
+        assert a._renew_once() is False  # a notices the missed deadline
+        assert b.try_acquire()
+        assert b.generation == 2
+        assert cluster.fence.generation == 2
+        cluster.apply_pod(PodSpec(name="p1", uid="u1"))  # successor writes pass
+
+    def test_stale_leader_writes_refused_while_successor_proceeds(self):
+        """Two replicas, each with its OWN store frontend (and fence) over
+        one shared apiserver — the production topology. The paused leader's
+        writes die at its fence; the successor's land on the server."""
+        clock = FakeClock()
+        server = FakeApiServer(clock=clock)
+
+        def frontend():
+            return ApiServerCluster(
+                KubeClient(DirectTransport(server), qps=1e6, burst=10**6),
+                clock=clock,
+            )
+
+        cluster_a, cluster_b = frontend(), frontend()
+        a = LeaderElector(cluster_a, "a")
+        b = LeaderElector(cluster_b, "b")
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        clock.advance(LeaderElector.LEASE_SECONDS + 1)  # a pauses past TTL
+        assert b.try_acquire()
+        assert b.generation == 2
+        # The resumed stale leader observes the missed deadline: fence drops.
+        assert a._renew_once() is False
+        with pytest.raises(FencedWriteError):
+            cluster_a.apply_pod(PodSpec(name="stale", uid="u-stale"))
+        assert server.get_object("pods", "default", "stale") is None
+        cluster_b.apply_pod(PodSpec(name="fresh", uid="u-fresh"))
+        assert server.get_object("pods", "default", "fresh") is not None
+
+    def test_release_disarms_fence(self):
+        cluster, clock = self._cluster()
+        elector = LeaderElector(cluster, "a")
+        assert elector.try_acquire()
+        elector.release()
+        assert cluster.fence.generation is None
+        cluster.apply_pod(PodSpec(name="p1", uid="u1"))  # pass-through again
+
+
+class TestLaunchIdentityGeneration:
+    def _packing(self):
+        return types.SimpleNamespace(
+            pods=[PodSpec(name="p", uid="u1")],
+            node_quantity=1,
+            instance_type_options=[],
+            pool_options=[],
+        )
+
+    def test_generation_folds_into_the_identity(self):
+        ident = ProvisionerWorker._launch_identity
+        packing = self._packing()
+        bare = ident("default", packing)
+        gen1 = ident("default", packing, lease_generation=1)
+        gen2 = ident("default", packing, lease_generation=2)
+        # Same batch, same generation: stable (crash-replay still adopts).
+        assert gen1 == ident("default", packing, lease_generation=1)
+        # A successor's re-solve of the SAME pods mints a fresh token.
+        assert len({bare, gen1, gen2}) == 3
+
+
+class TestCooperativeAbort:
+    def test_revoked_thread_fence_aborts_at_crashpoints(self):
+        fence = WriteFence()
+        fence.arm("a", 1)
+        bind_thread(fence)
+        try:
+            crashpoints.crashpoint("provision.before-launch")  # armed: passes
+            fence.revoke("a")
+            with pytest.raises(FencedWriteError) as info:
+                crashpoints.crashpoint("provision.before-launch")
+            assert info.value.verb == "sweep:provision.before-launch"
+        finally:
+            bind_thread(None)
+
+    def test_unbound_thread_is_unaffected(self):
+        bind_thread(None)
+        crashpoints.crashpoint("provision.before-launch")
+
+
+class TestWarmStandby:
+    def _manager(self):
+        return Manager(
+            Cluster(),
+            FakeCloudProvider(),
+            Options(cluster_name="ha", solver="greedy", leader_election=False),
+        )
+
+    def test_standby_is_warm_but_not_ready_until_activated(self):
+        mgr = self._manager()
+        try:
+            mgr.start_standby()
+            assert mgr.standby.is_set()
+            assert mgr.warm.wait(timeout=10.0)
+            assert not mgr.ready.is_set()  # warm, but not routable
+            mgr.start()  # takeover: activate
+            assert not mgr.standby.is_set()
+            assert mgr.ready.is_set()
+        finally:
+            mgr.stop()
+
+    def test_readyz_answers_standby_then_ok(self):
+        mgr = self._manager()
+        server = serve_http(mgr, 0, address="127.0.0.1")
+        port = server.server_address[1]
+
+        def fetch(path):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5.0
+                ) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as err:
+                return err.code, err.read()
+
+        try:
+            mgr.start_standby()
+            assert mgr.warm.wait(timeout=10.0)
+            assert fetch("/healthz")[0] == 200  # liveness must NOT kill us
+            status, body = fetch("/readyz")
+            assert (status, body) == (503, b"standby")
+            mgr.start()
+            assert fetch("/readyz")[0] == 200
+        finally:
+            mgr.stop()
+            server.shutdown()
+
+
+class TestLiveReload:
+    def test_apply_reload_touches_only_the_reloadable_subset(self):
+        live = options_pkg.parse(["--cluster-name", "c", "--log-level", "info"])
+        fresh = options_pkg.parse(
+            ["--cluster-name", "other", "--log-level", "debug"]
+        )
+        changed = options_pkg.apply_reload(live, fresh)
+        assert changed == {"log_level": "debug"}
+        assert live.log_level == "debug"
+        assert live.cluster_name == "c"  # not reloadable: untouched
+
+    def test_manager_reload_applies_log_level(self):
+        mgr = Manager(
+            Cluster(),
+            FakeCloudProvider(),
+            Options(cluster_name="ha", solver="greedy", leader_election=False),
+        )
+        previous = klog.get_level()
+        try:
+            mgr.reload_options({"log_level": "debug"})
+            assert klog.get_level() == "debug"
+        finally:
+            klog.set_level(previous)
+
+    def test_debug_loglevel_endpoint_round_trips(self):
+        mgr = Manager(
+            Cluster(),
+            FakeCloudProvider(),
+            Options(cluster_name="ha", solver="greedy", leader_election=False),
+        )
+        server = serve_http(mgr, 0, address="127.0.0.1")
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}/debug/loglevel"
+        previous = klog.get_level()
+
+        def request(method, body=None):
+            req = urllib.request.Request(base, data=body, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as err:
+                return err.code, err.read()
+
+        try:
+            status, body = request("POST", b'{"level": "debug"}')
+            assert status == 200
+            assert klog.get_level() == "debug"
+            assert mgr.options.log_level == "debug"
+            status, body = request("GET")
+            assert status == 200
+            assert json.loads(body) == {"level": "debug"}
+            status, _ = request("POST", b"warning")  # raw level, no JSON
+            assert status == 200
+            assert klog.get_level() == "warning"
+            status, _ = request("POST", b"shouting")
+            assert status == 400
+            assert klog.get_level() == "warning"  # bad input changes nothing
+        finally:
+            klog.set_level(previous)
+            server.shutdown()
+
+
+class TestJitter:
+    def test_jittered_s_stays_within_the_fraction_band(self):
+        import random
+
+        rng = random.Random(7)
+        for _ in range(200):
+            value = jittered_s(5.0, rng=rng)
+            assert 4.0 <= value <= 6.0
+        assert jittered_s(0.0, rng=rng) == 0.0
+
+
+class TestFencedCloudVerbsInMemory:
+    def test_store_verbs_fence_on_the_in_memory_backend(self):
+        cluster = Cluster()
+        cluster.fence.arm("a", 1)
+        cluster.fence.revoke("a")
+        with pytest.raises(FencedWriteError):
+            cluster.create_node(NodeSpec(name="n1"))
+        with pytest.raises(FencedWriteError):
+            cluster.apply_pod(PodSpec(name="p1", uid="u1"))
