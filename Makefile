@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke ha-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke ha-smoke lifecycle-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -152,6 +152,20 @@ market-smoke:
 ha-smoke:
 	timeout -k 10 240 python tools/ha_smoke.py
 
+# The node-lifecycle capstone (tools/lifecycle_smoke.py): a 520-node fake-
+# kubelet fleet (tests/fake_kubelet.py) driving registration, heartbeats,
+# pod-ready acks and eviction completion against the real threaded Manager,
+# through a seeded misbehavior storm — never-join, slow-join, ready-flap,
+# mid-life heartbeat loss, eviction black-holes, zombie re-registration —
+# racing arrival waves and an API fault storm, with the controller killed
+# at health.after-cordon and health.mid-displace and rebuilt mid-storm.
+# Asserts every replica bound exactly once to a live Ready node, displaced
+# pods rebound exactly once (never ping-ponged), zero PDB violations
+# (server-side watch oracle), zero leaked instances after the GC grace,
+# zero zombie adoptions, and the pending-p99 SLO held. Hard 240s timeout.
+lifecycle-smoke:
+	timeout -k 10 240 python tools/lifecycle_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -168,6 +182,7 @@ smoke:
 	$(MAKE) obs-smoke || rc=1; \
 	$(MAKE) market-smoke || rc=1; \
 	$(MAKE) ha-smoke || rc=1; \
+	$(MAKE) lifecycle-smoke || rc=1; \
 	exit $$rc
 
 proto:

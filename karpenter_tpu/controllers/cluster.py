@@ -321,6 +321,23 @@ class Cluster:
         self._fence_check("update_node")
         self._notify("node", node, verb="update")
 
+    def heartbeat_node(self, name: str, ready: bool = True) -> Optional[NodeSpec]:
+        """Kubelet-side status report: stamp status_reported_at with the
+        current clock and set the Ready condition. A dedicated verb (not
+        update_node) because heartbeats are STATUS writes — the apiserver
+        backend patches only status.conditions so a controller's concurrent
+        metadata/spec patch is never clobbered, and vice versa. Unfenced:
+        heartbeats come from the node's kubelet, not the (possibly deposed)
+        controller leader. Returns the node, or None if it doesn't exist."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                return None
+            node.ready = ready
+            node.status_reported_at = self.clock.now()
+        self._notify("node", node, verb="update")
+        return node
+
     def remove_node_annotation(self, node: NodeSpec, key: str) -> None:
         """Delete one annotation. A dedicated verb because removal does NOT
         survive update_node on the apiserver backend: its merge-patch sends

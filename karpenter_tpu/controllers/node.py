@@ -24,11 +24,23 @@ def _min_requeue(*results: Optional[float]) -> Optional[float]:
 
 
 class Readiness:
-    """Strip the not-ready taint once the kubelet reports Ready
-    (ref: node/readiness.go:27-41)."""
+    """Strip the not-ready taint once the kubelet reports Ready, and — the
+    other direction the reference never implemented — re-add it when a node
+    that HAD joined goes NotReady, so the solver stops packing onto a sick
+    node (ref: node/readiness.go:27-41; the one-way-taint gap)."""
 
     def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
         if not node.ready:
+            # Only nodes that once reported get the taint re-added: a
+            # never-joined node still carries its registration taint, and
+            # re-tainting it here would double-write every liveness wait.
+            if node.status_reported_at is not None and not any(
+                t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints
+            ):
+                node.taints.append(
+                    Taint(key=wellknown.NOT_READY_TAINT_KEY, effect="NoSchedule")
+                )
+                cluster.update_node(node)
             return None
         before = len(node.taints)
         node.taints = [
@@ -52,16 +64,24 @@ class Readiness:
 
 class Liveness:
     """Delete nodes whose kubelet never reported within the timeout — the
-    runaway-scale guard (ref: node/liveness.go:31-52, designs/limits.md)."""
+    runaway-scale guard (ref: node/liveness.go:31-52, designs/limits.md).
+
+    Deliberately scoped to the NEVER-joined case: a node that reported once
+    and then went dark is the health controller's job
+    (controllers/health.py), which drains and replaces instead of deleting
+    out from under still-running pods."""
+
+    def __init__(self, timeout: float = LIVENESS_TIMEOUT_SECONDS):
+        self.timeout = timeout
 
     def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
         if node.status_reported_at is not None:
             return None
         age = cluster.clock.now() - node.created_at
-        if age >= LIVENESS_TIMEOUT_SECONDS:
+        if age >= self.timeout:
             cluster.delete_node(node.name)
             return None
-        return LIVENESS_TIMEOUT_SECONDS - age
+        return self.timeout - age
 
 
 class Expiration:
@@ -131,11 +151,11 @@ class NodeController:
     karpenter-labeled nodes, skip deleting ones, run sub-reconcilers, requeue
     at the soonest requested time."""
 
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, liveness_timeout: float = LIVENESS_TIMEOUT_SECONDS):
         self.cluster = cluster
         self.reconcilers = [
             Readiness(),
-            Liveness(),
+            Liveness(timeout=liveness_timeout),
             Expiration(),
             Emptiness(),
             Finalizer(),
@@ -154,6 +174,14 @@ class NodeController:
         results = []
         for reconciler in self.reconcilers:
             results.append(reconciler.reconcile(self.cluster, provisioner, node))
-            if self.cluster.try_get_node(name) is None:
-                return None  # a sub-reconciler deleted the node
+            # RE-READ between sub-reconcilers, don't just probe existence:
+            # on the apiserver backend a watch event (kubelet heartbeat, a
+            # rival controller's patch) can REPLACE the cached object
+            # mid-sequence, and the next sub-reconciler writing through the
+            # stale reference would undo that update. The refreshed object
+            # also catches a sub-reconciler's own delete (deletion held by
+            # the finalizer), which ends the pass.
+            node = self.cluster.try_get_node(name)
+            if node is None or node.deletion_timestamp is not None:
+                return None  # a sub-reconciler (or a rival) deleted the node
         return _min_requeue(*results)

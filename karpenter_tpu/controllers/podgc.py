@@ -50,11 +50,11 @@ class PodGcController:
         node_names = {node.name for node in self.cluster.list_nodes()}
         orphans: Set[Tuple[str, str, str]] = set()
         for pod in self.cluster.list_pods():
-            if (
-                pod.node_name is not None
-                and pod.deletion_timestamp is None
-                and pod.node_name not in node_names
-            ):
+            # Terminating pods are orphans too: with the node gone there is
+            # no kubelet left to complete the eviction, so the pod would
+            # stay terminating forever (kube's gcOrphaned force-deletes the
+            # same way). The two-sighting rule still applies.
+            if pod.node_name is not None and pod.node_name not in node_names:
                 orphans.add((pod.namespace, pod.name, getattr(pod, "uid", "") or ""))
         deleted: Set[Tuple[str, str, str]] = set()
         for key in orphans & self._suspects:  # second consecutive sighting

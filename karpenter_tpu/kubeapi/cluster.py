@@ -628,6 +628,35 @@ class ApiServerCluster(Cluster):
                 raise
         super().update_node(node)
 
+    def heartbeat_node(self, name: str, ready: bool = True):
+        # Status-only merge-patch — the write a real kubelet's status loop
+        # issues. Deliberately disjoint from update_node's metadata/spec
+        # patch so neither side clobbers the other. Unfenced (see base):
+        # the reporter is the node, not the controller leader.
+        try:
+            updated = self.api.patch(
+                f"{NODES}/{name}",
+                {
+                    "status": {
+                        "conditions": [
+                            {
+                                "type": "Ready",
+                                "status": "True" if ready else "False",
+                                "lastHeartbeatTime": convert.rfc3339(
+                                    self.clock.now()
+                                ),
+                            }
+                        ]
+                    }
+                },
+            )
+            self._record_rv("node", updated)
+        except ApiError as error:
+            if error.status != 404:
+                raise
+            return None
+        return super().heartbeat_node(name, ready)
+
     def remove_node_annotation(self, node: NodeSpec, key: str) -> None:
         self.fence.check("remove_node_annotation")
         # Merge-patch null is the only way to DELETE a key server-side

@@ -20,6 +20,7 @@ from karpenter_tpu.api import wellknown
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.health import HealthController
 from karpenter_tpu.controllers.metrics import MetricsController, POLL_SECONDS
 from karpenter_tpu.controllers.node import NodeController
 from karpenter_tpu.controllers.instancegc import InstanceGcController
@@ -567,7 +568,9 @@ class Manager:
         )
         self.selection = SelectionController(cluster, self.provisioning)
         self.termination = TerminationController(cluster, cloud)
-        self.node = NodeController(cluster)
+        self.node = NodeController(
+            cluster, liveness_timeout=options.node_liveness_timeout
+        )
         self.counter = CounterController(cluster)
         self.metrics = MetricsController(cluster)
         self.podgc = PodGcController(cluster)
@@ -588,6 +591,15 @@ class Manager:
             escalate_fraction=options.interruption_escalate_fraction,
             cluster_state=self.cluster_state,
             price_book=self.price_book,
+        )
+        self.health = HealthController(
+            cluster,
+            cloud,
+            self.provisioning,
+            self.termination,
+            unreachable_timeout=options.node_unreachable_timeout,
+            drain_stuck_timeout=options.drain_stuck_timeout,
+            cluster_state=self.cluster_state,
         )
         self.consolidation = ConsolidationController(
             cluster,
@@ -692,6 +704,11 @@ class Manager:
             # ahead of the deadline, replace before the pods land.
             "interruption": ReconcileLoop(
                 "interruption", self.interruption.reconcile, concurrency=1
+            ),
+            # Node-health sweep: heartbeat staleness + NotReady detection
+            # with flap hysteresis, escalating through the drain ladder.
+            "health": ReconcileLoop(
+                "health", self.health.reconcile, concurrency=1
             ),
             # Consolidation sweep: re-solve the live cluster for cost and
             # shed/replace capacity the workload no longer justifies.
@@ -818,6 +835,7 @@ class Manager:
         self.loops["podgc"].enqueue("sweep")
         self.loops["instancegc"].enqueue("sweep")
         self.loops["interruption"].enqueue("sweep")
+        self.loops["health"].enqueue("sweep")
         self.loops["consolidation"].enqueue("sweep")
         self.loops["market"].enqueue("sweep")
         self._kick_warmup()

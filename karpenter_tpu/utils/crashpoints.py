@@ -105,6 +105,18 @@ LEADER_SITES = (
     "leader.before-renew",
 )
 
+# Unhealthy-node escalation commit points (docs/design/node-lifecycle.md):
+# - ``health.after-cordon``   staleness confirmed and the victim cordoned,
+#   nothing displaced yet — a restart must re-detect the same node (the
+#   hysteresis counters are in-memory) and resume the ladder idempotently.
+# - ``health.mid-displace``   fires per displaced pod (arm with at=N) — a
+#   kill here leaves some pods rebound-pending and some still on the dying
+#   node; the restart must finish the drain without double-displacing.
+HEALTH_SITES = (
+    "health.after-cordon",
+    "health.mid-displace",
+)
+
 
 class SimulatedCrash(BaseException):
     """The controller process 'died' at a named site. BaseException so no

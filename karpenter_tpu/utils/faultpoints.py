@@ -45,6 +45,21 @@ instrumentation):
                     ``commit-lost`` commits the server write but reports
                     the attempt lost — the classic split-brain seed, where
                     the holder must re-observe itself on the next campaign
+- ``kubelet.register``   crossed by the fake-kubelet fleet
+                    (tests/fake_kubelet.py) at node registration: ``drop``
+                    = never-join (the Liveness guard's prey), ``delay`` =
+                    slow-join (registration lands late but inside grace),
+                    ``zombie`` = a DELETED node's kubelet re-registering
+                    under its old name (the adoption-defense prey)
+- ``kubelet.heartbeat``  crossed per heartbeat: ``drop`` = the kubelet goes
+                    permanently dark mid-life (gone-dark detection prey),
+                    ``flap`` = one beat reports NotReady then recovers
+                    (the hysteresis must absorb it)
+- ``kubelet.pod-ready``  crossed per pod-ready transition: ``delay`` holds
+                    the transition back
+- ``kubelet.eviction``   crossed per eviction the kubelet should complete:
+                    ``black-hole`` = the pod sticks terminating forever
+                    (the stuck-drain breaker's prey)
 """
 
 from __future__ import annotations
@@ -65,6 +80,10 @@ SITES = (
     "watch.stall",
     "market.feed",
     "lease.cas",
+    "kubelet.register",
+    "kubelet.heartbeat",
+    "kubelet.pod-ready",
+    "kubelet.eviction",
 )
 
 REQUEST_SITES = tuple(s for s in SITES if s.startswith("api.request."))
@@ -81,6 +100,10 @@ KINDS_BY_SITE = {
     "watch.stall": ("stall",),
     "market.feed": ("stale", "reorder", "blackout"),
     "lease.cas": ("conflict", "commit-lost"),
+    "kubelet.register": ("drop", "delay", "zombie"),
+    "kubelet.heartbeat": ("drop", "flap"),
+    "kubelet.pod-ready": ("delay",),
+    "kubelet.eviction": ("black-hole",),
 }
 
 

@@ -108,6 +108,23 @@ class Options:
     # Lower = tighter arrays, more re-uploads; 1.0 effectively disables
     # compaction. See docs/operations.md.
     encode_compaction_threshold: float = 0.5
+    # Node-health ladder (controllers/health.py; docs/design/
+    # node-lifecycle.md and the operations.md "unhealthy node" runbook):
+    # heartbeat age past which a JOINED node counts unreachable — kube's
+    # node-monitor-grace-period analogue. The escalation ladder engages
+    # after STALE_OBSERVATIONS consecutive unhealthy sweeps.
+    node_unreachable_timeout: float = 60.0
+    # How long a node may exist without its kubelet EVER reporting before
+    # the Liveness guard deletes it (controllers/node.py; replaces the old
+    # LIVENESS_TIMEOUT_SECONDS constant as the wired value). Must cover the
+    # instancegc launch grace: deleting a never-joined node earlier than
+    # the GC's bootstrap window races a legitimately slow bootstrap.
+    node_liveness_timeout: float = 900.0
+    # Polite-drain budget for a confirmed-unhealthy node; past it the drain
+    # escalates over PDBs and do-not-evict (counted on
+    # drain_stalled_total{reason="unreachable"}) rather than leaving pods
+    # on an unreachable node.
+    drain_stuck_timeout: float = 120.0
 
     def _kube_retry_errors(self) -> List[str]:
         """Retry-envelope flag validation (kubeapi/client.py RetryPolicy)."""
@@ -186,6 +203,39 @@ class Options:
             errors.append(
                 "encode-compaction-threshold must be in (0, 1], got "
                 f"{self.encode_compaction_threshold}"
+            )
+        errors.extend(self._node_health_errors())
+        return errors
+
+    def _node_health_errors(self) -> List[str]:
+        """Node-health timeout validation, including the ordering contract
+        with the leaked-capacity GC (controllers/instancegc.py)."""
+        from karpenter_tpu.controllers.instancegc import LAUNCH_GRACE_SECONDS
+
+        errors: List[str] = []
+        for flag, value in (
+            ("node-unreachable-timeout", self.node_unreachable_timeout),
+            ("node-liveness-timeout", self.node_liveness_timeout),
+            ("drain-stuck-timeout", self.drain_stuck_timeout),
+        ):
+            if value <= 0:
+                errors.append(f"{flag} must be > 0, got {value}")
+        if 0 < self.node_liveness_timeout < LAUNCH_GRACE_SECONDS:
+            errors.append(
+                "node-liveness-timeout must be >= the instancegc launch "
+                f"grace ({LAUNCH_GRACE_SECONDS:.0f}s) — deleting a "
+                "never-joined node inside the bootstrap window races the "
+                f"leak GC, got {self.node_liveness_timeout}"
+            )
+        if (
+            self.node_unreachable_timeout > 0
+            and self.node_liveness_timeout > 0
+            and self.node_unreachable_timeout >= self.node_liveness_timeout
+        ):
+            errors.append(
+                "node-unreachable-timeout must be < node-liveness-timeout "
+                "(gone-dark detection is the fast path), got "
+                f"{self.node_unreachable_timeout} >= {self.node_liveness_timeout}"
             )
         return errors
 
@@ -273,6 +323,18 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         "--slo-ttfl", type=float,
         default=float(_env("SLO_TTFL", "0")),
     )
+    parser.add_argument(
+        "--node-unreachable-timeout", type=float,
+        default=float(_env("NODE_UNREACHABLE_TIMEOUT", "60")),
+    )
+    parser.add_argument(
+        "--node-liveness-timeout", type=float,
+        default=float(_env("NODE_LIVENESS_TIMEOUT", "900")),
+    )
+    parser.add_argument(
+        "--drain-stuck-timeout", type=float,
+        default=float(_env("DRAIN_STUCK_TIMEOUT", "120")),
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -301,6 +363,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         reprice_threshold=args.reprice_threshold,
         reprice_debounce=args.reprice_debounce,
         market_poll_interval=args.market_poll_interval,
+        node_unreachable_timeout=args.node_unreachable_timeout,
+        node_liveness_timeout=args.node_liveness_timeout,
+        drain_stuck_timeout=args.drain_stuck_timeout,
     )
     options.validate()
     return options

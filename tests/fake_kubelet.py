@@ -1,0 +1,240 @@
+"""A simulated kubelet fleet — the kwok-style node-lifecycle harness.
+
+Large clusters with realistic node lifecycles have to fit in CI: every
+controller test so far flipped ``node.ready`` / ``status_reported_at`` by
+hand, which exercises none of the heartbeat plumbing and cannot express a
+node that *misbehaves*. This fleet plays the kubelet side of the protocol
+against either store backend through the ordinary Cluster verbs, clock-
+driven and threadless (tests and smokes call ``step()`` like chaos_smoke's
+``nudge``):
+
+- **join**: the first heartbeat stamps ``status_reported_at`` and flips the
+  node Ready (``Cluster.heartbeat_node`` — a status-only write on the
+  apiserver backend, exactly the patch a real kubelet's status loop issues);
+- **heartbeats**: every beat refreshes the stamp while the kubelet is alive;
+- **pod-ready transitions**: pods bound to the node are acknowledged as
+  running on the following beat;
+- **eviction handling**: a pod the controllers marked terminating
+  (deletionTimestamp set) is completed — deleted — by its node's kubelet,
+  the role the real kubelet plays in an eviction.
+
+Per-node misbehavior is drawn from the ``kubelet.*`` faultpoints
+(utils/faultpoints.py), so a storm armed after ``faultpoints.seed(n)``
+replays bit-identically:
+
+- ``kubelet.register``: ``drop`` = never-join, ``delay`` = slow-join,
+  ``zombie`` = after its node is DELETED the kubelet re-registers under the
+  old name with the dead incarnation's provider id — the adoption-defense
+  prey (controllers/health.py must reject it);
+- ``kubelet.heartbeat``: ``drop`` = the kubelet goes permanently dark
+  mid-life (latched), ``flap`` = one beat reports NotReady then recovers;
+- ``kubelet.pod-ready``: ``delay`` holds a pod's running acknowledgment;
+- ``kubelet.eviction``: ``black-hole`` = the pod sticks terminating forever
+  (latched per pod) — the stuck-drain breaker's prey.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.cloudprovider import NodeSpec
+from karpenter_tpu.controllers.cluster import Cluster
+from karpenter_tpu.utils import faultpoints
+
+
+class FakeKubelet:
+    """One node's kubelet: behavior is drawn ONCE at adoption (first-winner
+    semantics over the stacked ``kubelet.register`` faults gives each node
+    at most one registration behavior), heartbeat/eviction faults roll per
+    beat and latch where the physical failure would."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        node: NodeSpec,
+        slow_join_s: float = 2.0,
+        heartbeat_interval_s: float = 0.0,
+    ):
+        self.cluster = cluster
+        self.name = node.name
+        # Status-loop period (fake seconds): 0 = report every step; storm
+        # harnesses raise it so a 500-kubelet fleet doesn't issue 500 status
+        # patches per beat (a real kubelet reports every ~10s, not per tick).
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._last_heartbeat: float = float("-inf")
+        self.provider_id = node.provider_id
+        self.labels = dict(node.labels)
+        self.instance_type = node.instance_type
+        self.zone = node.zone
+        self.capacity = dict(node.capacity)
+        self.capacity_type = node.capacity_type
+        self.never_join = False
+        self.zombie = False
+        self.rejoined = False
+        self.dark = False  # heartbeat-loss latched: permanently silent
+        self.join_at = cluster.clock.now()
+        fault = faultpoints.draw("kubelet.register")
+        if fault is not None:
+            if fault.kind == "drop":
+                self.never_join = True
+            elif fault.kind == "delay":
+                self.join_at += fault.delay_s or slow_join_s
+            elif fault.kind == "zombie":
+                self.zombie = True
+        self.joined = False
+        # Pods acknowledged running; a pod-ready delay holds the ack a beat.
+        self.running: Set[Tuple[str, str]] = set()
+        self._ready_held: Set[Tuple[str, str]] = set()
+        # Pods whose eviction this kubelet will never complete.
+        self.black_holed: Set[Tuple[str, str]] = set()
+
+    def step(self, now: float, pods: Optional[List] = None) -> None:
+        """One kubelet tick. `pods` is an optional pre-indexed list of this
+        node's pods (the fleet builds one index per step instead of letting
+        500 kubelets each filter the full pod list)."""
+        if self.never_join or self.dark:
+            return
+        if now < self.join_at:
+            return  # slow-join: registration lands late
+        node = self.cluster.try_get_node(self.name)
+        if node is None:
+            if self.zombie and self.joined and not self.rejoined:
+                self._rejoin()
+            elif not self.zombie:
+                return
+            node = self.cluster.try_get_node(self.name)
+            if node is None:
+                return  # rejoin rejected (or never attempted): stay dead
+        if pods is None:
+            pods = self.cluster.list_pods(node_name=self.name)
+        if node.deletion_timestamp is not None:
+            # A deleting node's kubelet keeps serving evictions (the drain
+            # depends on it) but its heartbeats no longer matter.
+            self._handle_evictions(pods)
+            return
+        if now - self._last_heartbeat >= self.heartbeat_interval_s:
+            ready = True
+            fault = faultpoints.draw("kubelet.heartbeat")
+            if fault is not None:
+                if fault.kind == "drop":
+                    self.dark = True  # mid-life heartbeat loss: latched
+                    return
+                if fault.kind == "flap":
+                    ready = False  # one NotReady beat; next beat recovers
+            self.cluster.heartbeat_node(self.name, ready=ready)
+            self.joined = True
+            self._last_heartbeat = now
+        if not self.joined:
+            return  # first status report hasn't happened yet
+        self._acknowledge_pods(pods)
+        self._handle_evictions(pods)
+
+    def _rejoin(self) -> None:
+        """The zombie: its Node was deleted (instance terminated at the
+        cloud) but the kubelet never got the memo and re-registers under the
+        SAME name with the DEAD incarnation's provider id. The health
+        controller must reject this instead of adopting it."""
+        self.rejoined = True
+        ghost = NodeSpec(
+            name=self.name,
+            provider_id=self.provider_id,
+            labels=dict(self.labels),
+            instance_type=self.instance_type,
+            zone=self.zone,
+            capacity=dict(self.capacity),
+            capacity_type=self.capacity_type,
+            ready=True,
+        )
+        try:
+            self.cluster.create_node(ghost)
+        except Exception:  # noqa: BLE001 — a 409 means the name was retaken
+            return
+
+    def _acknowledge_pods(self, pods: List) -> None:
+        for pod in pods:
+            key = (pod.namespace, pod.name)
+            if key in self.running or pod.deletion_timestamp is not None:
+                continue
+            if key not in self._ready_held:
+                fault = faultpoints.draw("kubelet.pod-ready")
+                if fault is not None and fault.kind == "delay":
+                    self._ready_held.add(key)  # ack on a later beat
+                    continue
+            self._ready_held.discard(key)
+            self.running.add(key)
+
+    def _handle_evictions(self, pods: List) -> None:
+        """Complete evictions: the kubelet kills the container and the pod
+        object goes away — unless this kubelet black-holes it."""
+        for pod in pods:
+            if pod.deletion_timestamp is None:
+                continue
+            key = (pod.namespace, pod.name)
+            if key in self.black_holed:
+                continue
+            fault = faultpoints.draw("kubelet.eviction")
+            if fault is not None and fault.kind == "black-hole":
+                self.black_holed.add(key)  # stuck terminating forever
+                continue
+            self.running.discard(key)
+            self.cluster.delete_pod(pod.namespace, pod.name)
+
+
+class FakeKubeletFleet:
+    """Adopts a kubelet for every managed node as it appears and beats the
+    whole fleet once per ``step()``. Deleted nodes keep their kubelet object
+    (a zombie needs it to rejoin); re-adoption is suppressed so a zombie's
+    re-registration doesn't mint a fresh, well-behaved kubelet."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        slow_join_s: float = 2.0,
+        heartbeat_interval_s: float = 0.0,
+    ):
+        self.cluster = cluster
+        self.slow_join_s = slow_join_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.kubelets: Dict[str, FakeKubelet] = {}
+
+    def sync(self) -> None:
+        for node in self.cluster.list_nodes():
+            if node.name in self.kubelets:
+                continue
+            if wellknown.PROVISIONER_NAME_LABEL not in node.labels:
+                continue  # foreign nodes bring their own kubelet
+            self.kubelets[node.name] = FakeKubelet(
+                self.cluster,
+                node,
+                slow_join_s=self.slow_join_s,
+                heartbeat_interval_s=self.heartbeat_interval_s,
+            )
+
+    def step(self) -> None:
+        self.sync()
+        now = self.cluster.clock.now()
+        by_node: Dict[str, List] = {}
+        for pod in self.cluster.list_pods():
+            if pod.node_name is not None:
+                by_node.setdefault(pod.node_name, []).append(pod)
+        for kubelet in list(self.kubelets.values()):
+            kubelet.step(now, pods=by_node.get(kubelet.name, []))
+
+    def kubelet(self, name: str) -> Optional[FakeKubelet]:
+        return self.kubelets.get(name)
+
+    # --- storm accounting ----------------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        """Behavior census for storm logs/assertions."""
+        return {
+            "total": len(self.kubelets),
+            "never_join": sum(1 for k in self.kubelets.values() if k.never_join),
+            "dark": sum(1 for k in self.kubelets.values() if k.dark),
+            "zombies": sum(1 for k in self.kubelets.values() if k.zombie),
+            "rejoined": sum(1 for k in self.kubelets.values() if k.rejoined),
+            "black_holed_pods": sum(
+                len(k.black_holed) for k in self.kubelets.values()
+            ),
+        }

@@ -15,6 +15,7 @@ from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.health import HealthController
 from karpenter_tpu.controllers.instancegc import InstanceGcController
 from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.metrics import MetricsController
@@ -97,6 +98,9 @@ class Harness:
             self.cluster, self.cloud, self.provisioning, self.termination
         )
         self.consolidation = ConsolidationController(
+            self.cluster, self.cloud, self.provisioning, self.termination
+        )
+        self.health = HealthController(
             self.cluster, self.cloud, self.provisioning, self.termination
         )
 

@@ -16,6 +16,7 @@ from tests import harness as harness_mod
 from tests import test_chaos as chaos
 from tests import test_consolidation as consolidation
 from tests import test_crash_consistency as crash
+from tests import test_health as health
 from tests import test_interruption as interruption
 from tests import test_market_feed as market_feed
 from tests import test_node_lifecycle as lifecycle
@@ -168,6 +169,41 @@ class TestProvisioningUnderApiFaultsOnApiserver(chaos.TestProvisioningUnderApiFa
     actually fire — the 409-create → GET → retry-once path and the
     committed-timeout re-POST must converge with zero leaked instances,
     indistinguishable (to the controllers) from the quiet in-memory run."""
+
+
+class TestHealthDetectionOnApiserver(health.TestHealthDetection):
+    """The unhealthy-node ladder against the fake apiserver: heartbeats are
+    status-only merge-patches (disjoint from the controller's metadata/spec
+    writes), the re-taint and cordon are real patches, and the gone-dark
+    liveness-gap regression holds on the write-through store."""
+
+
+class TestStuckDrainOnApiserver(health.TestStuckDrain):
+    pass
+
+
+class TestZombieDefenseOnApiserver(health.TestZombieDefense):
+    """Zombie re-registration on this backend is a real POST racing the
+    deletion tombstones — the rejection must hold regardless."""
+
+
+class TestHealthCrashMatrixOnApiserver(health.TestHealthCrashMatrix):
+    pass
+
+
+class TestKubeletFleetOnApiserver(health.TestKubeletFleet):
+    """The fake-kubelet fleet speaks to the write-through cluster — every
+    heartbeat and eviction completion is a live apiserver request."""
+
+
+class TestReadinessRetaintOnApiserver(health.TestReadinessRetaint):
+    pass
+
+
+class TestNodeControllerStalenessOnApiserver(health.TestNodeControllerStaleness):
+    """The stale-object satellite's real shape: between sub-reconciler
+    patches the informer cache has moved — the re-read must pick up the
+    merged object, not the pre-write snapshot."""
 
 
 class TestLeaseCasUnderChaos:

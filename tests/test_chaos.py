@@ -119,10 +119,11 @@ class TestFaultpointFacility:
         the fake apiserver's stall handler) may not drift apart — a new
         kube-call site must declare its chaos coverage in both places."""
         scanned = list((Path(karpenter_tpu.__file__).parent).rglob("*.py")) + [
-            Path(__file__).parent / "fake_apiserver.py"
+            Path(__file__).parent / "fake_apiserver.py",
+            Path(__file__).parent / "fake_kubelet.py",
         ]
         pattern = re.compile(
-            r'"((?:api\.request|watch)\.[a-z0-9-]+|market\.feed|lease\.cas)"'
+            r'"((?:api\.request|watch|kubelet)\.[a-z0-9-]+|market\.feed|lease\.cas)"'
         )
         found = set()
         for path in scanned:

@@ -300,4 +300,6 @@ class TestCrashpointFacility:
             crashpoints.INTERRUPTION_SITES
         ) | set(crashpoints.CONSOLIDATION_SITES) | set(
             crashpoints.ENCODE_SITES
-        ) | set(crashpoints.MARKET_SITES) | set(crashpoints.LEADER_SITES)
+        ) | set(crashpoints.MARKET_SITES) | set(crashpoints.LEADER_SITES) | set(
+            crashpoints.HEALTH_SITES
+        )

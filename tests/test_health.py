@@ -1,0 +1,601 @@
+"""Unhealthy-node battletest: a node that joined and then went dark (or
+reports NotReady past the flap hysteresis) must ride the escalation ladder —
+re-taint, cordon, PDB-gated displacement, replacement fed ahead of the
+drain, finalizer delete — with the stuck-drain breaker and zombie defense
+closing the corners, and the same properties must survive a controller
+killed at any health crashpoint.
+
+The fake-kubelet fleet (tests/fake_kubelet.py) drives the kubelet side so
+the heartbeat plumbing itself is under test, not hand-flipped node fields.
+`make lifecycle-smoke` wraps the same subsystem in a 500-node storm; this
+module is the deterministic matrix. test_backend_parity re-runs the classes
+against the fake apiserver.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+from karpenter_tpu.controllers.health import (
+    NODE_HEARTBEAT_STALE_SECONDS,
+    NODE_UNHEALTHY_TOTAL,
+    NODE_ZOMBIE_REJECTIONS_TOTAL,
+    HealthController,
+)
+from karpenter_tpu.controllers.instancegc import (
+    LAUNCH_GRACE_SECONDS,
+    InstanceGcController,
+)
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import (
+    DRAIN_STALLED_TOTAL,
+    TerminationController,
+)
+from karpenter_tpu.cloudprovider import CloudInstance, NodeSpec
+from karpenter_tpu.utils import crashpoints, faultpoints
+from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+from tests import fixtures
+from tests.fake_kubelet import FakeKubeletFleet
+from tests.harness import Harness
+
+
+class BindRecorder:
+    """Watch-driven record of every node a pod was ever bound to (consecutive
+    duplicates collapsed) — the 'rebinds exactly once' oracle."""
+
+    def __init__(self, cluster):
+        self.bound = {}
+        cluster.watch(self._on)
+
+    def _on(self, kind, obj) -> None:
+        if kind != "pod" or getattr(obj, "node_name", None) is None:
+            return
+        seq = self.bound.setdefault(obj.uid, [])
+        if not seq or seq[-1] != obj.node_name:
+            seq.append(obj.node_name)
+
+
+def joined_harness(n_pods=3, pods=None):
+    """Harness + provisioner + n pods packed onto one node whose kubelet has
+    heartbeated (joined, Ready, not-ready taint stripped); returns
+    (harness, recorder, pods, node)."""
+    h = Harness()
+    recorder = BindRecorder(h.cluster)
+    h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    pods = pods if pods is not None else fixtures.pods(n_pods)
+    h.provision(*pods)
+    node = h.expect_scheduled(pods[0])
+    for pod in pods[1:]:
+        assert h.expect_scheduled(pod).name == node.name
+    h.cluster.heartbeat_node(node.name)
+    h.node.reconcile(node.name)  # Ready: strips the not-ready taint
+    node = h.cluster.get_node(node.name)
+    assert not any(t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints)
+    return h, recorder, pods, node
+
+
+def sweep_until_confirmed(h: Harness, extra: int = 0) -> None:
+    """Advance past the unreachable timeout, then run exactly enough sweeps
+    for the hysteresis to pass (+ extra)."""
+    h.clock.advance(h.health.unreachable_timeout + 1)
+    for _ in range(h.health.stale_observations + extra):
+        h.health.reconcile()
+        h.clock.advance(2.0)
+
+
+def converge(h: Harness, rounds: int = 6) -> None:
+    """Drive health sweeps + provisioning + terminations to a fixpoint."""
+    for _ in range(rounds):
+        h.health.reconcile()
+        for worker in list(h.provisioning.workers.values()):
+            worker.provision()
+        h.reconcile_terminations(rounds=3)
+
+
+def restart(h: Harness) -> None:
+    """A controller-process restart over the surviving cluster + cloud state,
+    including the health controller, plus the boot re-list routing
+    still-pending pods back through selection."""
+    h.provisioning = ProvisioningController(h.cluster, h.cloud, None)
+    h.selection = SelectionController(h.cluster, h.provisioning)
+    h.termination = TerminationController(h.cluster, h.cloud)
+    h.instancegc = InstanceGcController(h.cluster, h.cloud)
+    h.node = NodeController(h.cluster)
+    h.health = HealthController(
+        h.cluster, h.cloud, h.provisioning, h.termination
+    )
+    for provisioner in h.cluster.list_provisioners():
+        h.provisioning.reconcile(provisioner.name)
+    for pod in h.cluster.list_pods():
+        if pod.is_provisionable():
+            h.selection.reconcile(pod.namespace, pod.name)
+
+
+def assert_rebound_exactly_once(h, recorder, pods, old_node) -> None:
+    for pod in pods:
+        live = h.cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} never rebound"
+        assert live.node_name != old_node.name
+        assert h.cluster.try_get_node(live.node_name) is not None
+        assert recorder.bound[pod.uid] == [old_node.name, live.node_name], (
+            f"{pod.name} bind history {recorder.bound[pod.uid]}"
+        )
+
+
+def assert_no_leaks(h: Harness) -> None:
+    h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+    h.instancegc.reconcile()
+    h.instancegc.reconcile()
+    node_ids = {n.provider_id for n in h.cluster.list_nodes()}
+    leaked = set(h.cloud.instances) - node_ids
+    assert not leaked, f"instances with no Node after GC grace: {sorted(leaked)}"
+
+
+class TestHealthDetection:
+    def test_gone_dark_node_cordoned_drained_replaced_deleted(self):
+        """The acceptance scenario and the liveness-gap regression: a node
+        that heartbeated ONCE and went dark — which the Liveness guard
+        deliberately ignores — is confirmed stale, cordoned, drained,
+        replaced, and deleted within the unreachable timeout + drain
+        budget, with every pod rebound exactly once and zero leaks."""
+        h, recorder, pods, node = joined_harness()
+        start = h.clock.now()
+        before = NODE_UNHEALTHY_TOTAL.get("stale-heartbeat")
+
+        sweep_until_confirmed(h)
+        live = h.cluster.try_get_node(node.name)
+        assert live is None or live.deletion_timestamp is not None, (
+            "gone-dark node not handed to the finalizer path"
+        )
+        if live is not None:
+            assert live.unschedulable, "victim was not cordoned"
+            assert any(
+                t.key == wellknown.NOT_READY_TAINT_KEY for t in live.taints
+            ), "victim was not re-tainted"
+        assert NODE_UNHEALTHY_TOTAL.get("stale-heartbeat") - before == 1
+
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        assert node.name in h.cloud.deleted_nodes
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        elapsed = h.clock.now() - start
+        assert elapsed <= (
+            h.health.unreachable_timeout + h.health.drain_stuck_timeout
+        ), f"convergence took {elapsed}s"
+        assert_no_leaks(h)
+
+    def test_flap_is_absorbed_by_hysteresis(self):
+        """One NotReady beat (or one missed sweep) must not reach the
+        ladder: a fresh healthy heartbeat resets the strike count."""
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        before = NODE_UNHEALTHY_TOTAL.get("not-ready")
+        for _ in range(h.health.stale_observations - 1):
+            h.cluster.heartbeat_node(node.name, ready=False)
+            h.health.reconcile()
+        h.cluster.heartbeat_node(node.name, ready=True)  # recovers
+        for _ in range(h.health.stale_observations):
+            h.health.reconcile()
+        live = h.cluster.get_node(node.name)
+        assert live.deletion_timestamp is None
+        assert not live.unschedulable
+        assert NODE_UNHEALTHY_TOTAL.get("not-ready") == before
+        assert h.health._strikes.get(node.name, 0) == 0
+
+    def test_persistent_not_ready_escalates(self):
+        """A kubelet that keeps heartbeating but reports NotReady is just as
+        dead to the scheduler — same ladder, reason='not-ready'."""
+        h, recorder, pods, node = joined_harness()
+        before = NODE_UNHEALTHY_TOTAL.get("not-ready")
+        for _ in range(h.health.stale_observations):
+            h.cluster.heartbeat_node(node.name, ready=False)
+            h.health.reconcile()
+        assert NODE_UNHEALTHY_TOTAL.get("not-ready") - before == 1
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert_no_leaks(h)
+
+    def test_never_joined_node_is_livenesss_case(self):
+        """status_reported_at=None is the Liveness guard's jurisdiction —
+        health must not double-handle it (two controllers deleting the same
+        node would race their replacement launches)."""
+        h, recorder, pods, node = joined_harness()
+        fresh = h.provision(fixtures.pod(name="late"))
+        never_joined = h.expect_scheduled(fresh[0])
+        assert never_joined.status_reported_at is None
+        sweep_until_confirmed(h, extra=2)
+        live = h.cluster.try_get_node(never_joined.name)
+        assert live is not None and live.deletion_timestamp is None
+
+    def test_interruption_owned_node_is_skipped(self):
+        """A node the interruption drain already owns must not be
+        double-driven — one ladder at a time."""
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        node.annotations[wellknown.INTERRUPTION_KIND_ANNOTATION] = "spot"
+        h.cluster.update_node(node)
+        before = NODE_UNHEALTHY_TOTAL.get("stale-heartbeat")
+        sweep_until_confirmed(h, extra=2)
+        assert NODE_UNHEALTHY_TOTAL.get("stale-heartbeat") == before
+
+    def test_staleness_gauge_tracks_worst_node(self):
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        h.clock.advance(30.0)
+        h.health.reconcile()
+        assert NODE_HEARTBEAT_STALE_SECONDS.get() == pytest.approx(30.0)
+        h.cluster.heartbeat_node(node.name)
+        h.health.reconcile()
+        assert NODE_HEARTBEAT_STALE_SECONDS.get() == pytest.approx(0.0)
+
+
+class TestStuckDrain:
+    def test_do_not_evict_waits_then_breaker_fires(self):
+        """Polite first: a do-not-evict pod pins the drain. Past the
+        drain-stuck budget the breaker escalates LOUDLY — leaving pods on an
+        unreachable node is strictly worse than any protection."""
+        protected = fixtures.pod(
+            annotations={wellknown.DO_NOT_EVICT_ANNOTATION: "true"}
+        )
+        h, recorder, pods, node = joined_harness(pods=[protected, fixtures.pod()])
+        stalled_before = DRAIN_STALLED_TOTAL.get("unreachable")
+
+        sweep_until_confirmed(h)
+        live = h.cluster.get_node(node.name)
+        assert live.deletion_timestamp is None, "polite phase overrode do-not-evict"
+        assert live.unschedulable
+        assert (
+            h.cluster.get_pod(protected.namespace, protected.name).node_name
+            == node.name
+        )
+        assert DRAIN_STALLED_TOTAL.get("unreachable") == stalled_before
+
+        h.clock.advance(h.health.drain_stuck_timeout + 1)
+        h.health.reconcile()
+        assert DRAIN_STALLED_TOTAL.get("unreachable") - stalled_before == 1
+        h.health.reconcile()  # the breaker counts once per episode
+        assert DRAIN_STALLED_TOTAL.get("unreachable") - stalled_before == 1
+
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert_no_leaks(h)
+
+    def test_pdb_refusal_waits_then_breaker_overrides(self):
+        guarded = [fixtures.pod(labels={"app": "db"}) for _ in range(2)]
+        h, recorder, pods, node = joined_harness(pods=guarded)
+        h.cluster.apply_pdb("db-pdb", {"app": "db"}, min_available=2)
+        stalled_before = DRAIN_STALLED_TOTAL.get("unreachable")
+
+        sweep_until_confirmed(h)
+        assert h.cluster.get_node(node.name).deletion_timestamp is None
+        for pod in pods:
+            assert h.cluster.get_pod(pod.namespace, pod.name).node_name == node.name
+
+        h.clock.advance(h.health.drain_stuck_timeout + 1)
+        h.health.reconcile()
+        assert DRAIN_STALLED_TOTAL.get("unreachable") - stalled_before == 1
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert_no_leaks(h)
+
+
+class TestZombieDefense:
+    def _drain_to_deletion(self):
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        sweep_until_confirmed(h)
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        return h, node
+
+    def test_buried_provider_id_rejected_on_reregistration(self):
+        """The dead kubelet phoning home: same name, same (dead) provider id
+        — rejected, never adopted, counted."""
+        h, node = self._drain_to_deletion()
+        before = NODE_ZOMBIE_REJECTIONS_TOTAL.get()
+        zombie = NodeSpec(
+            name=node.name,
+            provider_id=node.provider_id,
+            labels=dict(node.labels),
+            ready=True,
+        )
+        h.cluster.create_node(zombie)
+        h.health.reconcile()
+        assert NODE_ZOMBIE_REJECTIONS_TOTAL.get() - before == 1
+        live = h.cluster.try_get_node(node.name)
+        assert live is None or live.deletion_timestamp is not None
+
+    def test_replacement_with_fresh_provider_id_is_adopted(self):
+        """The negative control: a same-name node riding a FRESH launch is a
+        legitimate replacement, not a zombie."""
+        h, node = self._drain_to_deletion()
+        before = NODE_ZOMBIE_REJECTIONS_TOTAL.get()
+        fresh = "fake:///z/fi-fresh-launch"
+        h.cloud.instances[fresh] = CloudInstance(
+            instance_id="fi-fresh-launch", provider_id=fresh
+        )
+        h.cluster.create_node(
+            NodeSpec(
+                name=node.name,
+                provider_id=fresh,
+                labels=dict(node.labels),
+                ready=True,
+            )
+        )
+        h.cluster.heartbeat_node(node.name)
+        h.health.reconcile()
+        h.health.reconcile()
+        assert NODE_ZOMBIE_REJECTIONS_TOTAL.get() == before
+        assert h.cluster.get_node(node.name).deletion_timestamp is None
+
+    def test_instance_less_ghost_reaped_after_two_sightings(self):
+        """The restart-durable layer: a node no provider listing accounts
+        for is reaped on the SECOND consecutive sighting (the instancegc
+        hysteresis — one sweep of listing lag proves nothing)."""
+        h, recorder, pods, node = joined_harness(n_pods=1)  # a real instance
+        before = NODE_ZOMBIE_REJECTIONS_TOTAL.get()
+        ghost = NodeSpec(
+            name="ghost",
+            provider_id="fake:///z/fi-ghost",
+            labels={wellknown.PROVISIONER_NAME_LABEL: "default"},
+            ready=True,
+        )
+        h.cluster.create_node(ghost)
+        h.health.reconcile()
+        assert NODE_ZOMBIE_REJECTIONS_TOTAL.get() == before  # first sighting
+        assert h.cluster.try_get_node("ghost") is not None
+        h.health.reconcile()
+        assert NODE_ZOMBIE_REJECTIONS_TOTAL.get() - before == 1
+        assert h.cluster.try_get_node("ghost") is None
+        # The real node was never collateral damage.
+        assert h.cluster.get_node(node.name).deletion_timestamp is None
+
+    def test_empty_provider_listing_disables_ghost_check(self):
+        """A backend that enumerates nothing must not get the whole fleet
+        reaped as ghosts."""
+        h = Harness()
+        h.cluster.create_node(
+            NodeSpec(
+                name="unlisted",
+                provider_id="fake:///z/fi-unlisted",
+                labels={wellknown.PROVISIONER_NAME_LABEL: "default"},
+                ready=True,
+            )
+        )
+        assert h.cloud.list_instances() == []
+        before = NODE_ZOMBIE_REJECTIONS_TOTAL.get()
+        h.health.reconcile()
+        h.health.reconcile()
+        h.health.reconcile()
+        assert NODE_ZOMBIE_REJECTIONS_TOTAL.get() == before
+        assert h.cluster.get_node("unlisted").deletion_timestamp is None
+
+
+# Every health site at its first passage, plus mid-displace at its second
+# (first pod displaced and fed, controller dies before the rest).
+HEALTH_MATRIX = [(site, 1) for site in crashpoints.HEALTH_SITES] + [
+    ("health.mid-displace", 2)
+]
+
+
+class TestHealthCrashMatrix:
+    """The controller killed at every health commit point, restarted over
+    the surviving state, and the escalation still converges — pods rebound
+    exactly once, victim gone, zero leaked instances."""
+
+    @pytest.mark.parametrize(
+        "site,at", HEALTH_MATRIX, ids=[f"{s}@{a}" for s, a in HEALTH_MATRIX]
+    )
+    def test_kill_restart_converges(self, site, at):
+        h, recorder, pods, node = joined_harness()
+        h.clock.advance(h.health.unreachable_timeout + 1)
+        crashpoints.arm(site, at=at)
+        with pytest.raises(SimulatedCrash) as crash:
+            for _ in range(h.health.stale_observations + 1):
+                h.health.reconcile()
+        assert crash.value.site == site
+        restart(h)
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert_no_leaks(h)
+
+
+class TestKubeletFleet:
+    """The fake-kubelet fleet against the real controllers: heartbeats flow
+    through Cluster.heartbeat_node (a status-only write on the apiserver
+    backend), behaviors come from the seeded kubelet faultpoints."""
+
+    def test_fleet_joins_nodes_and_strips_taint(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        h.provision(*fixtures.pods(3))
+        fleet = FakeKubeletFleet(h.cluster)
+        fleet.step()
+        for node in h.cluster.list_nodes():
+            assert node.ready and node.status_reported_at is not None
+            h.node.reconcile(node.name)
+        for node in h.cluster.list_nodes():
+            assert not any(
+                t.key == wellknown.NOT_READY_TAINT_KEY for t in node.taints
+            )
+
+    def test_fleet_acknowledges_pods_running(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        pods = h.provision(*fixtures.pods(2))
+        fleet = FakeKubeletFleet(h.cluster)
+        fleet.step()
+        running = set()
+        for kubelet in fleet.kubelets.values():
+            running |= kubelet.running
+        assert {(p.namespace, p.name) for p in pods} == running
+
+    def test_never_join_fault_leaves_node_for_liveness(self):
+        faultpoints.seed(7)
+        faultpoints.arm("kubelet.register", "drop", rate=1.0)
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        h.provision(fixtures.pod())
+        fleet = FakeKubeletFleet(h.cluster)
+        for _ in range(5):
+            fleet.step()
+            h.clock.advance(2.0)
+        node = h.cluster.list_nodes()[0]
+        assert node.status_reported_at is None  # Liveness will reap it
+
+    def test_heartbeat_drop_goes_dark_and_health_reaps(self):
+        """End-to-end tentpole: kubelet joins, loses heartbeats mid-life
+        (faultpoint), health confirms staleness and runs the ladder; the
+        fleet's eviction handling completes the drain."""
+        faultpoints.seed(11)
+        h, recorder, pods, node = joined_harness()
+        fleet = FakeKubeletFleet(h.cluster)
+        fleet.step()  # adopt + heartbeat
+        faultpoints.arm("kubelet.heartbeat", "drop", rate=1.0)
+        fleet.step()  # the drop latches: kubelet goes dark
+        assert fleet.kubelet(node.name).dark
+        faultpoints.disarm_all()
+        h.clock.advance(h.health.unreachable_timeout + 1)
+        for _ in range(h.health.stale_observations):
+            h.health.reconcile()
+            fleet.step()  # dark kubelet stays silent; others keep beating
+        for _ in range(6):
+            h.health.reconcile()
+            for worker in list(h.provisioning.workers.values()):
+                worker.provision()
+            fleet.step()  # kubelets complete evictions
+            h.reconcile_terminations(rounds=3)
+        assert h.cluster.try_get_node(node.name) is None
+        assert_rebound_exactly_once(h, recorder, pods, node)
+        assert_no_leaks(h)
+
+    def test_eviction_black_hole_sticks_until_breaker(self):
+        """A black-holed eviction leaves the pod terminating forever — the
+        kubelet-side stall the drain breaker exists for."""
+        faultpoints.seed(13)
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        fleet = FakeKubeletFleet(h.cluster)
+        fleet.step()
+        faultpoints.arm("kubelet.eviction", "black-hole", rate=1.0)
+        h.cluster.evict_pod(pods[0].namespace, pods[0].name)
+        fleet.step()
+        assert (pods[0].namespace, pods[0].name) in fleet.kubelet(
+            node.name
+        ).black_holed
+        fleet.step()
+        assert (
+            h.cluster.get_pod(pods[0].namespace, pods[0].name).deletion_timestamp
+            is not None
+        ), "black-holed pod was completed anyway"
+
+    def test_zombie_kubelet_rejoins_and_is_rejected(self):
+        """The full zombie loop: register-zombie fault armed, node deleted
+        by health, kubelet re-registers the dead incarnation, health rejects
+        it instead of adopting."""
+        faultpoints.seed(17)
+        faultpoints.arm("kubelet.register", "zombie", rate=1.0)
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        fleet = FakeKubeletFleet(h.cluster)
+        fleet.step()
+        assert fleet.kubelet(node.name).zombie
+        faultpoints.disarm_all()
+        sweep_until_confirmed(h)
+        converge(h)
+        assert h.cluster.try_get_node(node.name) is None
+        before = NODE_ZOMBIE_REJECTIONS_TOTAL.get()
+        fleet.step()  # the zombie re-registers under the old name
+        assert fleet.kubelet(node.name).rejoined
+        assert h.cluster.try_get_node(node.name) is not None
+        h.health.reconcile()
+        assert NODE_ZOMBIE_REJECTIONS_TOTAL.get() - before == 1
+        live = h.cluster.try_get_node(node.name)
+        assert live is None or live.deletion_timestamp is not None
+        assert_rebound_exactly_once(h, recorder, pods, node)
+
+
+class TestReadinessRetaint:
+    """Satellite regression: readiness must be two-way — a Ready→NotReady
+    transition re-adds the not-ready taint, and in-flight schedule receivers
+    re-check the live taints before accepting a pod."""
+
+    def test_not_ready_transition_readds_taint(self):
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        h.cluster.heartbeat_node(node.name, ready=False)
+        h.node.reconcile(node.name)
+        live = h.cluster.get_node(node.name)
+        assert any(
+            t.key == wellknown.NOT_READY_TAINT_KEY for t in live.taints
+        ), "Ready→NotReady did not restore the taint"
+        # And back: recovery strips it again.
+        h.cluster.heartbeat_node(node.name, ready=True)
+        h.node.reconcile(node.name)
+        live = h.cluster.get_node(node.name)
+        assert not any(t.key == wellknown.NOT_READY_TAINT_KEY for t in live.taints)
+
+    def test_in_flight_receiver_rechecks_taints(self):
+        """A consolidation rebind planned against a then-Ready receiver must
+        refuse once the receiver went NotReady — the re-read of the live
+        node, not the stale plan, decides."""
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        orphan = fixtures.pod(cpu="0.01", memory="16Mi", name="displaced")
+        h.cluster.apply_pod(orphan)
+        assert h.consolidation._rebind(orphan, node.name), (
+            "sanity: a Ready receiver accepts"
+        )
+        h.cluster.reschedule_pod(orphan.namespace, orphan.name)
+        h.cluster.heartbeat_node(node.name, ready=False)
+        h.node.reconcile(node.name)  # re-taints
+        live_pod = h.cluster.get_pod(orphan.namespace, orphan.name)
+        assert not h.consolidation._rebind(live_pod, node.name), (
+            "NotReady receiver accepted an in-flight pod"
+        )
+
+
+class TestNodeControllerStaleness:
+    """Satellite regression: NodeController re-reads the node between
+    sub-reconcilers, so a write (or delete) by an earlier sub-reconciler —
+    or a rival controller — is visible to the next one."""
+
+    def test_later_subreconcilers_see_earlier_writes(self):
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        seen = []
+
+        class Mutator:
+            def reconcile(self, cluster, provisioner, live):
+                live.annotations["probe"] = "written"
+                cluster.update_node(live)
+                return None
+
+        class Witness:
+            def reconcile(self, cluster, provisioner, live):
+                seen.append(live.annotations.get("probe"))
+                return None
+
+        h.node.reconcilers = [Mutator(), Witness()]
+        h.node.reconcile(node.name)
+        assert seen == ["written"], (
+            "second sub-reconciler saw a stale object (annotation missing)"
+        )
+
+    def test_mid_loop_deletion_stops_the_chain(self):
+        h, recorder, pods, node = joined_harness(n_pods=1)
+        ran = []
+
+        class Deleter:
+            def reconcile(self, cluster, provisioner, live):
+                cluster.delete_node(live.name)
+                return None
+
+        class MustNotRun:
+            def reconcile(self, cluster, provisioner, live):
+                ran.append(live.name)
+                return None
+
+        h.node.reconcilers = [Deleter(), MustNotRun()]
+        assert h.node.reconcile(node.name) is None
+        assert ran == [], "sub-reconciler ran against a deleting node"

@@ -316,7 +316,12 @@ class TestPodGc:
         client.delete("/api/v1/namespaces/default/pods/p", uid="uid-new")
         assert client.try_get("/api/v1/namespaces/default/pods/p") is None
 
-    def test_bound_and_terminating_pods_untouched(self):
+    def test_pods_on_live_nodes_untouched_terminating_orphans_reaped(self):
+        """Pods on a LIVE node — bound or mid-drain terminating — are never
+        podgc's business. A terminating pod on a GONE node is: with no
+        kubelet left to complete the eviction it would stay terminating
+        forever, so it is force-deleted (kube's gcOrphaned behavior), still
+        on the second sighting only."""
         from karpenter_tpu.cloudprovider import NodeSpec
         from karpenter_tpu.controllers.podgc import PodGcController
         from tests.harness import Harness
@@ -328,17 +333,24 @@ class TestPodGc:
         bound = fixtures.pod(name="bound")
         h.cluster.apply_pod(bound)
         h.cluster.get_pod(bound.namespace, bound.name).node_name = "n1"
-        terminating = fixtures.pod(name="terminating")
-        h.cluster.apply_pod(terminating)
-        dying = h.cluster.get_pod(terminating.namespace, terminating.name)
+        draining = fixtures.pod(name="draining")
+        h.cluster.apply_pod(draining)
+        mid_drain = h.cluster.get_pod(draining.namespace, draining.name)
+        mid_drain.node_name = "n1"
+        mid_drain.deletion_timestamp = h.clock.now()
+        stuck = fixtures.pod(name="stuck")
+        h.cluster.apply_pod(stuck)
+        dying = h.cluster.get_pod(stuck.namespace, stuck.name)
         dying.node_name = "gone"
         dying.deletion_timestamp = h.clock.now()
         gc.reconcile()
+        assert h.cluster.try_get_pod(stuck.namespace, stuck.name) is not None
         gc.reconcile()
         assert h.cluster.try_get_pod(bound.namespace, bound.name) is not None
         assert h.cluster.try_get_pod(
-            terminating.namespace, terminating.name
+            draining.namespace, draining.name
         ) is not None
+        assert h.cluster.try_get_pod(stuck.namespace, stuck.name) is None
 
 
 class TestDeletionDrainPath:

@@ -1,0 +1,671 @@
+"""Node-lifecycle capstone (`make lifecycle-smoke`): a 500+ node fake-kubelet
+fleet riding a mixed misbehavior storm against the REAL threaded Manager.
+
+The fleet (tests/fake_kubelet.py) plays every kubelet: registration,
+throttled heartbeats, pod-ready acks, eviction completion — through its OWN
+apiserver frontend, modeling kubelets as processes separate from the
+controller. The storm mixes, seeded and replayable:
+
+- never-join nodes (the Liveness guard's prey: deleted at the liveness
+  deadline, their evicted pods force-reaped by podgc and re-created by the
+  smoke's replica layer);
+- slow joiners (not-ready taint stripped late);
+- ready-flaps (absorbed by the health controller's hysteresis);
+- mid-life heartbeat loss (the unhealthy-node ladder's prey: cordon →
+  displace → replace → delete, all inside the unreachable+drain budget);
+- eviction black-holes (stuck-terminating pods; podgc force-delete once the
+  node is gone);
+- zombie kubelets re-registering their deleted node (must be REJECTED);
+- an API fault storm on the controller's transport, racing arrival waves;
+- the controller process killed at ``health.after-cordon`` and
+  ``health.mid-displace`` mid-storm and rebuilt over the surviving state.
+
+At the end: every workload replica has exactly one live pod bound to a
+live, Ready, schedulable node; no pod ever ping-ponged between nodes; zero
+PDB violations (server-side watch oracle); zero leaked instances after the
+GC grace; zero zombie adoptions; the pending-p99 SLO held.
+"""
+
+import queue
+import sys
+import threading
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+FLEET_PODS = 520  # one pod per node (pinned to the 2-cpu type) -> 520 nodes
+GUARDED = 6  # replicas behind the PDB
+MIN_AVAILABLE = 3
+BEAT_FAKE_S = 3.0
+HEARTBEAT_INTERVAL_FAKE_S = 15.0
+UNREACHABLE_TIMEOUT_S = 45.0
+DRAIN_STUCK_TIMEOUT_S = 60.0
+LIVENESS_TIMEOUT_S = 300.0  # floor: instancegc LAUNCH_GRACE_SECONDS
+SLO_PENDING_P99_S = 600.0
+SLO_TTFL_S = 600.0
+INSTANCE_TYPE = "small-instance-type"
+
+
+def build_process(state):
+    """One 'controller process': a fresh ApiServerCluster + Manager over the
+    surviving apiserver + cloud — what a supervisor restart observes. The
+    kubelet fleet's frontend is NOT rebuilt: kubelets are other processes."""
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient, RetryPolicy
+    from karpenter_tpu.kubeapi.chaos import ChaosTransport
+    from karpenter_tpu.runtime import Manager
+    from karpenter_tpu.utils.options import Options
+    from tests.fake_apiserver import DirectTransport
+
+    client = KubeClient(
+        ChaosTransport(DirectTransport(state["server"]), clock=state["clock"]),
+        qps=1e6,
+        burst=10**6,
+        clock=state["clock"],
+        retry=RetryPolicy(max_attempts=6, backoff_base_s=0.01, backoff_cap_s=0.1),
+    )
+    client.WATCH_BACKOFF_BASE_S = 0.02
+    client.WATCH_BACKOFF_CAP_S = 0.5
+    cluster = ApiServerCluster(client, clock=state["clock"]).start()
+    manager = Manager(
+        cluster,
+        state["cloud"],
+        Options(
+            cluster_name="lifecycle",
+            solver="greedy",
+            leader_election=False,
+            node_unreachable_timeout=UNREACHABLE_TIMEOUT_S,
+            node_liveness_timeout=LIVENESS_TIMEOUT_S,
+            drain_stuck_timeout=DRAIN_STUCK_TIMEOUT_S,
+            slo_pending_p99=SLO_PENDING_P99_S,
+            slo_ttfl=SLO_TTFL_S,
+        ),
+    )
+    manager.start()
+    state["cluster"], state["manager"] = cluster, manager
+
+
+def stop_process(state):
+    state["manager"].stop()
+    state["cluster"].close()
+
+
+def build(state):
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+    from karpenter_tpu.utils.clock import FakeClock
+    from tests.fake_apiserver import DirectTransport, FakeApiServer
+    from tests.fake_kubelet import FakeKubeletFleet
+
+    state["clock"] = FakeClock()
+    state["server"] = FakeApiServer(clock=state["clock"], history_limit=1 << 20)
+    state["cloud"] = FakeCloudProvider(clock=state["clock"])
+    build_process(state)
+    # The kubelet fleet's own frontend: un-chaosed (the API fault storm hits
+    # the CONTROLLER's transport; a kubelet patching its node status is a
+    # different client) and never torn down by controller restarts.
+    state["kubeside"] = ApiServerCluster(
+        KubeClient(
+            DirectTransport(state["server"]),
+            qps=1e6,
+            burst=10**6,
+            clock=state["clock"],
+        ),
+        clock=state["clock"],
+    ).start()
+    state["fleet"] = FakeKubeletFleet(
+        state["kubeside"], heartbeat_interval_s=HEARTBEAT_INTERVAL_FAKE_S
+    )
+    state["kubeside"].apply_provisioner(
+        Provisioner(name="default", spec=ProvisionerSpec())
+    )
+
+
+def replica_pod(rs_id, incarnation):
+    from karpenter_tpu.api import wellknown
+    from tests import fixtures
+
+    labels = {"rs": rs_id}
+    if rs_id.startswith("guarded"):
+        labels["app"] = "guarded"
+    return fixtures.pod(
+        cpu="1.2",
+        memory="1Gi",
+        name=f"{rs_id}-r{incarnation}",
+        labels=labels,
+        node_selector={wellknown.INSTANCE_TYPE_LABEL: INSTANCE_TYPE},
+    )
+
+
+class ReplicaLayer:
+    """The smoke's ReplicaSet analogue: one desired replica per rs id; a
+    replica whose pod was evicted-and-reaped gets a fresh incarnation."""
+
+    def __init__(self, state):
+        self.state = state
+        self.desired = {}  # rs_id -> incarnation counter
+
+    def scale_up(self, rs_ids):
+        for rs_id in rs_ids:
+            self.desired[rs_id] = 1
+            self.state["kubeside"].apply_pod(replica_pod(rs_id, 1))
+
+    def scale_down(self, rs_ids):
+        cluster = self.state["kubeside"]
+        for rs_id in rs_ids:
+            self.desired.pop(rs_id, None)
+            for pod in cluster.list_pods(
+                predicate=lambda p, r=rs_id: p.labels.get("rs") == r
+            ):
+                cluster.delete_pod(pod.namespace, pod.name)
+
+    def reconcile(self):
+        cluster = self.state["kubeside"]
+        alive = {}
+        for pod in cluster.list_pods():
+            rs_id = pod.labels.get("rs")
+            if rs_id is not None and pod.deletion_timestamp is None:
+                alive[rs_id] = alive.get(rs_id, 0) + 1
+        for rs_id, incarnation in self.desired.items():
+            if alive.get(rs_id, 0) == 0:
+                self.desired[rs_id] = incarnation + 1
+                cluster.apply_pod(replica_pod(rs_id, incarnation + 1))
+
+    def fully_scheduled(self):
+        """Every desired replica has exactly one live pod, bound to a live
+        Ready schedulable node — the convergence predicate, on server truth
+        mirrored through the un-chaosed kubelet frontend."""
+        cluster = self.state["kubeside"]
+        healthy_nodes = {
+            n.name
+            for n in cluster.list_nodes()
+            if n.ready and n.deletion_timestamp is None and not n.unschedulable
+        }
+        bound = {}
+        for pod in cluster.list_pods():
+            rs_id = pod.labels.get("rs")
+            if rs_id is None or pod.deletion_timestamp is not None:
+                continue
+            bound.setdefault(rs_id, []).append(pod)
+        for rs_id in self.desired:
+            pods = bound.get(rs_id, [])
+            if len(pods) != 1:
+                return False
+            if pods[0].node_name not in healthy_nodes:
+                return False
+        return True
+
+
+def beat(state):
+    """One storm tick: fake time advances, every kubelet steps, the replica
+    layer heals, and the periodic sweeps are pulled forward so the storm
+    converges in smoke time."""
+    state["clock"].advance(BEAT_FAKE_S)
+    state["fleet"].step()
+    state["replicas"].reconcile()
+    manager = state["manager"]
+    if state["beats"] % 5 == 0:
+        # Health sweeps pace with the kubelet status period: sweeping every
+        # beat would observe one flapped heartbeat as 5 consecutive NotReady
+        # strikes and defeat the hysteresis the flap leg exists to prove.
+        manager.loops["health"].enqueue("sweep")
+        manager.loops["podgc"].enqueue("sweep")
+        for node in state["cluster"].list_nodes():
+            manager.loops["node"].enqueue(node.name)
+    for node in state["cluster"].list_nodes():
+        if node.deletion_timestamp is not None:
+            manager.loops["termination"].enqueue(node.name)
+        if not node.ready:
+            manager.loops["node"].enqueue(node.name)
+    for pod in state["cluster"].list_pods():
+        if pod.is_provisionable():
+            manager.loops["selection"].enqueue((pod.namespace, pod.name))
+    state["beats"] += 1
+    time.sleep(0.03)
+
+
+def wait_for(state, predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        beat(state)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class PdbOracle:
+    """Every pod event on the SERVER must leave the guarded group at or
+    above minAvailable — evaluated on the server's own store, immune to any
+    client-side cache staleness."""
+
+    def __init__(self, server, match_labels, min_available):
+        self.server = server
+        self.match = dict(match_labels)
+        self.min = min_available
+        self.violations = []
+        self.q = server.subscribe("pods")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _healthy(self) -> int:
+        _, payload = self.server.handle("GET", "/api/v1/pods")
+        return sum(
+            1
+            for p in payload.get("items", [])
+            if not (p.get("metadata") or {}).get("deletionTimestamp")
+            and (p.get("spec") or {}).get("nodeName")
+            and all(
+                ((p.get("metadata") or {}).get("labels") or {}).get(k) == v
+                for k, v in self.match.items()
+            )
+        )
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            healthy = self._healthy()
+            if healthy < self.min:
+                self.violations.append(healthy)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.server.unsubscribe("pods", self.q)
+
+
+class BindOracle:
+    """Watch-driven bind history per pod uid on the server's stream: a pod
+    may bind once and rebind at most twice more (displaced from a node whose
+    replacement also died is legal under a random storm; ping-ponging beyond
+    that is not)."""
+
+    MAX_BINDS = 3
+
+    def __init__(self, server):
+        self.server = server
+        self.bound = {}
+        # Seed with the pre-storm bindings: without them a displaced pod's
+        # chain would START at its post-storm node and the bound is vacuous.
+        _, payload = server.handle("GET", "/api/v1/pods")
+        for p in payload.get("items", []):
+            uid = (p.get("metadata") or {}).get("uid")
+            node = (p.get("spec") or {}).get("nodeName")
+            if uid and node:
+                self.bound[uid] = [node]
+        self.q = server.subscribe("pods")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                event = self.q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            obj = event.get("object") or {}
+            uid = (obj.get("metadata") or {}).get("uid")
+            node = (obj.get("spec") or {}).get("nodeName")
+            if not uid or not node:
+                continue
+            seq = self.bound.setdefault(uid, [])
+            if not seq or seq[-1] != node:
+                seq.append(node)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.server.unsubscribe("pods", self.q)
+
+    def worst(self):
+        return max((len(s) for s in self.bound.values()), default=0)
+
+
+def arm_kubelet_storm():
+    """The per-node misbehavior mix, seeded so the storm replays."""
+    from karpenter_tpu.utils import faultpoints
+
+    faultpoints.seed(20260806)
+    faultpoints.arm("kubelet.register", "drop", rate=0.02)  # never-join
+    faultpoints.arm("kubelet.register", "delay", rate=0.05, delay_s=10.0)
+    faultpoints.arm("kubelet.register", "zombie", rate=0.02)
+    faultpoints.arm("kubelet.heartbeat", "flap", rate=0.02)
+    # Random mid-life heartbeat loss, per-heartbeat-draw: ~20k draws over the
+    # storm, so this lands on a couple of nodes beyond the deterministically
+    # darkened victims.
+    faultpoints.arm("kubelet.heartbeat", "drop", rate=0.00015)
+    faultpoints.arm("kubelet.pod-ready", "delay", rate=0.05)
+    faultpoints.arm("kubelet.eviction", "black-hole", rate=0.10)
+
+
+def arm_api_storm():
+    """A modest API fault layer on the controller's transport — enough to
+    prove the ladder's writes ride the retry envelope, low enough that a
+    520-node fleet's traffic converges in smoke time."""
+    from karpenter_tpu.utils import faultpoints
+
+    for site in faultpoints.REQUEST_SITES:
+        faultpoints.arm(site, "latency", rate=0.02, delay_s=0.01)
+        faultpoints.arm(site, "reset", rate=0.01)
+    for site in ("api.request.post", "api.request.put", "api.request.patch"):
+        faultpoints.arm(site, "conflict", rate=0.02)
+
+
+def load(state):
+    state["replicas"] = ReplicaLayer(state)
+    state["kubeside"].apply_pdb("guarded", {"app": "guarded"}, MIN_AVAILABLE)
+    rs_ids = [f"guarded-{i}" for i in range(GUARDED)] + [
+        f"work-{i}" for i in range(FLEET_PODS - GUARDED)
+    ]
+    state["replicas"].scale_up(rs_ids)
+
+    def fleet_launched():
+        nodes = state["kubeside"].list_nodes()
+        bound = sum(
+            1 for p in state["kubeside"].list_pods() if p.node_name is not None
+        )
+        return len(nodes) >= FLEET_PODS and bound >= FLEET_PODS
+
+    wait_for(state, fleet_launched, 150.0, "initial fleet to launch and bind")
+    state["fleet"].sync()  # adopt stragglers created since the last beat
+    census = state["fleet"].counts()
+    print(
+        f"lifecycle-smoke: {FLEET_PODS} replicas bound across "
+        f"{len(state['kubeside'].list_nodes())} nodes; kubelet census "
+        f"{census}"
+    )
+    assert census["total"] >= FLEET_PODS, "fleet smaller than the node count"
+    assert census["never_join"] > 0, "storm drew no never-join kubelets"
+    assert census["zombies"] > 0, "storm drew no zombie kubelets"
+
+
+def darken(state, avoid=()):
+    """Deterministically kill one live, loaded node's heartbeats — the
+    direct lever for pointing the storm at a health crashpoint."""
+    fleet = state["fleet"]
+    for node in sorted(state["kubeside"].list_nodes(), key=lambda n: n.name):
+        kubelet = fleet.kubelet(node.name)
+        if (
+            kubelet is not None
+            and kubelet.joined
+            and not kubelet.dark
+            and not kubelet.never_join
+            and not kubelet.zombie
+            and node.name not in avoid
+            and node.deletion_timestamp is None
+            and node.ready
+            and state["kubeside"].list_pods(node_name=node.name)
+        ):
+            kubelet.dark = True
+            return node.name
+    raise AssertionError("no live loaded node left to darken")
+
+
+def crash_and_restart(state, site):
+    from karpenter_tpu.utils import crashpoints
+
+    crashpoints.arm(site)
+    wait_for(
+        state,
+        lambda: site not in crashpoints.armed(),
+        60.0,
+        f"crashpoint {site} to fire",
+    )
+    crashpoints.disarm_all()
+    print(f"  killed at {site}; restarting the controller process")
+    stop_process(state)
+    build_process(state)
+
+
+def arrival_waves(state, round_index):
+    """Racing arrivals: fresh replicas land mid-storm; some earlier extras
+    scale back down — sustained POST/DELETE traffic under the fault layer."""
+    extras = [f"extra{round_index}-{i}" for i in range(6)]
+    state["replicas"].scale_up(extras)
+    if round_index:
+        gone = [f"extra{round_index - 1}-{i}" for i in range(3)]
+        state["replicas"].scale_down(gone)
+
+
+def storm(state):
+    darkened = []
+    for round_index, site in enumerate(
+        ("health.after-cordon", "health.mid-displace")
+    ):
+        arrival_waves(state, round_index)
+        victim = darken(state, avoid=darkened)
+        darkened.append(victim)
+        print(f"  round {round_index + 1}: darkened {victim}, arming {site}")
+        # Let the staleness build so the crash fires mid-escalation.
+        crash_and_restart(state, site)
+
+        def victim_gone(name=victim):
+            return state["kubeside"].try_get_node(name) is None
+
+        wait_for(state, victim_gone, 120.0, f"reclaim of darkened {victim}")
+        print(f"  round {round_index + 1}: {victim} reclaimed after the crash")
+    evict_wave(state)
+    darkened.append(force_zombie_rejection(state, avoid=darkened))
+    return darkened
+
+
+def evict_wave(state, count=50):
+    """Drive evictions through LIVE kubelets (drains only ever hit
+    never-join nodes, whose kubelets are dead): evict a slice of the
+    workload so the fleet's eviction handling — and its black-hole leg —
+    actually runs. The replica layer re-creates each one."""
+    cluster = state["kubeside"]
+    fleet = state["fleet"]
+    evicted = 0
+    for pod in sorted(cluster.list_pods(), key=lambda p: p.name):
+        if evicted >= count:
+            break
+        if (
+            pod.labels.get("rs", "").startswith("work-")
+            and pod.deletion_timestamp is None
+            and pod.node_name is not None
+        ):
+            kubelet = fleet.kubelet(pod.node_name)
+            if kubelet is None or not kubelet.joined or kubelet.dark:
+                continue
+            cluster.evict_pod(pod.namespace, pod.name)
+            evicted += 1
+    for _ in range(6):  # let the kubelets serve (or black-hole) them
+        beat(state)
+    print(
+        f"  evicted {evicted} pods through live kubelets; "
+        f"{state['fleet'].counts()['black_holed_pods']} black-holed"
+    )
+    assert evicted >= count // 2, "eviction wave found too few live targets"
+
+
+def force_zombie_rejection(state, avoid):
+    """Point the storm at the zombie defense deterministically: partition a
+    zombie-flagged kubelet (dark), let the health ladder reclaim its node,
+    then heal the partition — the kubelet re-registers its dead incarnation
+    and the controller must reject, never adopt, the ghost."""
+    fleet = state["fleet"]
+    zombie = next(
+        (
+            k
+            for _, k in sorted(fleet.kubelets.items())
+            if k.zombie
+            and k.joined
+            and not k.dark
+            and not k.rejoined
+            and k.name not in avoid
+            and state["kubeside"].try_get_node(k.name) is not None
+        ),
+        None,
+    )
+    assert zombie is not None, "storm drew no reclaimable zombie kubelet"
+    zombie.dark = True
+    wait_for(
+        state,
+        lambda: state["kubeside"].try_get_node(zombie.name) is None,
+        120.0,
+        f"reclaim of zombie host {zombie.name}",
+    )
+    zombie.dark = False  # partition heals: the kubelet is back, its node isn't
+
+    def rejoin_rejected():
+        if not zombie.rejoined:
+            return False
+        return state["kubeside"].try_get_node(zombie.name) is None
+
+    wait_for(state, rejoin_rejected, 60.0, "zombie re-registration rejection")
+    print(f"  zombie {zombie.name} re-registered and was rejected")
+    return zombie.name
+
+
+def wait_lifecycle_converged(state):
+    """Never-join nodes reaped by Liveness, dark nodes reaped by health,
+    every desired replica healthy on a live Ready node."""
+    fleet = state["fleet"]
+
+    def misbehaving_nodes_gone():
+        live = {n.name for n in state["kubeside"].list_nodes()}
+        for kubelet in fleet.kubelets.values():
+            if (kubelet.never_join or kubelet.dark) and kubelet.name in live:
+                return False
+        return True
+
+    wait_for(
+        state,
+        misbehaving_nodes_gone,
+        240.0,
+        "never-join and gone-dark nodes to be reaped",
+    )
+    wait_for(
+        state,
+        state["replicas"].fully_scheduled,
+        120.0,
+        "every replica healthy on a live Ready node",
+    )
+
+
+def assert_zero_zombie_adoptions(state):
+    from karpenter_tpu.controllers.health import NODE_ZOMBIE_REJECTIONS_TOTAL
+
+    instances = {i.provider_id for i in state["cloud"].list_instances()}
+    adopted = [
+        n.name
+        for n in state["kubeside"].list_nodes()
+        if n.provider_id and n.provider_id not in instances
+    ]
+    assert not adopted, f"instance-less nodes adopted: {adopted}"
+    census = state["fleet"].counts()
+    if census["rejoined"]:
+        assert NODE_ZOMBIE_REJECTIONS_TOTAL.get() >= census["rejoined"], (
+            f"{census['rejoined']} zombies rejoined but only "
+            f"{NODE_ZOMBIE_REJECTIONS_TOTAL.get():.0f} rejections counted"
+        )
+    return census["rejoined"]
+
+
+def assert_no_leaks_after_grace(state):
+    from karpenter_tpu.controllers.instancegc import LAUNCH_GRACE_SECONDS
+
+    manager = state["manager"]
+    stop_process(state)
+    state["clock"].advance(LAUNCH_GRACE_SECONDS + 1)
+    manager.instancegc.reconcile()
+    manager.instancegc.reconcile()
+    leaked = set(state["cloud"].instances) - {
+        n.provider_id for n in state["kubeside"].list_nodes()
+    }
+    assert not leaked, f"leaked instances after GC grace: {sorted(leaked)}"
+
+
+def assert_slo_held(state):
+    from karpenter_tpu.utils.obs import OBS
+
+    snapshot = OBS.slo_snapshot()
+    p99 = snapshot["pending"]["p99"]
+    assert OBS.evaluator.breaches == {}, (
+        f"SLO breached under the storm: {OBS.evaluator.breaches} "
+        f"(pending p99 {p99:.1f}s vs target {SLO_PENDING_P99_S}s)"
+    )
+    return p99
+
+
+def settle_and_verify(state, darkened):
+    from karpenter_tpu.utils import faultpoints
+
+    injected = faultpoints.total_fired()
+    faultpoints.disarm_all()  # quiet skies for the convergence audit
+    wait_lifecycle_converged(state)
+    for name, loop in state["manager"].loops.items():
+        assert loop._threads and all(t.is_alive() for t in loop._threads), (
+            f"sweep loop {name!r} has a dead worker thread at exit"
+        )
+    for name in darkened:
+        assert state["kubeside"].try_get_node(name) is None
+        assert name in state["cloud"].deleted_nodes
+    state["oracle"].stop()
+    assert state["oracle"].violations == [], (
+        f"PDB dipped below minAvailable: {state['oracle'].violations}"
+    )
+    state["binds"].stop()
+    worst = state["binds"].worst()
+    assert 2 <= worst <= state["binds"].MAX_BINDS, (
+        f"worst bind chain {worst}: displaced pods must rebind exactly once "
+        f"(chain 2), never ping-pong past {state['binds'].MAX_BINDS}"
+    )
+    census = state["fleet"].counts()
+    assert census["black_holed_pods"] >= 1, (
+        "the eviction black-hole leg never fired"
+    )
+    rejected = assert_zero_zombie_adoptions(state)
+    pending_p99 = assert_slo_held(state)
+    assert_no_leaks_after_grace(state)
+    return injected, worst, rejected, pending_p99
+
+
+def main() -> int:
+    began = time.time()
+    state = {"beats": 0}
+    try:
+        build(state)
+        arm_kubelet_storm()
+        load(state)
+        # Oracles arm AFTER the load ramp: they guard bound pods against
+        # DISRUPTION, and initial pending isn't one.
+        state["oracle"] = PdbOracle(
+            state["server"], {"app": "guarded"}, MIN_AVAILABLE
+        )
+        state["binds"] = BindOracle(state["server"])
+        arm_api_storm()
+        darkened = storm(state)
+        injected, worst, rejected, pending_p99 = settle_and_verify(
+            state, darkened
+        )
+    except AssertionError as failure:
+        print(f"lifecycle-smoke: FAIL in {time.time() - began:.1f}s: {failure}")
+        return 1
+    finally:
+        try:
+            state["kubeside"].close()
+        except Exception:  # noqa: BLE001
+            pass
+    census = state["fleet"].counts()
+    print(
+        f"lifecycle-smoke: OK in {time.time() - began:.1f}s "
+        f"({census['total']} kubelets: {census['never_join']} never-joined, "
+        f"{census['dark']} went dark, {census['rejoined']} zombie rejoins "
+        f"rejected ({rejected} counted), {census['black_holed_pods']} "
+        f"black-holed evictions; {injected} faults injected, 2 mid-storm "
+        f"crash+restarts; 0 PDB violations, 0 leaked instances, 0 zombie "
+        f"adoptions, worst bind chain {worst}, pending p99 "
+        f"{pending_p99:.1f}s inside the {SLO_PENDING_P99_S:.0f}s SLO)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
