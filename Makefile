@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke ha-smoke lifecycle-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke ha-smoke lifecycle-smoke soak-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -166,6 +166,23 @@ ha-smoke:
 lifecycle-smoke:
 	timeout -k 10 240 python tools/lifecycle_smoke.py
 
+# The overload capstone (tools/soak_smoke.py): sustained churn where the
+# pod arrival rate deliberately exceeds the drain rate against a bounded
+# admission cap, with lease renewals riding the critical lane of a
+# genuinely contended token bucket, spot interruptions and an API fault
+# storm underneath, then a recovery phase. Asserts the queue cap is never
+# exceeded while refusals are counted, zero lease losses with every renew
+# inside its deadline, the backlog fully drains after saturation ends, the
+# p99 pending SLO is RE-ATTAINED once the window rolls past the storm, and
+# the leak oracles hold (threads stable, RSS bounded, compaction cycles
+# bounded, reconcile backoff state pruned, flight recorder gap-free). The
+# default profile fits tier-1 (~10s); SOAK_FULL=1 runs the multi-minute
+# sustained profile (also reachable via the slow-marked pytest wrapper in
+# tests/test_soak.py). The timeout widens with the profile.
+SOAK_BUDGET := $(if $(SOAK_FULL),480,120)
+soak-smoke:
+	timeout -k 10 $(SOAK_BUDGET) python tools/soak_smoke.py
+
 # Every fault-injection smoke in one verdict, fail-late (a crash-smoke
 # failure must not mask an interruption regression in the same run).
 smoke:
@@ -183,6 +200,7 @@ smoke:
 	$(MAKE) market-smoke || rc=1; \
 	$(MAKE) ha-smoke || rc=1; \
 	$(MAKE) lifecycle-smoke || rc=1; \
+	$(MAKE) soak-smoke || rc=1; \
 	exit $$rc
 
 proto:

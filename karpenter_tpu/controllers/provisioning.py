@@ -39,6 +39,14 @@ MAX_PODS_PER_BATCH = 2000
 BATCH_IDLE_SECONDS = 1.0
 BATCH_MAX_SECONDS = 10.0
 
+# Admission cap per worker (batch window + overflow backlog together;
+# --provision-queue-max-pods). Past it, `add` REFUSES and the pod rides
+# selection's backoff requeue instead — bounded memory here, and the
+# aging/retry pressure moves to the layer that already owns it. The default
+# holds 25 full batch windows: a storm that deep is minutes of solve work
+# away from the window anyway, so queueing more buys nothing but RSS.
+DEFAULT_QUEUE_MAX_PODS = 50_000
+
 # Pod binds fan out in parallel (ref: provisioner.go:239-247 ParallelizeUntil
 # runs one goroutine per pod): each bind is an apiserver RPC in production,
 # so without fan-out the bind stage dominates a large pass. The pool is
@@ -72,6 +80,28 @@ SOLVE_DURATION = REGISTRY.histogram(
 BIND_DURATION = REGISTRY.histogram(
     "allocation_bind_duration_seconds",
     "Duration of node creation + pod binding per packing",
+)
+
+# Overload visibility (docs/design/overload.md): current held pods per
+# worker (batch window + overflow), refusals by reason, and the
+# pending-cycle age of each pod at the moment its batch window closes for
+# solving — the distribution a starving pod would push right.
+PROVISION_QUEUE_DEPTH = REGISTRY.gauge(
+    "provision_queue_depth",
+    "Pods held by the provisioner worker (open batch window + overflow "
+    "backlog)",
+    ["provisioner"],
+)
+PROVISION_BACKPRESSURE_TOTAL = REGISTRY.counter(
+    "provision_backpressure_total",
+    "Pods refused at provisioning admission, by reason",
+    ["reason"],
+)
+BATCH_WINDOW_AGE = REGISTRY.histogram(
+    "batch_window_age_seconds",
+    "Pending-cycle age of each pod when its batch window closes for "
+    "solving (aging-ordered refill keeps the tail bounded under overload)",
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0),
 )
 
 
@@ -142,11 +172,14 @@ class ProvisionerWorker:
         solver: Optional[Solver] = None,
         cluster_state=None,
         level_recorder=None,
+        queue_max_pods: Optional[int] = None,
     ):
         self.provisioner = provisioner
         self.cluster = cluster
         self.cloud = cloud
         self.solver = solver or GreedySolver()
+        # Admission cap (batch + overflow); see DEFAULT_QUEUE_MAX_PODS.
+        self.queue_max_pods = queue_max_pods or DEFAULT_QUEUE_MAX_PODS
         # Reports each constrained solve's kernel-chosen relaxation level
         # back to selection's bookkeeping cache (selection.Preferences).
         self.level_recorder = level_recorder
@@ -169,6 +202,10 @@ class ProvisionerWorker:
         self._lock = threading.Lock()
         self._first_add: Optional[float] = None  # vet: guarded-by(self._lock)
         self._last_add: Optional[float] = None  # vet: guarded-by(self._lock)
+        # Saturation edge state: the flight recorder gets ONE event per
+        # engage/release transition, never one per refused pod (a 50k-pod
+        # refusal storm would evict every launch record from the ring).
+        self._saturated = False  # vet: guarded-by(self._lock)
         self._node_seq = 0
 
     # --- batching (ref: provisioner.go:137-163) -----------------------------
@@ -179,32 +216,60 @@ class ProvisionerWorker:
     # their closing edge is a clock passing, not an event).
     batch_full: Optional[threading.Event] = None
 
-    def add(self, pod: PodSpec) -> None:
-        """Accept a pod unconditionally: into the open batch window, or the
-        overflow backlog once the window is full."""
+    def add(self, pod: PodSpec) -> bool:
+        """Admit a pod into the open batch window, or the overflow backlog
+        once the window is full — up to the admission cap. Returns True iff
+        the worker HOLDS the pod (a duplicate re-add of a held pod counts);
+        False means refused at the cap, and the caller (selection) keeps the
+        pod on its backoff requeue ladder, where it already ages."""
         filled = False
+        refused = engaged = False
         with self._lock:
             accepted = False
+            depth = len(self._pending) + len(self._overflow)
             if pod.uid not in self._pending_uids:
-                accepted = True
-                if len(self._pending) >= MAX_PODS_PER_BATCH:
-                    self._overflow.append(pod)
+                if depth >= self.queue_max_pods:
+                    refused = True
+                    engaged = not self._saturated
+                    self._saturated = True
                 else:
-                    self._pending.append(pod)
-                    filled = len(self._pending) >= MAX_PODS_PER_BATCH
-                self._pending_uids.add(pod.uid)
-                # Window clock moves only on GENUINE adds: duplicate
-                # re-verify adds would otherwise keep refreshing _last_add
-                # and hold a partial batch open to the 10s max instead of
-                # closing on the 1s idle.
-                now = self.cluster.clock.now()
-                if self._first_add is None:
-                    self._first_add = now
-                self._last_add = now
+                    accepted = True
+                    depth += 1
+                    if len(self._pending) >= MAX_PODS_PER_BATCH:
+                        self._overflow.append(pod)
+                    else:
+                        self._pending.append(pod)
+                        filled = len(self._pending) >= MAX_PODS_PER_BATCH
+                    self._pending_uids.add(pod.uid)
+                    # Window clock moves only on GENUINE adds: duplicate
+                    # re-verify adds would otherwise keep refreshing _last_add
+                    # and hold a partial batch open to the 10s max instead of
+                    # closing on the 1s idle.
+                    now = self.cluster.clock.now()
+                    if self._first_add is None:
+                        self._first_add = now
+                    self._last_add = now
+        if refused:
+            PROVISION_BACKPRESSURE_TOTAL.inc("queue-full")
+            if engaged:
+                RECORDER.record(
+                    "backpressure",
+                    provisioner=self.provisioner.name,
+                    phase="engage",
+                    depth=self.queue_max_pods,
+                )
+            return False
         if accepted:
             OBS.stamp(pod.uid, "batched")
+            PROVISION_QUEUE_DEPTH.set(float(depth), self.provisioner.name)
         if filled and self.batch_full is not None:
             self.batch_full.set()
+        return True
+
+    def queue_depth(self) -> int:
+        """Pods currently held (open window + overflow backlog)."""
+        with self._lock:
+            return len(self._pending) + len(self._overflow)
 
     def take_backlog(self) -> List[PodSpec]:
         """Drain EVERYTHING (batch + overflow) for hand-off to a replacement
@@ -215,6 +280,8 @@ class ProvisionerWorker:
             self._overflow = []
             self._pending_uids = set()
             self._first_add = self._last_add = None
+            self._saturated = False
+        PROVISION_QUEUE_DEPTH.set(0.0, self.provisioner.name)
         return backlog
 
     def batch_ready(self) -> bool:
@@ -231,21 +298,52 @@ class ProvisionerWorker:
             )
 
     def _drain(self) -> List[PodSpec]:
+        now = self.cluster.clock.now()
+        released = False
         with self._lock:
             batch = self._pending
             # Refill the next window straight from the overflow backlog —
             # its pods already waited a full window, so the next batch
             # starts its clock now rather than waiting for re-verifies.
-            self._pending = self._overflow[:MAX_PODS_PER_BATCH]
-            self._overflow = self._overflow[MAX_PODS_PER_BATCH:]
+            # Under pressure the refill is AGING-ORDERED: oldest pending
+            # cycle first (lifecycle-tracker anchors — re-adds after a
+            # refused/rescheduled round arrive out of arrival order, and a
+            # plain FIFO would let them starve behind fresher waves). The
+            # OBS lock is a leaf: nothing in the tracker calls back here.
+            overflow = self._overflow
+            if overflow:
+                anchors = OBS.pending_anchors([p.uid for p in overflow])
+                order = sorted(
+                    range(len(overflow)),
+                    key=lambda i: (anchors.get(overflow[i].uid, now), i),
+                )
+                overflow = [overflow[i] for i in order]
+            self._pending = overflow[:MAX_PODS_PER_BATCH]
+            self._overflow = overflow[MAX_PODS_PER_BATCH:]
             self._pending_uids = {p.uid for p in self._pending} | {
                 p.uid for p in self._overflow
             }
+            depth = len(self._pending) + len(self._overflow)
+            if self._saturated and depth < self.queue_max_pods:
+                self._saturated = False
+                released = True
             if self._pending:
-                now = self.cluster.clock.now()
                 self._first_add = self._last_add = now
             else:
                 self._first_add = self._last_add = None
+        PROVISION_QUEUE_DEPTH.set(float(depth), self.provisioner.name)
+        if released:
+            RECORDER.record(
+                "backpressure",
+                provisioner=self.provisioner.name,
+                phase="release",
+                depth=depth,
+            )
+        if batch:
+            anchors = OBS.pending_anchors([p.uid for p in batch])
+            BATCH_WINDOW_AGE.observe_many(
+                [max(0.0, now - anchors.get(p.uid, now)) for p in batch]
+            )
         return batch
 
     # --- the provisioning pass (ref: provisioner.go:102-135) ----------------
@@ -706,11 +804,13 @@ class ProvisioningController:
         cloud: CloudProvider,
         solver: Optional[Solver] = None,
         cluster_state=None,
+        queue_max_pods: Optional[int] = None,
     ):
         self.cluster = cluster
         self.cloud = cloud
         self.solver = solver
         self.cluster_state = cluster_state
+        self.queue_max_pods = queue_max_pods
         self.workers: Dict[str, ProvisionerWorker] = {}
         self._hashes: Dict[str, int] = {}
         # Runtime wiring (runtime.Manager): propagated to every worker so a
@@ -728,7 +828,10 @@ class ProvisioningController:
     def reconcile(self, name: str) -> None:
         provisioner = self.cluster.try_get_provisioner(name)
         if provisioner is None or provisioner.deletion_timestamp is not None:
-            self.workers.pop(name, None)
+            if self.workers.pop(name, None) is not None:
+                # The worker's depth series would otherwise freeze at its
+                # last value forever on the deleted provisioner's label.
+                PROVISION_QUEUE_DEPTH.set(0.0, name)
             self._hashes.pop(name, None)
             return
         self.apply(provisioner)
@@ -758,6 +861,7 @@ class ProvisioningController:
                 effective, self.cluster, self.cloud, self.solver,
                 cluster_state=self.cluster_state,
                 level_recorder=self._record_level,
+                queue_max_pods=self.queue_max_pods,
             )
             replacement.batch_full = self.batch_full
             # Hand the old worker's accepted backlog (batch + overflow) to

@@ -90,6 +90,12 @@ class SelectionController:
     # floors the base at 1s; the cap matches the reference's 1000s.
     BACKOFF_BASE_SECONDS = 1.0
     BACKOFF_MAX_SECONDS = 1000.0
+    # Backoff cap for pods REFUSED at a full provisioning queue
+    # (--provision-queue-max-pods): unlike a no-match, the queue drains at
+    # batch cadence, so the retry ceiling stays tight — the pod keeps aging
+    # on its lifecycle anchor and re-enters the worker's aging-ordered
+    # refill as soon as admission reopens.
+    REFUSED_BACKOFF_MAX_SECONDS = 30.0
 
     def __init__(self, cluster: Cluster, provisioning: ProvisioningController):
         self.cluster = cluster
@@ -118,12 +124,13 @@ class SelectionController:
         # Hand the STORED pod over untouched: the scheduler compiles its
         # full relaxation ladder into the solve, so there is no relaxed copy
         # to fabricate here (the old detached-copy re-solve loop is gone).
-        matched = self._select_and_enqueue(pod)
-        if matched:
+        outcome = self._select_and_enqueue(pod)
+        if outcome == "accepted":
             self._failures.delete(pod.uid)
             return self.ACCEPTED_REQUEUE_SECONDS
-        # No provisioner matched. The retry happens anyway — the reference
-        # returns the match error so controller-runtime keeps requeueing
+        # No provisioner matched, or the matching worker's admission queue
+        # is full. The retry happens anyway — the reference returns the
+        # match error so controller-runtime keeps requeueing
         # (selectProvisioner:80-102), which is what heals a pod whose
         # provisioner appears (or widens) later — but with exponential
         # backoff, so a permanently-unschedulable pod isn't polled at 1 Hz
@@ -133,10 +140,12 @@ class SelectionController:
         self._failures.set(pod.uid, failures + 1)
         # min() on the exponent too: the counter keeps growing for a pod
         # that never schedules, and 2.0**1024 overflows.
-        return min(
-            self.BACKOFF_BASE_SECONDS * (2.0 ** min(failures, 16)),
-            self.BACKOFF_MAX_SECONDS,
+        cap = (
+            self.REFUSED_BACKOFF_MAX_SECONDS
+            if outcome == "refused"
+            else self.BACKOFF_MAX_SECONDS
         )
+        return min(self.BACKOFF_BASE_SECONDS * (2.0 ** min(failures, 16)), cap)
 
     def _validate(self, pod: PodSpec) -> None:
         greedy = greedy_topology_enabled()
@@ -199,10 +208,12 @@ class SelectionController:
                     "DoNotSchedule topology spread constraint on the same key"
                 )
 
-    def _select_and_enqueue(self, pod: PodSpec) -> bool:
+    def _select_and_enqueue(self, pod: PodSpec) -> str:
         """First matching provisioner in alphabetical order wins
-        (ref: selectProvisioner:80-102). True iff a worker accepted the pod
-        (workers accept unconditionally — batch window or overflow)."""
+        (ref: selectProvisioner:80-102). Outcomes: "accepted" (a worker
+        holds the pod — batch window or overflow), "refused" (the matching
+        worker's admission queue is at --provision-queue-max-pods; the pod
+        stays on the requeue ladder and ages there), "no-match"."""
         for provisioner in self.cluster.list_provisioners():
             if provisioner.deletion_timestamp is not None:
                 continue
@@ -218,9 +229,11 @@ class SelectionController:
                 self._compatible(worker, pod)
             except PodIncompatibleError:
                 continue
-            worker.add(pod)
-            return True
-        return False
+            # First match decides: a refusal here must NOT fall through to a
+            # later (alphabetically lower-priority) provisioner — that would
+            # flip placement priority under load and flap back after drain.
+            return "accepted" if worker.add(pod) else "refused"
+        return "no-match"
 
     @staticmethod
     def _compatible(worker, pod: PodSpec) -> None:

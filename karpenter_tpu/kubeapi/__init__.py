@@ -17,6 +17,7 @@ from karpenter_tpu.kubeapi.client import (
     RetryPolicy,
     Transport,
     TransportError,
+    critical_lane,
 )
 from karpenter_tpu.kubeapi.cluster import ApiServerCluster
 
@@ -27,4 +28,5 @@ __all__ = [
     "RetryPolicy",
     "Transport",
     "TransportError",
+    "critical_lane",
 ]

@@ -64,6 +64,49 @@ KUBE_API_REQUEST_DURATION = REGISTRY.histogram(
     "Kube API request latency per attempt (failed attempts included)",
     ["verb"],
 )
+KUBE_API_LANE_WAIT = REGISTRY.histogram(
+    "kube_api_lane_wait_seconds",
+    "Time a request waited for a rate-limiter token, by priority lane "
+    "(critical lane waits spiking means the reserve is sized wrong)",
+    ["lane"],
+)
+
+# --- priority lanes ----------------------------------------------------------
+#
+# The kube analogue of API Priority & Fairness, client-side: the token
+# bucket keeps a small reserve only CRITICAL requests may drain, so a bulk
+# LIST/bind storm saturating the limiter cannot park the control-plane
+# heartbeat traffic behind it. Critical today: lease renew/acquire (losing
+# the lease mid-storm deposes the leader and trips the write fence),
+# node heartbeat status writes, and finalizer removal/node deletes (a
+# stuck drain holds capacity). The lane rides a thread-local so call
+# sites stay signature-free: kubeapi/cluster.py wraps its critical verbs
+# in `with critical_lane():` and every nested request inherits it.
+
+# Fraction of the bucket's burst reserved for the critical lane.
+CRITICAL_RESERVE_FRACTION = 0.1
+
+_lane_local = threading.local()
+
+
+def current_lane() -> str:
+    """The calling thread's lane: "critical" inside a critical_lane() block,
+    else "bulk"."""
+    return getattr(_lane_local, "lane", "bulk")
+
+
+class critical_lane:
+    """Context manager marking every kube request on this thread critical
+    (reserved-token lane) for the duration. Re-entrant; restores the prior
+    lane on exit so a critical section nested in another stays critical."""
+
+    def __enter__(self) -> "critical_lane":
+        self._prior = getattr(_lane_local, "lane", "bulk")
+        _lane_local.lane = "critical"
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        _lane_local.lane = self._prior
 
 
 class ApiError(Exception):
@@ -290,17 +333,43 @@ class HttpTransport(Transport):
 
 class RateLimiter:
     """Token bucket matching the reference's client-side throttle
-    (ref: cmd/controller/main.go:67, options qps/burst)."""
+    (ref: cmd/controller/main.go:67, options qps/burst), with a critical
+    reserve: bulk callers may not drain the bucket below `critical_reserve`
+    tokens — only critical-lane callers take the bucket to zero, so a bulk
+    storm's worst case delays a lease renew by refill arithmetic, never by
+    the storm's own queue."""
 
-    def __init__(self, qps: float, burst: int, clock: Optional[Clock] = None):
+    def __init__(
+        self,
+        qps: float,
+        burst: int,
+        clock: Optional[Clock] = None,
+        critical_reserve: int = 0,
+    ):
         self.qps = qps
         self.burst = burst
+        # Reserve clamped inside the bucket: a reserve >= burst would
+        # starve bulk entirely.
+        self.critical_reserve = max(0, min(int(critical_reserve), burst - 1))
         self.clock = clock or SYSTEM_CLOCK
         self._tokens = float(burst)  # vet: guarded-by(self._lock)
         self._last = self.clock.monotonic()  # vet: guarded-by(self._lock)
         self._lock = threading.Lock()
 
-    def wait(self) -> None:
+    # Shortest throttle sleep: refill arithmetic can leave a sub-ULP token
+    # deficit (tokens + (deficit/qps)*qps rounds just below the grant line),
+    # and the matching sub-nanosecond sleep is absorbed by double-precision
+    # rounding on any clock with a large absolute value (1e6 + 1e-18 == 1e6)
+    # — the refill never lands and wait() livelocks. One scheduler quantum
+    # is the floor; the overshoot is noise against a >= 1-token wait.
+    MIN_SLEEP_S = 0.0005
+
+    def wait(self, critical: bool = False) -> float:
+        """Block until a token is available in the caller's lane; returns
+        the seconds slept (0.0 for an unthrottled call) so the envelope can
+        publish per-lane wait."""
+        floor = 0.0 if critical else float(self.critical_reserve)
+        waited = 0.0
         while True:
             with self._lock:
                 now = self.clock.monotonic()
@@ -308,14 +377,17 @@ class RateLimiter:
                     self.burst, self._tokens + (now - self._last) * self.qps
                 )
                 self._last = now
-                if self._tokens >= 1.0:
+                if self._tokens >= floor + 1.0:
                     self._tokens -= 1.0
-                    return
-                needed = (1.0 - self._tokens) / self.qps
+                    return waited
+                needed = max(
+                    (floor + 1.0 - self._tokens) / self.qps, self.MIN_SLEEP_S
+                )
             # Deliberately OUTSIDE the bucket lock (the blocking-under-lock
             # checker enforces this shape): a throttled caller must not hold
             # up token refill arithmetic for everyone else while it sleeps.
             self.clock.sleep(needed)
+            waited += needed
 
 
 # Per-verb request deadlines (the envelope passes these to the transport).
@@ -402,10 +474,15 @@ class KubeClient:
         burst: int = 300,
         clock: Optional[Clock] = None,
         retry: Optional[RetryPolicy] = None,
+        critical_reserve: Optional[int] = None,
     ):
         self.transport = transport
         self.clock = clock or SYSTEM_CLOCK
-        self.limiter = RateLimiter(qps, burst, self.clock)
+        if critical_reserve is None:
+            critical_reserve = int(burst * CRITICAL_RESERVE_FRACTION)
+        self.limiter = RateLimiter(
+            qps, burst, self.clock, critical_reserve=critical_reserve
+        )
         self.retry = retry or RetryPolicy()
 
     def _call(self, verb, path, query="", body=None) -> dict:
@@ -424,11 +501,13 @@ class KubeClient:
         rationale that makes uniform retry safe."""
         method = "GET" if verb == "LIST" else verb
         label = verb.lower()
+        lane = current_lane()
         timeout_s = self.retry.timeout_for(verb)
         attempt = 0
         while True:
             attempt += 1
-            self.limiter.wait()
+            waited = self.limiter.wait(critical=lane == "critical")
+            KUBE_API_LANE_WAIT.observe(waited, lane)
             began = self.clock.monotonic()
             try:
                 status, payload = self.transport.request(

@@ -31,7 +31,7 @@ from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.errors import PDBViolationError
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.kubeapi import convert
-from karpenter_tpu.kubeapi.client import ApiError, KubeClient
+from karpenter_tpu.kubeapi.client import ApiError, KubeClient, critical_lane
 from karpenter_tpu.utils import faultpoints
 from karpenter_tpu.utils import logging as klog
 from karpenter_tpu.utils.clock import Clock
@@ -632,24 +632,27 @@ class ApiServerCluster(Cluster):
         # Status-only merge-patch — the write a real kubelet's status loop
         # issues. Deliberately disjoint from update_node's metadata/spec
         # patch so neither side clobbers the other. Unfenced (see base):
-        # the reporter is the node, not the controller leader.
+        # the reporter is the node, not the controller leader. Critical
+        # lane: a heartbeat parked behind a bulk bind storm reads as a
+        # gone-dark node and trips the health ladder for no reason.
         try:
-            updated = self.api.patch(
-                f"{NODES}/{name}",
-                {
-                    "status": {
-                        "conditions": [
-                            {
-                                "type": "Ready",
-                                "status": "True" if ready else "False",
-                                "lastHeartbeatTime": convert.rfc3339(
-                                    self.clock.now()
-                                ),
-                            }
-                        ]
-                    }
-                },
-            )
+            with critical_lane():
+                updated = self.api.patch(
+                    f"{NODES}/{name}",
+                    {
+                        "status": {
+                            "conditions": [
+                                {
+                                    "type": "Ready",
+                                    "status": "True" if ready else "False",
+                                    "lastHeartbeatTime": convert.rfc3339(
+                                        self.clock.now()
+                                    ),
+                                }
+                            ]
+                        }
+                    },
+                )
             self._record_rv("node", updated)
         except ApiError as error:
             if error.status != 404:
@@ -674,8 +677,12 @@ class ApiServerCluster(Cluster):
 
     def delete_node(self, name: str) -> None:
         self.fence.check("delete_node")
+        # Critical lane (with remove_finalizer below): the drain path's
+        # teardown verbs — parking them behind a bulk storm holds reclaimed
+        # capacity (and its cost) alive for the storm's duration.
         try:
-            self.api.delete(f"{NODES}/{name}")
+            with critical_lane():
+                self.api.delete(f"{NODES}/{name}")
         except ApiError as error:
             if error.status != 404:
                 raise
@@ -685,9 +692,11 @@ class ApiServerCluster(Cluster):
         self.fence.check("remove_finalizer")
         remaining = [f for f in node.finalizers if f != finalizer]
         try:
-            updated = self.api.patch(
-                f"{NODES}/{node.name}", {"metadata": {"finalizers": remaining}}
-            )
+            with critical_lane():
+                updated = self.api.patch(
+                    f"{NODES}/{node.name}",
+                    {"metadata": {"finalizers": remaining}},
+                )
             self._record_rv("node", updated)
         except ApiError as error:
             if error.status != 404:
@@ -768,18 +777,22 @@ class ApiServerCluster(Cluster):
         if fault is not None and fault.kind == "conflict":
             return 0
         commit_lost = fault is not None and fault.kind == "commit-lost"
-        now = self.clock.now()
-        current = self.api.try_get(f"{LEASES}/{name}")
-        if current is None:
-            committed = int(transitions) if transitions is not None else 1
-            won = self._lease_create(name, holder, duration_s, now, committed)
-        else:
-            committed = self._lease_next_transitions(
-                current, holder, now, transitions
-            )
-            won = committed > 0 and self._lease_update(
-                name, holder, duration_s, now, committed, current
-            )
+        # Critical lane for the whole read-CAS round: a lease renew queued
+        # behind a bulk LIST/bind storm past the TTL deposes the leader —
+        # the exact failure the reserved token budget exists to prevent.
+        with critical_lane():
+            now = self.clock.now()
+            current = self.api.try_get(f"{LEASES}/{name}")
+            if current is None:
+                committed = int(transitions) if transitions is not None else 1
+                won = self._lease_create(name, holder, duration_s, now, committed)
+            else:
+                committed = self._lease_next_transitions(
+                    current, holder, now, transitions
+                )
+                won = committed > 0 and self._lease_update(
+                    name, holder, duration_s, now, committed, current
+                )
         if not won or commit_lost:
             return 0
         return super().acquire_lease(name, holder, duration_s, transitions=committed)
@@ -832,26 +845,29 @@ class ApiServerCluster(Cluster):
 
     def release_lease(self, name: str, holder: str) -> bool:
         path = f"{LEASES}/{name}"
-        current = self.api.try_get(path)
-        state = convert.lease_from_kube(current) if current else None
-        if state is None or state[0] != holder:
-            return False
-        # Vacate by clearing holderIdentity instead of deleting the object:
-        # leaseTransitions must survive a voluntary release, or the next
-        # holder's generation would alias the first one's fence token.
-        body = convert.lease_to_kube(name, "", 0, self.clock.now(), state[3])
-        body["metadata"]["resourceVersion"] = current.get("metadata", {}).get(
-            "resourceVersion"
-        )
-        try:
-            self.api.update(path, body)
-        except ApiError as error:
-            if error.status not in (404, 409):
-                raise
+        with critical_lane():
+            current = self.api.try_get(path)
+            state = convert.lease_from_kube(current) if current else None
+            if state is None or state[0] != holder:
+                return False
+            # Vacate by clearing holderIdentity instead of deleting the
+            # object: leaseTransitions must survive a voluntary release, or
+            # the next holder's generation would alias the first one's
+            # fence token.
+            body = convert.lease_to_kube(name, "", 0, self.clock.now(), state[3])
+            body["metadata"]["resourceVersion"] = current.get("metadata", {}).get(
+                "resourceVersion"
+            )
+            try:
+                self.api.update(path, body)
+            except ApiError as error:
+                if error.status not in (404, 409):
+                    raise
         return super().release_lease(name, holder)
 
     def get_lease(self, name: str):
-        current = self.api.try_get(f"{LEASES}/{name}")
+        with critical_lane():
+            current = self.api.try_get(f"{LEASES}/{name}")
         state = convert.lease_from_kube(current) if current else None
         if state is None:
             return None

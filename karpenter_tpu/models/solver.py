@@ -61,6 +61,18 @@ BREAK_EVEN_GAUGE = REGISTRY.gauge(
     "Host/device break-even calibration measured at boot",
     ["quantity"],
 )
+# Device-memory survival (CostSolver._solve_batch_survive): batch splits
+# forced by HBM pressure. "estimate" = the pre-dispatch estimator chunked
+# an oversized batch before it could OOM; "oom" = a live RESOURCE_EXHAUSTED
+# bisected the batch and re-dispatched the halves; "floor" = a single
+# schedule still OOMed, so the solve fell through to the BackendHealth CPU
+# pin. A climbing "oom" rate with zero "estimate" means the estimator's
+# budget read is wrong for this device.
+SOLVER_BATCH_SPLIT_TOTAL = REGISTRY.counter(
+    "solver_batch_split_total",
+    "Solve-batch splits under device memory pressure (estimate|oom|floor)",
+    ["reason"],
+)
 
 
 class Solver(abc.ABC):
@@ -1953,6 +1965,115 @@ def _realize_lp_dense(
     return round_list, unschedulable_counts
 
 
+# --- device-memory survival --------------------------------------------------
+#
+# A batch of schedules can exceed device HBM even though every schedule fits
+# alone: the batched path dispatches all K fused kernels before the first
+# fetch, so their [G, T] LP states are live simultaneously. Rather than let
+# one oversized sweep crash provisioning (or silently dump the WHOLE batch
+# onto the CPU pin), CostSolver bisects on RESOURCE_EXHAUSTED and
+# re-dispatches the halves — each half re-runs the identical per-schedule
+# math, so the recovered plans are bit-identical to the unsplit solve.
+
+# Markers scanned (case-insensitively) over the error text. XLA surfaces
+# allocation failure as XlaRuntimeError("RESOURCE_EXHAUSTED: ..."); older
+# jaxlibs and the PJRT CPU client phrase it as "Out of memory" or "Failed
+# to allocate N bytes".
+_RESOURCE_EXHAUSTED_MARKERS = (
+    "resource_exhausted",
+    "out of memory",
+    "failed to allocate",
+)
+
+
+def _is_resource_exhausted(error: BaseException) -> bool:
+    """True when `error` is a device allocation failure — the recoverable
+    kind the bisect ladder retries. Message-scan, not type-check: the
+    concrete exception class differs across jaxlib versions and the injected
+    fault, but the status phrase is stable."""
+    text = f"{type(error).__name__}: {error}".lower()
+    return any(marker in text for marker in _RESOURCE_EXHAUSTED_MARKERS)
+
+
+# Live [G, T] float32 copies per in-flight solve: LP assignment + Adam m/v +
+# gradient + softmax activations + compaction scratch. A deliberate
+# overestimate — the pre-split only has to be conservative enough that the
+# bisect path stays the rare fallback, not a per-sweep tax.
+_LIVE_TENSOR_COPIES = 6
+# Fraction of the device budget the pre-split packs to — headroom for the
+# runtime's own allocations and fetch staging buffers.
+HBM_SAFETY_FACTOR = 0.8
+
+
+def _hbm_budget_bytes() -> Optional[float]:
+    """Device memory budget for the pre-dispatch estimator, or None to skip
+    pre-splitting (CPU backends report no limit — the bisect ladder still
+    covers them). KARPENTER_HBM_BYTES overrides for tests and for devices
+    whose PJRT client misreports bytes_limit."""
+    import os
+
+    raw = os.environ.get("KARPENTER_HBM_BYTES", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+    try:
+        stats = jax.devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+        return float(limit) if limit else None
+    except Exception:  # noqa: BLE001 — estimator absence must never fail a solve
+        return None
+
+
+def _estimate_solve_bytes(groups: PodGroups, fleet: InstanceFleet) -> float:
+    """Rough HBM footprint of one schedule's fused solve: the padded [G, T]
+    LP tensors dominate (the dense plan state is [MR, G] int8 — noise next
+    to float32 [G, T] at scale). Bucketed dims, because that's what the
+    kernel actually allocates."""
+    g = bucket_size(max(1, int(groups.num_groups)))
+    t = bucket_size(max(1, int(fleet.num_types)))
+    return float(g) * float(t) * 4.0 * _LIVE_TENSOR_COPIES
+
+
+def _presplit_for_hbm(
+    items: Sequence[Tuple[PodGroups, InstanceFleet]],
+) -> List[List[Tuple[PodGroups, InstanceFleet]]]:
+    """Greedily chunk a batch so each chunk's estimated footprint fits the
+    device budget — the cheap pre-check that spares the common oversized
+    sweep a guaranteed OOM + bisect round trip. One chunk (no split) when
+    the budget is unknown or everything fits."""
+    budget = _hbm_budget_bytes()
+    if budget is None or len(items) <= 1:
+        return [list(items)]
+    cap = budget * HBM_SAFETY_FACTOR
+    chunks: List[List[Tuple[PodGroups, InstanceFleet]]] = []
+    current: List[Tuple[PodGroups, InstanceFleet]] = []
+    current_bytes = 0.0
+    for item in items:
+        cost = _estimate_solve_bytes(*item)
+        if current and current_bytes + cost > cap:
+            chunks.append(current)
+            current, current_bytes = [], 0.0
+        current.append(item)
+        current_bytes += cost
+    chunks.append(current)
+    return chunks
+
+
+def _maybe_inject_device_oom() -> None:
+    """The solver.dispatch faultpoint: chaos harnesses arm "oom" here to
+    prove the bisect ladder recovers (count=N forces N failures, i.e. N
+    split depths, before a dispatch goes through)."""
+    from karpenter_tpu.utils import faultpoints
+
+    if faultpoints.draw("solver.dispatch") is not None:
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: injected device allocation failure "
+            "(faultpoint solver.dispatch)"
+        )
+
+
 class CostSolver(Solver):
     """The flagship: runs pure-greedy FFD, cost-greedy, and the LP-relaxation
     plan on TPU, returns the cheapest feasible packing. Because greedy is
@@ -2013,12 +2134,19 @@ class CostSolver(Solver):
             )
         return decode_dense_result(dense, groups, fleet, pool_zones)
 
-    def _dispatch_batch(self, items):
+    def _dispatch_batch(self, items, batched: Optional[bool] = None):
         """Shared first stage of the batched and pipelined paths: host-solve
         or dispatch every schedule (async, device->host copies queued), and
         start ONE overlap worker for the pending schedules' host work.
         Returns (results, pending, zones_box, overlap) where `results` holds
-        the already-finished slots and pending the in-flight ones."""
+        the already-finished slots and pending the in-flight ones.
+
+        `batched` pins the host-gate threshold independently of len(items):
+        the OOM bisect re-dispatches HALVES of a batch, and a singleton half
+        re-gated as unary would flip host/device routing — the recovered
+        plan must be bit-identical to the unsplit solve's."""
+        if batched is None:
+            batched = len(items) > 1
         results: List[Optional[ffd.PackResult]] = [None] * len(items)
         pending = []  # (index, groups, fleet, fused, prebuilt_pool)
         for i, (groups, fleet) in enumerate(items):
@@ -2026,9 +2154,7 @@ class CostSolver(Solver):
                 results[i] = ffd.pack_groups(fleet, groups)
                 continue
             prebuilt_pool = None  # (zones, matrix) when the host gate ran
-            if host_solve_enabled(
-                int(groups.counts.sum()), batched=len(items) > 1
-            ):
+            if host_solve_enabled(int(groups.counts.sum()), batched=batched):
                 # Small schedule: the host path answers in milliseconds —
                 # cheaper than even a SHARED device fetch's slice of work.
                 # A single-item "batch" has no fetch to amortize, so it uses
@@ -2117,9 +2243,24 @@ class CostSolver(Solver):
         build all pool matrices while the device works, then fetch ALL
         compacted payloads in one device->host transfer — K schedules cost
         one round trip instead of K (the round trip dominates on tunneled
-        devices)."""
-        results, pending, zones_box, overlap = self._dispatch_batch(items)
+        devices). Rides the OOM-survival ladder: oversized batches are
+        pre-split by the HBM estimator, and a live RESOURCE_EXHAUSTED
+        bisects and re-dispatches instead of crashing the sweep."""
+        return self._solve_batch_survive(list(items), batched=len(items) > 1)
+
+    def _solve_batch_fetch(
+        self,
+        items: Sequence[Tuple[PodGroups, InstanceFleet]],
+        batched: bool,
+    ) -> List[ffd.PackResult]:
+        """One dispatch->fetch->finish round for `items` — the unit the
+        bisect retries. Raises (RESOURCE_EXHAUSTED included) instead of
+        falling back; _solve_batch_survive owns recovery."""
+        results, pending, zones_box, overlap = self._dispatch_batch(
+            items, batched=batched
+        )
         if pending:
+            _maybe_inject_device_oom()
             with device_profile(TRACER), TRACER.span(
                 "solve.device.batch", solves=len(pending)
             ):
@@ -2132,6 +2273,89 @@ class CostSolver(Solver):
                     entry, zones, pool_prices, mix_plan, plan
                 )
         return results
+
+    def _solve_batch_survive(
+        self,
+        items: List[Tuple[PodGroups, InstanceFleet]],
+        batched: bool,
+        depth: int = 0,
+    ) -> List[ffd.PackResult]:
+        """Device-memory survival ladder around the batched solve:
+
+        1. depth 0 pre-splits by the HBM estimator — a batch whose estimated
+           footprint exceeds the device budget never reaches the device
+           whole (reason="estimate").
+        2. A RESOURCE_EXHAUSTED from dispatch/fetch bisects the batch and
+           re-dispatches the halves sequentially (reason="oom") — each half
+           re-runs the identical per-schedule math under the ORIGINAL
+           batched gate, so recovered plans are bit-identical to the
+           unsplit solve's.
+        3. A singleton that still OOMs is the floor (reason="floor"): fall
+           through to the existing BackendHealth CPU pin and answer from
+           the host path — degraded latency, never a crash.
+
+        Any non-memory error propagates unchanged: retrying a batch around
+        a logic error would just re-fail, and the caller's fallback ladder
+        (provisioning's serial re-solve, the sidecar's status mapping) owns
+        those.
+        """
+        if not items:
+            return []
+        if depth == 0:
+            chunks = _presplit_for_hbm(items)
+            if len(chunks) > 1:
+                SOLVER_BATCH_SPLIT_TOTAL.inc("estimate", amount=len(chunks) - 1)
+                klog.named("solver").info(
+                    "HBM estimator pre-split solve batch: %d schedules -> "
+                    "%d chunks", len(items), len(chunks),
+                )
+                out: List[ffd.PackResult] = []
+                for chunk in chunks:
+                    out.extend(self._solve_batch_survive(chunk, batched, depth=1))
+                return out
+        try:
+            return self._solve_batch_fetch(items, batched)
+        except Exception as error:  # noqa: BLE001 — classifier gates the catch
+            if not _is_resource_exhausted(error):
+                raise
+            if len(items) == 1:
+                SOLVER_BATCH_SPLIT_TOTAL.inc("floor")
+                klog.named("solver").warning(
+                    "single schedule exhausted device memory (%s); pinning "
+                    "CPU backend and answering from the host path", error,
+                )
+                from karpenter_tpu.utils import backend_health
+
+                backend_health.pin_cpu()
+                return [self._floor_solve(*items[0])]
+            SOLVER_BATCH_SPLIT_TOTAL.inc("oom")
+            mid = len(items) // 2
+            klog.named("solver").warning(
+                "device memory exhausted (%s); bisecting %d-schedule batch "
+                "at depth %d", error, len(items), depth + 1,
+            )
+            # Sequential, not parallel: the halves must not be in flight
+            # together — co-residency is exactly what just OOMed.
+            return self._solve_batch_survive(
+                items[:mid], batched, depth=depth + 1
+            ) + self._solve_batch_survive(
+                items[mid:], batched, depth=depth + 1
+            )
+
+    @staticmethod
+    def _floor_solve(groups: PodGroups, fleet: InstanceFleet) -> ffd.PackResult:
+        """The bisect floor's answer: host cost solve (compiled FFD + mix
+        candidates — same scoring as the device candidates), or plain FFD
+        when the native library is absent. Cannot touch the device, so it
+        cannot re-OOM."""
+        zones, matrix = _pool_price_matrix(fleet)
+        dense = cost_solve_host(
+            groups.vectors, groups.counts, fleet.capacity,
+            fleet.total, fleet.prices, matrix,
+        )
+        if dense is None:
+            return ffd.pack_groups(fleet, groups)
+        return decode_dense_result(dense, groups, fleet, zones)
 
     def solve_encoded_pipelined(
         self, items: Sequence[Tuple[PodGroups, InstanceFleet]]
@@ -2155,6 +2379,11 @@ class CostSolver(Solver):
 
         def _results() -> Iterator[ffd.PackResult]:
             next_pending = 0
+            # After a mid-stream RESOURCE_EXHAUSTED, the not-yet-fetched
+            # tail is re-solved through the bisect ladder; `recovered`
+            # holds those plans, indexed from pending slot `recovered_base`.
+            recovered: Optional[List[ffd.PackResult]] = None
+            recovered_base = 0
             for i in range(len(items)):
                 if results[i] is not None:
                     yield results[i]
@@ -2162,13 +2391,39 @@ class CostSolver(Solver):
                 entry = pending[next_pending]
                 k = next_pending
                 next_pending += 1
+                if recovered is not None:
+                    yield recovered[k - recovered_base]
+                    continue
                 # Wait for THIS schedule's host work only — later schedules'
                 # mix candidates keep computing while this one decodes/binds.
                 overlap.wait(k)
-                with device_profile(TRACER), TRACER.span(
-                    "solve.device.pipelined", solve=k
-                ):
-                    plan = fetch_plan(entry[3])
+                try:
+                    _maybe_inject_device_oom()
+                    with device_profile(TRACER), TRACER.span(
+                        "solve.device.pipelined", solve=k
+                    ):
+                        plan = fetch_plan(entry[3])
+                except Exception as error:  # noqa: BLE001 — classifier gates
+                    if not _is_resource_exhausted(error):
+                        raise
+                    # The in-flight tail just proved it doesn't fit next to
+                    # whatever else holds HBM: abandon those handles and
+                    # re-solve pending[k:] through the bisect ladder, under
+                    # the SAME batched gate so plans stay bit-identical.
+                    SOLVER_BATCH_SPLIT_TOTAL.inc("oom")
+                    klog.named("solver").warning(
+                        "device memory exhausted mid-pipeline (%s); "
+                        "re-solving %d remaining schedules via bisect",
+                        error, len(pending) - k,
+                    )
+                    recovered = self._solve_batch_survive(
+                        [(e[1], e[2]) for e in pending[k:]],
+                        batched=len(items) > 1,
+                        depth=1,
+                    )
+                    recovered_base = k
+                    yield recovered[0]
+                    continue
                 yield self._finish_one(
                     entry, zones_box[k], overlap.pool_prices[k],
                     overlap.mix_plans[k], plan,

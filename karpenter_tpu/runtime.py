@@ -295,6 +295,21 @@ class ReconcileLoop:
             self.ERROR_BACKOFF_BASE_S, self.ERROR_BACKOFF_CAP_S, streak
         )
 
+    def forget(self, key) -> None:
+        """Drop a terminally deleted key's backoff streak. The streak is
+        only ever popped on a SUCCESSFUL reconcile — a key that erred its
+        way out of existence (deleted mid-outage) would otherwise hold its
+        entry forever, one leak per churned pod/node over a long soak. The
+        pending queue entry (if any) self-heals: the key pops, reconciles
+        to a not-found no-op, and leaves no state behind."""
+        with self._cv:
+            self._err_streak.pop(key, None)
+
+    def err_streak_size(self) -> int:
+        """Soak-oracle accessor: backoff entries currently held."""
+        with self._cv:
+            return len(self._err_streak)
+
     def _reconcile_chunk(self, keys: list) -> None:
         """Reconcile a popped chunk; metrics are recorded once per chunk
         (per-key durations, batched) so high-concurrency pools don't convoy
@@ -564,7 +579,8 @@ class Manager:
         RECORDER.configure(clock=cluster.clock)
         OBS.attach(cluster)
         self.provisioning = ProvisioningController(
-            cluster, cloud, self.solver, cluster_state=self.cluster_state
+            cluster, cloud, self.solver, cluster_state=self.cluster_state,
+            queue_max_pods=options.provision_queue_max_pods,
         )
         self.selection = SelectionController(cluster, self.provisioning)
         self.termination = TerminationController(cluster, cloud)
@@ -754,6 +770,24 @@ class Manager:
             self.loops["counter"].enqueue(obj.name)
             self.loops["metrics"].enqueue(obj.name)
 
+    def _on_delta(self, verb: str, kind: str, obj) -> None:
+        """Terminal deletes prune the per-key error-backoff streaks
+        (ReconcileLoop.forget): a pod/node that erred its way out of
+        existence would otherwise leak one streak entry per churned object
+        for the life of the process — invisible in 10-second smokes, a
+        steady drip over a soak. Rides the store's verb-level feed; the
+        plain watch (no verb) cannot see deletes as deletes."""
+        if verb != "delete":
+            return
+        if kind == "pod":
+            self.loops["selection"].forget((obj.namespace, obj.name))
+        elif kind == "node":
+            self.loops["node"].forget(obj.name)
+            self.loops["termination"].forget(obj.name)
+        elif kind == "provisioner":
+            for name in ("provisioning", "counter", "metrics"):
+                self.loops[name].forget(obj.name)
+
     # --- batch loop ---------------------------------------------------------
 
     def _batch_loop(self) -> None:
@@ -817,6 +851,7 @@ class Manager:
     def start(self) -> None:
         self.standby.clear()
         self.cluster.watch(self._on_event)
+        self.cluster.watch_deltas(self._on_delta)
         for loop in self.loops.values():
             loop.start()
         # Standalone eviction pump (ref: termination/eviction.go:45-57): the

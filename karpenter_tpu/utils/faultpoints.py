@@ -60,6 +60,11 @@ instrumentation):
 - ``kubelet.eviction``   crossed per eviction the kubelet should complete:
                     ``black-hole`` = the pod sticks terminating forever
                     (the stuck-drain breaker's prey)
+- ``solver.dispatch``    crossed per device solve batch (models/solver.py
+                    CostSolver): ``oom`` raises RESOURCE_EXHAUSTED at the
+                    dispatch/fetch choke point — the bisect-and-retry
+                    ladder's prey (arm with count=N to force N split
+                    depths before the batch fits)
 """
 
 from __future__ import annotations
@@ -84,6 +89,7 @@ SITES = (
     "kubelet.heartbeat",
     "kubelet.pod-ready",
     "kubelet.eviction",
+    "solver.dispatch",
 )
 
 REQUEST_SITES = tuple(s for s in SITES if s.startswith("api.request."))
@@ -104,6 +110,7 @@ KINDS_BY_SITE = {
     "kubelet.heartbeat": ("drop", "flap"),
     "kubelet.pod-ready": ("delay",),
     "kubelet.eviction": ("black-hole",),
+    "solver.dispatch": ("oom",),
 }
 
 

@@ -624,6 +624,15 @@ class PodLifecycleTracker:
             tracked = len(self._pods)
         TRACKED_PODS.set(float(tracked))
 
+    def pending_anchors(self, uids: Sequence[str]) -> Dict[str, float]:
+        """Pending-cycle anchor (epoch seconds) per tracked uid — one lock
+        round for a whole backlog. Untracked uids are omitted; callers treat
+        a missing anchor as "newest" (the provisioning worker's aging refill
+        sorts by this, so an untracked pod can never starve a tracked one)."""
+        with self._lock:
+            pods = self._pods
+            return {uid: pods[uid].anchor for uid in uids if uid in pods}
+
     def tracked(self) -> int:
         with self._lock:
             return len(self._pods)

@@ -125,6 +125,13 @@ class Options:
     # drain_stalled_total{reason="unreachable"}) rather than leaving pods
     # on an unreachable node.
     drain_stuck_timeout: float = 120.0
+    # Admission cap per provisioner worker (batch window + overflow
+    # backlog). Past it, adds are REFUSED back onto selection's backoff
+    # requeue (counted on provision_backpressure_total{reason="queue-full"})
+    # instead of growing the overflow without bound — the overload story's
+    # bounded-admission layer (docs/design/overload.md and the
+    # operations.md "saturation" runbook).
+    provision_queue_max_pods: int = 50_000
 
     def _kube_retry_errors(self) -> List[str]:
         """Retry-envelope flag validation (kubeapi/client.py RetryPolicy)."""
@@ -205,6 +212,15 @@ class Options:
                 f"{self.encode_compaction_threshold}"
             )
         errors.extend(self._node_health_errors())
+        from karpenter_tpu.controllers.provisioning import MAX_PODS_PER_BATCH
+
+        if self.provision_queue_max_pods < MAX_PODS_PER_BATCH:
+            errors.append(
+                "provision-queue-max-pods must be >= one batch window "
+                f"({MAX_PODS_PER_BATCH}) — a cap below it would refuse pods "
+                "a single batch could absorb, got "
+                f"{self.provision_queue_max_pods}"
+            )
         return errors
 
     def _node_health_errors(self) -> List[str]:
@@ -335,6 +351,10 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         "--drain-stuck-timeout", type=float,
         default=float(_env("DRAIN_STUCK_TIMEOUT", "120")),
     )
+    parser.add_argument(
+        "--provision-queue-max-pods", type=int,
+        default=int(_env("PROVISION_QUEUE_MAX_PODS", "50000")),
+    )
     args = parser.parse_args(argv)
     options = Options(
         cluster_name=args.cluster_name,
@@ -366,6 +386,7 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         node_unreachable_timeout=args.node_unreachable_timeout,
         node_liveness_timeout=args.node_liveness_timeout,
         drain_stuck_timeout=args.drain_stuck_timeout,
+        provision_queue_max_pods=args.provision_queue_max_pods,
     )
     options.validate()
     return options

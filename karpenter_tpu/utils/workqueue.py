@@ -54,10 +54,16 @@ class BackoffQueue:
         base_delay: float = 0.1,
         max_delay: float = 10.0,
         clock: Optional[Clock] = None,
+        max_items: Optional[int] = None,
     ):
         self.base_delay = base_delay
         self.max_delay = max_delay
         self.clock = clock or SYSTEM_CLOCK
+        # Requeue-set bound: past this many distinct in-flight items, add()
+        # refuses new ones (requeues of items already held always land —
+        # dropping an accepted item's retry would strand it). None =
+        # unbounded, for queues whose feeder is itself bounded.
+        self.max_items = max_items
         self._queue: deque = deque()  # vet: guarded-by(self._lock)
         self._in_queue: set = set()  # vet: guarded-by(self._lock)
         self._failures: Dict[Hashable, int] = {}  # vet: guarded-by(self._lock)
@@ -67,6 +73,8 @@ class BackoffQueue:
     def add(self, item: Hashable) -> bool:
         with self._lock:
             if item in self._in_queue:
+                return False
+            if self.max_items is not None and len(self._in_queue) >= self.max_items:
                 return False
             self._in_queue.add(item)
             self._queue.append(item)

@@ -123,7 +123,8 @@ class TestFaultpointFacility:
             Path(__file__).parent / "fake_kubelet.py",
         ]
         pattern = re.compile(
-            r'"((?:api\.request|watch|kubelet)\.[a-z0-9-]+|market\.feed|lease\.cas)"'
+            r'"((?:api\.request|watch|kubelet)\.[a-z0-9-]+'
+            r'|market\.feed|lease\.cas|solver\.dispatch)"'
         )
         found = set()
         for path in scanned:

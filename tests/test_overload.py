@@ -1,0 +1,509 @@
+"""Overload control plane tests: bounded admission with anti-starvation,
+critical priority lanes in the kube client, and device-OOM batch survival —
+the ISSUE 18 tentpole's regression coverage. The soak smoke
+(tools/soak_smoke.py) composes these layers; these tests pin each one in
+isolation so a soak failure bisects to a layer, not a rerun."""
+
+import pytest
+
+from karpenter_tpu.api.provisioner import Constraints, Provisioner, ProvisionerSpec
+from karpenter_tpu.controllers import provisioning as provisioning_mod
+from karpenter_tpu.controllers.provisioning import (
+    PROVISION_BACKPRESSURE_TOTAL,
+    PROVISION_QUEUE_DEPTH,
+)
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.utils import faultpoints
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.workqueue import BackoffQueue
+
+from tests import fixtures
+from tests.harness import Harness
+
+
+@pytest.fixture(autouse=True)
+def _clean_faultpoints():
+    faultpoints.disarm_all()
+    faultpoints.seed(0)
+    yield
+    faultpoints.disarm_all()
+
+
+def default_provisioner(**kwargs) -> Provisioner:
+    return Provisioner(name="default", spec=ProvisionerSpec(**kwargs))
+
+
+# --- bounded admission (tentpole layer 1) ------------------------------------
+
+
+class TestBoundedAdmission:
+    """ProvisionerWorker.add refuses past --provision-queue-max-pods; the
+    refusal rides selection's backoff ladder instead of growing an
+    unbounded overflow list."""
+
+    def _harness(self, cap: int) -> Harness:
+        h = Harness()
+        h.provisioning.queue_max_pods = cap
+        h.apply_provisioner(default_provisioner())
+        return h
+
+    def test_add_refuses_past_cap_and_counts_backpressure(self):
+        h = self._harness(cap=10)
+        worker = h.provisioning.worker("default")
+        before = PROVISION_BACKPRESSURE_TOTAL.get("queue-full")
+        pods = fixtures.pods(12, cpu="100m", memory="64Mi")
+        accepted = [worker.add(p) for p in pods]
+        assert accepted[:10] == [True] * 10
+        assert accepted[10:] == [False, False]
+        assert worker.queue_depth() == 10
+        assert PROVISION_BACKPRESSURE_TOTAL.get("queue-full") == before + 2
+        assert PROVISION_QUEUE_DEPTH.get("default") == 10.0
+
+    def test_duplicate_add_still_held_at_cap(self):
+        """A re-verify of a pod the worker already holds is not a refusal —
+        returning False would bounce an ADMITTED pod onto the backoff
+        ladder and double-track it."""
+        h = self._harness(cap=5)
+        worker = h.provisioning.worker("default")
+        pods = fixtures.pods(5, cpu="100m", memory="64Mi")
+        for pod in pods:
+            assert worker.add(pod)
+        assert worker.add(pods[0]) is True  # held, not refused
+        assert worker.queue_depth() == 5
+
+    def test_drain_releases_saturation(self):
+        h = self._harness(cap=5)
+        worker = h.provisioning.worker("default")
+        for pod in fixtures.pods(5, cpu="100m", memory="64Mi"):
+            worker.add(pod)
+        late = fixtures.pod(name="late", cpu="100m", memory="64Mi")
+        assert worker.add(late) is False
+        worker._drain()
+        assert worker.queue_depth() == 0
+        assert worker.add(late) is True
+
+    def test_refused_pod_lands_on_selection_backoff_ladder(self):
+        h = self._harness(cap=3)
+        worker = h.provisioning.worker("default")
+        selection = SelectionController(h.cluster, h.provisioning)
+        pods = fixtures.pods(4, cpu="100m", memory="64Mi")
+        for pod in pods:
+            h.cluster.apply_pod(pod)
+        delays = [selection.reconcile(p.namespace, p.name) for p in pods]
+        # First three accepted (slow-poll requeue), fourth refused with a
+        # SHORT backoff — the queue drains on the batch cadence, so the
+        # refused cap (30s) stays far under the no-match ceiling.
+        assert delays[:3] == [SelectionController.ACCEPTED_REQUEUE_SECONDS] * 3
+        assert 0 < delays[3] <= SelectionController.REFUSED_BACKOFF_MAX_SECONDS
+        assert worker.queue_depth() == 3
+        # After the window drains, the refused pod's retry is accepted.
+        worker._drain()
+        assert (
+            selection.reconcile(pods[3].namespace, pods[3].name)
+            == SelectionController.ACCEPTED_REQUEUE_SECONDS
+        )
+
+    def test_overflow_refill_is_aging_ordered_across_windows(self, monkeypatch):
+        """Anti-starvation: a pod admitted before the cap is solved in
+        FIFO-aging order across >=3 batch windows — re-adds arriving out of
+        order cannot push an old pending cycle behind fresher waves."""
+        monkeypatch.setattr(provisioning_mod, "MAX_PODS_PER_BATCH", 4)
+        h = self._harness(cap=100)
+        worker = h.provisioning.worker("default")
+        pods = fixtures.pods(16, cpu="100m", memory="64Mi")
+        # Arrival order is the REVERSE of pending-cycle age: the last-added
+        # pods have the oldest anchors (a refused-and-retried wave).
+        anchors = {p.uid: 1000.0 - i for i, p in enumerate(pods)}
+        monkeypatch.setattr(
+            provisioning_mod.OBS, "pending_anchors",
+            lambda uids: {u: anchors[u] for u in uids if u in anchors},
+        )
+        for pod in pods:
+            worker.add(pod)
+        windows = []
+        for _ in range(4):
+            windows.append([p.uid for p in worker._drain()])
+            h.clock.advance(provisioning_mod.BATCH_IDLE_SECONDS + 0.1)
+        assert [len(w) for w in windows] == [4, 4, 4, 4]
+        # Window 1 is the already-open batch (arrival order); every refill
+        # after it drains oldest-anchor-first: pods 15, 14, ... 4.
+        refill_order = [uid for window in windows[1:] for uid in window]
+        expected = [p.uid for p in sorted(pods[4:], key=lambda p: anchors[p.uid])]
+        assert refill_order == expected
+
+    def test_batch_window_age_histogram_observed(self):
+        h = self._harness(cap=100)
+        worker = h.provisioning.worker("default")
+        before = provisioning_mod.BATCH_WINDOW_AGE.count()
+        for pod in fixtures.pods(6, cpu="100m", memory="64Mi"):
+            worker.add(pod)
+        batch = worker._drain()
+        assert len(batch) == 6
+        assert provisioning_mod.BATCH_WINDOW_AGE.count() == before + 6
+
+
+# --- selection BackoffQueue bound (satellite 1) ------------------------------
+
+
+class TestBackoffQueueBound:
+    def test_dedup_holds_at_ten_thousand_keys(self):
+        q = BackoffQueue(clock=FakeClock())
+        keys = [("default", f"pod-{i}") for i in range(12_000)]
+        assert all(q.add(k) for k in keys)
+        # A full re-verify storm re-adds every key: nothing grows.
+        assert not any(q.add(k) for k in keys)
+        assert len(q) == 12_000
+
+    def test_max_items_refuses_new_keys_but_keeps_requeues(self):
+        q = BackoffQueue(clock=FakeClock(), max_items=10_000)
+        keys = [f"pod-{i}" for i in range(10_000)]
+        assert all(q.add(k) for k in keys)
+        assert q.add("pod-overflow") is False
+        assert len(q) == 10_000
+        # Draining frees capacity for new keys.
+        done = q.process(lambda item: True)
+        assert done == 10_000
+        assert q.add("pod-overflow") is True
+
+    def test_failing_items_requeue_within_the_bound(self):
+        clock = FakeClock()
+        q = BackoffQueue(clock=clock, max_items=2)
+        q.add("a")
+        q.add("b")
+        q.process(lambda item: False)  # both fail -> backoff requeue
+        assert len(q) == 2
+        assert q.add("c") is False  # bound counts the requeued set
+        clock.advance(60.0)
+        q.process(lambda item: True)
+        assert q.add("c") is True
+
+
+# --- ReconcileLoop backoff prune (satellite 3) -------------------------------
+
+
+class TestReconcileBackoffPrune:
+    def _loop(self):
+        from karpenter_tpu.runtime import ReconcileLoop
+
+        return ReconcileLoop("t", reconcile=lambda key: None)
+
+    def test_forget_drops_streak(self):
+        loop = self._loop()
+        with loop._cv:
+            loop._err_streak[("default", "pod-1")] = 7
+            loop._err_streak[("default", "pod-2")] = 3
+        loop.forget(("default", "pod-1"))
+        assert loop.err_streak_size() == 1
+        loop.forget(("default", "pod-1"))  # idempotent
+        assert loop.err_streak_size() == 1
+
+    def test_manager_delta_routes_terminal_deletes(self):
+        """Manager._on_delta prunes the right loop per kind — the leak was
+        one streak entry per churned pod/node for the life of the process."""
+        from types import SimpleNamespace
+
+        from karpenter_tpu.runtime import Manager
+
+        loops = {
+            name: self._loop()
+            for name in (
+                "selection", "node", "termination",
+                "provisioning", "counter", "metrics",
+            )
+        }
+        for loop in loops.values():
+            with loop._cv:
+                loop._err_streak["sentinel"] = 1
+        with loops["selection"]._cv:
+            loops["selection"]._err_streak[("default", "churned")] = 9
+        stub = SimpleNamespace(loops=loops)
+        pod = SimpleNamespace(namespace="default", name="churned")
+        Manager._on_delta(stub, "update", "pod", pod)  # non-terminal: no-op
+        assert loops["selection"].err_streak_size() == 2
+        Manager._on_delta(stub, "delete", "pod", pod)
+        assert loops["selection"].err_streak_size() == 1
+        node = SimpleNamespace(name="node-1")
+        with loops["node"]._cv:
+            loops["node"]._err_streak["node-1"] = 2
+        with loops["termination"]._cv:
+            loops["termination"]._err_streak["node-1"] = 2
+        Manager._on_delta(stub, "delete", "node", node)
+        assert loops["node"].err_streak_size() == 1
+        assert loops["termination"].err_streak_size() == 1
+
+
+# --- critical priority lanes (tentpole layer 2) ------------------------------
+
+
+class TestCriticalLanes:
+    def test_wait_never_livelocks_on_sub_ulp_refill(self):
+        """Refill arithmetic can leave a token deficit smaller than the
+        clock's double-precision ULP; the matching sleep then advances a
+        large-valued FakeClock by exactly nothing and wait() spins forever
+        (found by the soak's throttled rig at fake_now=1e6). The MIN_SLEEP_S
+        floor must keep the refill landing."""
+        import threading
+
+        from karpenter_tpu.kubeapi.client import RateLimiter
+
+        clock = FakeClock(start=1_000_000.0)
+        limiter = RateLimiter(qps=50.0, burst=20, clock=clock, critical_reserve=2)
+        drained = []
+
+        def drain():
+            for _ in range(60):  # well past the burst: forces refill waits
+                limiter.wait()
+            drained.append(True)
+
+        worker = threading.Thread(target=drain, daemon=True)
+        worker.start()
+        worker.join(timeout=10.0)
+        assert drained, "RateLimiter.wait livelocked on a sub-ULP token deficit"
+
+    def test_current_lane_defaults_bulk_and_nests(self):
+        from karpenter_tpu.kubeapi.client import critical_lane, current_lane
+
+        assert current_lane() == "bulk"
+        with critical_lane():
+            assert current_lane() == "critical"
+            with critical_lane():
+                assert current_lane() == "critical"
+            assert current_lane() == "critical"
+        assert current_lane() == "bulk"
+
+    def test_bulk_cannot_drain_below_the_reserve(self):
+        from karpenter_tpu.kubeapi.client import RateLimiter
+
+        clock = FakeClock()
+        limiter = RateLimiter(qps=1.0, burst=10, clock=clock, critical_reserve=2)
+        # Bulk takes burst - reserve tokens for free, then must wait.
+        for _ in range(8):
+            assert limiter.wait() == 0.0
+        t0 = clock.now()
+        assert limiter.wait() > 0.0  # bulk slept for refill
+        assert clock.now() > t0
+
+    def test_critical_lane_passes_through_a_bulk_storm(self):
+        """The lease-loss regression: with bulk throttled at the reserve
+        floor, a critical call (lease renew) still gets a token with ZERO
+        sleep — previously it queued behind the storm and the leader's
+        lease expired before the renew's turn came."""
+        from karpenter_tpu.kubeapi.client import RateLimiter
+
+        clock = FakeClock()
+        limiter = RateLimiter(qps=1.0, burst=10, clock=clock, critical_reserve=2)
+        for _ in range(8):
+            limiter.wait()  # the bulk storm drains to the floor
+        assert limiter.wait(critical=True) == 0.0
+        assert limiter.wait(critical=True) == 0.0
+        # The reserve is spent: even critical now pays refill, bounded by
+        # arithmetic (1 token / qps), not by the storm's queue.
+        assert limiter.wait(critical=True) == pytest.approx(1.0)
+
+    def test_client_routes_lane_from_context(self):
+        """KubeClient passes the ambient lane to the limiter per request —
+        the storm test above only protects callers that actually ride the
+        critical flag."""
+        from tests.fake_apiserver import DirectTransport, FakeApiServer
+
+        from karpenter_tpu.kubeapi.client import KubeClient, critical_lane
+
+        clock = FakeClock()
+        client = KubeClient(
+            DirectTransport(FakeApiServer(clock=clock)),
+            qps=1.0, burst=10, clock=clock, critical_reserve=2,
+        )
+        seen = []
+        real_wait = client.limiter.wait
+
+        def spy(critical=False):
+            seen.append(critical)
+            return real_wait(critical=critical)
+
+        client.limiter.wait = spy
+        client.get("/api/v1/nodes")
+        with critical_lane():
+            client.get("/api/v1/nodes")
+        assert seen == [False, True]
+
+    def test_lease_renew_survives_a_bulk_storm(self):
+        """End-to-end: drain the bucket with bulk reads, then renew the
+        lease — the renew must not advance the clock (no throttle sleep),
+        i.e. the storm can no longer cost the leader its lease."""
+        from tests.fake_apiserver import DirectTransport, FakeApiServer
+
+        from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient
+
+        clock = FakeClock()
+        server = FakeApiServer(clock=clock)
+        client = KubeClient(
+            DirectTransport(server),
+            qps=1.0, burst=20, clock=clock, critical_reserve=4,
+        )
+        cluster = ApiServerCluster(client, clock=clock)
+        try:
+            assert cluster.acquire_lease("leader", "mgr-1", duration_s=15.0) > 0
+            while client.limiter.wait() == 0.0:
+                pass  # bulk storm: drain to the reserve floor
+            t0 = clock.now()
+            assert cluster.acquire_lease("leader", "mgr-1", duration_s=15.0) > 0
+            # The whole read-CAS round rode the reserve: zero throttle sleep.
+            assert clock.now() == t0
+        finally:
+            cluster.close()
+
+
+# --- device-OOM batch survival (tentpole layer 3) ----------------------------
+
+
+def _canonical(result):
+    """Exact (bit-identical) rendering of a PackResult: node layouts, the
+    option ladders, the projected cost — float compared with ==, not
+    approx, because the bisect re-runs the IDENTICAL per-schedule math."""
+    return (
+        tuple(
+            (
+                tuple(opt.name for opt in packing.instance_type_options),
+                tuple(
+                    tuple(p.name for p in node) for node in packing.pods_per_node
+                ),
+            )
+            for packing in result.packings
+        ),
+        tuple(p.name for p in result.unschedulable),
+        result.projected_cost(),
+    )
+
+
+class TestDeviceOomSurvival:
+    """RESOURCE_EXHAUSTED at dispatch bisects the batch and re-dispatches
+    halves under the ORIGINAL host-gate flag — plans come out bit-identical
+    to the unsplit solve, and only a single schedule that still won't fit
+    falls through to the BackendHealth CPU pin."""
+
+    @pytest.fixture(autouse=True)
+    def _device_path(self, monkeypatch):
+        # Force the device dispatch so the solver.dispatch faultpoint is
+        # actually crossed, and keep the single-chip kernel.
+        monkeypatch.setenv("KARPENTER_HOST_SOLVE", "0")
+        monkeypatch.setenv("KARPENTER_SHARDED_SOLVE", "0")
+        monkeypatch.delenv("KARPENTER_HBM_BYTES", raising=False)
+
+    @staticmethod
+    def _problems(count=8):
+        from karpenter_tpu.ops.encode import build_fleet, group_pods
+
+        problems = []
+        for k in range(count):
+            pods = fixtures.pods(10 + 5 * k, cpu="1", memory="1Gi")
+            catalog = fixtures.size_ladder(3 + (k % 3))
+            problems.append(
+                (group_pods(pods), build_fleet(catalog, Constraints(), pods))
+            )
+        return problems
+
+    @pytest.mark.parametrize("failures", [1, 2, 3])
+    def test_rotating_split_depths_bit_identical(self, failures):
+        from karpenter_tpu.models.solver import CostSolver
+
+        solver = CostSolver(lp_steps=4)
+        problems = self._problems(8)
+        baseline = [_canonical(r) for r in solver.solve_encoded_many(problems)]
+        fault = faultpoints.arm("solver.dispatch", "oom", count=failures)
+        survived = solver.solve_encoded_many(problems)
+        assert fault.fires == failures  # each depth re-dispatched and re-failed
+        assert [_canonical(r) for r in survived] == baseline
+
+    def test_pipelined_path_recovers_mid_stream(self):
+        from karpenter_tpu.models.solver import CostSolver
+
+        solver = CostSolver(lp_steps=4)
+        problems = self._problems(6)
+        baseline = [
+            _canonical(r) for r in solver.solve_encoded_pipelined(problems)
+        ]
+        fault = faultpoints.arm("solver.dispatch", "oom", count=1)
+        survived = [
+            _canonical(r) for r in solver.solve_encoded_pipelined(problems)
+        ]
+        assert fault.fires == 1
+        assert survived == baseline
+
+    def test_floor_falls_through_to_cpu_pin(self, monkeypatch):
+        """A SINGLE schedule that still OOMs is the floor: pin the CPU
+        backend (the existing BackendHealth fallback) and answer from the
+        host path — never a crash, never a silent drop."""
+        from karpenter_tpu.models import solver as S
+        from karpenter_tpu.models.solver import CostSolver
+        from karpenter_tpu.utils import backend_health
+
+        pinned = []
+        monkeypatch.setattr(backend_health, "pin_cpu", lambda: pinned.append(1))
+        before = S.SOLVER_BATCH_SPLIT_TOTAL.get("floor")
+        faultpoints.arm("solver.dispatch", "oom")  # unlimited: every retry fails
+        problems = self._problems(1)
+        [result] = CostSolver(lp_steps=4).solve_encoded_many(problems)
+        assert pinned == [1]
+        assert S.SOLVER_BATCH_SPLIT_TOTAL.get("floor") == before + 1
+        # The floor still answers: every pod placed or explicitly left over.
+        placed = sum(
+            len(node) for p in result.packings for node in p.pods_per_node
+        )
+        assert placed + len(result.unschedulable) == 10
+
+    def test_whole_batch_never_silently_pinned(self):
+        """The acceptance criterion's negative space: a multi-schedule OOM
+        must bisect, not dump the entire batch onto the CPU pin — only the
+        floor (a lone schedule) may pin."""
+        from karpenter_tpu.models import solver as S
+        from karpenter_tpu.models.solver import CostSolver
+
+        before_oom = S.SOLVER_BATCH_SPLIT_TOTAL.get("oom")
+        before_floor = S.SOLVER_BATCH_SPLIT_TOTAL.get("floor")
+        faultpoints.arm("solver.dispatch", "oom", count=1)
+        CostSolver(lp_steps=4).solve_encoded_many(self._problems(4))
+        assert S.SOLVER_BATCH_SPLIT_TOTAL.get("oom") == before_oom + 1
+        assert S.SOLVER_BATCH_SPLIT_TOTAL.get("floor") == before_floor
+
+    def test_hbm_estimator_presplits_oversized_batch(self, monkeypatch):
+        from karpenter_tpu.models import solver as S
+        from karpenter_tpu.models.solver import CostSolver
+
+        solver = CostSolver(lp_steps=4)
+        problems = self._problems(6)
+        baseline = [_canonical(r) for r in solver.solve_encoded_many(problems)]
+        # Budget sized to hold ~2 schedules per chunk: the batch must be
+        # pre-split WITHOUT any injected failure.
+        per_item = max(S._estimate_solve_bytes(*p) for p in problems)
+        monkeypatch.setenv(
+            "KARPENTER_HBM_BYTES", str(per_item * 2 / S.HBM_SAFETY_FACTOR)
+        )
+        before = S.SOLVER_BATCH_SPLIT_TOTAL.get("estimate")
+        split = [_canonical(r) for r in solver.solve_encoded_many(problems)]
+        assert S.SOLVER_BATCH_SPLIT_TOTAL.get("estimate") > before
+        assert split == baseline
+
+    def test_non_memory_errors_propagate(self, monkeypatch):
+        """The bisect must not eat logic errors — retrying those just
+        re-fails slower and hides the bug."""
+        from karpenter_tpu.models import solver as S
+        from karpenter_tpu.models.solver import CostSolver
+
+        def explode(*args, **kwargs):
+            raise ValueError("bad plan decode")
+
+        monkeypatch.setattr(S, "fetch_plans", explode)
+        with pytest.raises(ValueError, match="bad plan decode"):
+            CostSolver(lp_steps=4).solve_encoded_many(self._problems(2))
+
+    def test_classifier_matches_known_phrasings(self):
+        from karpenter_tpu.models.solver import _is_resource_exhausted
+
+        assert _is_resource_exhausted(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 2GiB")
+        )
+        assert _is_resource_exhausted(
+            RuntimeError("Failed to allocate 1073741824 bytes")
+        )
+        assert not _is_resource_exhausted(ValueError("shape mismatch"))
+        assert not _is_resource_exhausted(TimeoutError("deadline"))

@@ -96,6 +96,7 @@ class TestDashboards:
         # Touch the histogram/gauge modules so registration runs.
         import karpenter_tpu.controllers.provisioning  # noqa: F401
         import karpenter_tpu.controllers.metrics  # noqa: F401
+        import karpenter_tpu.kubeapi.client  # noqa: F401 — lane-wait histogram
         import karpenter_tpu.runtime  # noqa: F401 — reconcile-loop metrics
         import karpenter_tpu.solver_service.client  # noqa: F401
 

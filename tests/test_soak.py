@@ -1,0 +1,36 @@
+"""Pytest wrapper for the sustained-churn soak's FULL profile.
+
+The short profile runs as `make soak-smoke` inside `make smoke` (tier-1
+pacing); this wrapper is the `slow`-marked entry point for the multi-minute
+profile, so `pytest -m slow` (or CI's soak lane) exercises the same gates at
+sustained scale without a Makefile detour. Subprocessed, not imported: the
+soak mutates process-global observability state (OBS, RECORDER, REGISTRY)
+and spins a real threaded Manager — it must not share an interpreter with
+the rest of the suite.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_soak_full_profile():
+    env = dict(os.environ, SOAK_FULL="1", JAX_PLATFORMS="cpu")
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "soak_smoke.py")],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=480,
+    )
+    assert result.returncode == 0, (
+        f"full-profile soak failed (rc {result.returncode}):\n"
+        f"{result.stdout}\n{result.stderr[-2000:]}"
+    )
+    assert "soak-smoke[full]: OK" in result.stdout

@@ -1,0 +1,521 @@
+"""Sustained-churn soak (`make soak-smoke`): the overload capstone.
+
+Every other smoke proves the control plane survives *point* faults in ~10
+seconds. This one proves it DEGRADES AND RECOVERS: an overload phase where
+the pod arrival rate deliberately exceeds the drain rate — riding the
+chaos-transport fault storm, a throttled kube client (real token bucket,
+not the 1e6-qps test client), and mid-storm spot interruptions — followed
+by a recovery phase where arrivals stop and the backlog must drain. The
+priority-lane audit runs on a second, genuinely-throttled client (the
+"rig") over the same server and clock: every tick drains its bucket with
+more bulk calls than the tick refills, then renews the lease through the
+critical lane of that same contended bucket. Gates:
+
+- BOUNDED ADMISSION: the provisioner queue never exceeds its cap, refusals
+  are counted (`provision_backpressure_total`), and every refused pod is
+  eventually solved — backpressure moved the pressure, it lost nothing;
+- PRIORITY LANES: lease renewals ride the critical lane through the bulk
+  storm — zero lease losses, no renewal delayed past its deadline, the
+  lease generation never moves (nobody ever stole leadership);
+- SLO RECOVERY: after saturation ends the backlog drains inside the
+  deadline, and once the SLO window rolls past the storm a fresh wave
+  re-attains the p99 pending target;
+- LEAK ORACLES: thread count stable, RSS growth bounded, reconcile-loop
+  backoff state pruned (not one entry per churned pod forever),
+  DeviceClusterState compaction cycles bounded, flight recorder gap-free.
+
+Two profiles: the default finishes in ~20s for tier-1 (`make smoke`);
+SOAK_FULL=1 runs the multi-minute profile (`SOAK_FULL=1 make soak-smoke`,
+or the `slow`-marked pytest wrapper in tests/test_soak.py).
+
+Wall-clock waits are real (the Manager's loops schedule on real time); the
+FakeClock drives TTL/deadline/window logic, and the throttled client's
+token-bucket sleeps advance it — overload literally accelerates cluster
+time, which is exactly the pressure the lease TTL and SLO windows feel.
+"""
+
+import os
+import sys
+import threading
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+FULL = bool(os.environ.get("SOAK_FULL"))
+
+# --- profile knobs -----------------------------------------------------------
+QUEUE_CAP = 40  # provisioner admission cap (pods)
+WAVE_PODS = 70  # arrivals per overload wave — deliberately > QUEUE_CAP
+WAVES = 14 if FULL else 3
+WAVE_SECONDS = 4.0 if FULL else 1.5  # real seconds of churn per wave
+INTERRUPT_EVERY = 4 if FULL else 2  # waves between spot interruptions
+MIN_INJECTED = 200 if FULL else 20  # the storm must actually bite
+RECOVERY_REAL_S = 120.0 if FULL else 30.0  # backlog-drain deadline (real)
+# The lane rig: a SECOND, genuinely throttled KubeClient over the same
+# server and FakeClock. The manager's own client stays unthrottled (as in
+# chaos_smoke) because limiter sleeps advance the FakeClock — a saturated
+# shared bucket would warp cluster time past every TTL. The rig gives the
+# priority-lane audit real contention with bounded time cost: each tick
+# hammers more bulk calls than the tick's refill mints, then renews the
+# lease through the critical lane of the SAME bucket.
+RIG_QPS, RIG_BURST = 50.0, 20  # default critical reserve: burst/10 = 2
+RIG_BULK_PER_TICK = 20  # > refill/tick (0.3s * 50qps = 15): sustained contention
+SLO_PENDING_P99_S = 240.0  # fake seconds
+SLO_TTFL_S = 240.0
+CRITICAL_DEADLINE_S = 2.0  # fake seconds a lease renew may cost, ceiling
+LEASE_NAME = "karpenter-tpu-leader"
+# Leak-oracle bounds (generous: the gate is "bounded", not "zero work").
+MAX_THREAD_GROWTH = 8
+MAX_RSS_GROWTH_MB = 300.0
+MAX_COMPACTIONS = 64
+MAX_BACKOFF_ENTRIES = 512
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as status:
+        for line in status:
+            if line.startswith("VmRSS:"):
+                return float(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def build_process(state):
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient, RetryPolicy
+    from karpenter_tpu.kubeapi.chaos import ChaosTransport
+    from karpenter_tpu.runtime import Manager
+    from karpenter_tpu.utils.options import Options
+    from tests.fake_apiserver import DirectTransport
+
+    client = KubeClient(
+        ChaosTransport(DirectTransport(state["server"]), clock=state["clock"]),
+        qps=1e6,
+        burst=10**6,
+        clock=state["clock"],
+        retry=RetryPolicy(max_attempts=6, backoff_base_s=0.01, backoff_cap_s=0.1),
+    )
+    client.WATCH_BACKOFF_BASE_S = 0.02
+    client.WATCH_BACKOFF_CAP_S = 0.5
+    cluster = ApiServerCluster(client, clock=state["clock"]).start()
+    manager = Manager(
+        cluster,
+        state["cloud"],
+        Options(
+            cluster_name="soak",
+            solver="greedy",
+            leader_election=False,
+            slo_pending_p99=SLO_PENDING_P99_S,
+            slo_ttfl=SLO_TTFL_S,
+        ),
+    )
+    # The soak saturates with ~100 pods, not 50k: shrink the admission cap
+    # so backpressure engages at smoke scale (the CLI floor ties the cap to
+    # MAX_PODS_PER_BATCH; the mechanism under test is cap-size-agnostic).
+    manager.provisioning.queue_max_pods = QUEUE_CAP
+    for worker in manager.provisioning.workers.values():
+        worker.queue_max_pods = QUEUE_CAP
+    manager.start()
+    state["cluster"], state["manager"] = cluster, manager
+
+
+def stop_process(state):
+    state["manager"].stop()
+    state["cluster"].close()
+
+
+def build_rig(state):
+    """The throttled client the lane audit contends on. NOT .start()ed: no
+    watch pumps — every token this bucket moves is the audit's own traffic,
+    so the contention arithmetic is deterministic."""
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient, RetryPolicy
+    from karpenter_tpu.kubeapi.chaos import ChaosTransport
+    from tests.fake_apiserver import DirectTransport
+
+    rig_client = KubeClient(
+        ChaosTransport(DirectTransport(state["server"]), clock=state["clock"]),
+        qps=RIG_QPS,
+        burst=RIG_BURST,
+        clock=state["clock"],
+        retry=RetryPolicy(max_attempts=6, backoff_base_s=0.01, backoff_cap_s=0.1),
+    )
+    state["rig"] = ApiServerCluster(rig_client, clock=state["clock"])
+
+
+def hammer_bulk(state):
+    """Drain the rig's bucket to its bulk floor: more calls per tick than
+    the tick refills, so the critical reserve is the only thing standing
+    between the storm and the lease."""
+    from karpenter_tpu.kubeapi import ApiError, TransportError
+
+    for _ in range(RIG_BULK_PER_TICK):
+        try:
+            state["rig"].api.try_get("/api/v1/nodes")
+        except (ApiError, TransportError):
+            pass  # bulk traffic may be eaten by the storm; the lane paid anyway
+
+
+def renew_lease(state):
+    """One critical-lane lease renewal through the CONTENDED rig bucket,
+    with its own delay audit: the fake seconds a renew costs IS the delay
+    the bulk storm managed to impose on the critical lane (token-bucket
+    sleeps advance the FakeClock)."""
+    clock = state["clock"]
+    t0 = clock.now()
+    won = state["rig"].acquire_lease(LEASE_NAME, "soak-mgr", 60.0)
+    delay = clock.now() - t0
+    state["renewals"] += 1
+    state["max_renew_delay"] = max(state["max_renew_delay"], delay)
+    if won:
+        state["generations"].add(int(won))
+    else:
+        state["lease_losses"] += 1
+
+
+def nudge(state, tick):
+    """Advance cluster time, heartbeat the fleet, pull sweeps forward, renew
+    the lease, and sample the overload oracles — one soak heartbeat."""
+    from karpenter_tpu.kubeapi import ApiError, TransportError
+
+    state["clock"].advance(0.3)
+    manager = state["manager"]
+    manager.loops["interruption"].enqueue("sweep")
+    if tick % 5 == 0:  # heartbeats at 1/5 tick rate: bulk load, not a flood
+        for node in state["cluster"].list_nodes():
+            # Unconditional refresh (chaos_smoke only heartbeats joining
+            # nodes): the SLO-window roll advances the fake clock hundreds
+            # of seconds, and a ready node whose status_reported_at went
+            # stale would trip the 900s liveness ladder mid-audit.
+            node.ready = True
+            node.status_reported_at = state["clock"].now()
+            try:
+                state["cluster"].update_node(node)
+            except (ApiError, TransportError):
+                pass  # storm ate the heartbeat; next beat retries
+            manager.loops["node"].enqueue(node.name)
+            manager.loops["termination"].enqueue(node.name)
+    for pod in state["cluster"].list_pods():
+        if pod.is_provisionable():
+            manager.loops["selection"].enqueue((pod.namespace, pod.name))
+    hammer_bulk(state)
+    renew_lease(state)
+    worker = manager.provisioning.worker("default")
+    if worker is not None:
+        state["max_queue_depth"] = max(
+            state["max_queue_depth"], worker.queue_depth()
+        )
+
+
+def wait_for(state, predicate, timeout, what):
+    tick = 0
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        nudge(state, tick)
+        tick += 1
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def arm_fault_storm():
+    """Low-rate but SUSTAINED: the soak crosses these sites tens of
+    thousands of times, so even 1-2%% rates inject hundreds of faults —
+    and every one lands in the flight recorder, whose gap-free oracle
+    bounds how hard the storm may blow (ring capacity 8192)."""
+    from karpenter_tpu.utils import faultpoints
+
+    faultpoints.seed(1804)
+    for site in faultpoints.REQUEST_SITES:
+        faultpoints.arm(site, "latency", rate=0.01, delay_s=0.01)
+        faultpoints.arm(site, "reset", rate=0.005)
+        faultpoints.arm(site, "server-error", rate=0.005)
+        faultpoints.arm(site, "throttle", rate=0.005, retry_after_s=0.02)
+    faultpoints.arm("api.request.patch", "conflict", rate=0.01)
+    faultpoints.arm("watch.event", "duplicate", rate=0.02)
+    faultpoints.arm("watch.event", "reorder", rate=0.02)
+    faultpoints.arm("watch.open", "tear", rate=0.02)
+    faultpoints.arm("market.feed", "stale", rate=0.1)
+    faultpoints.arm("market.feed", "reorder", rate=0.1)
+
+
+def build(state):
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.utils.clock import FakeClock
+    from tests.fake_apiserver import FakeApiServer
+
+    state["clock"] = FakeClock()
+    state["server"] = FakeApiServer(clock=state["clock"], history_limit=65536)
+    state["cloud"] = FakeCloudProvider(clock=state["clock"])
+    state["renewals"] = 0
+    state["lease_losses"] = 0
+    state["max_renew_delay"] = 0.0
+    state["generations"] = set()
+    state["max_queue_depth"] = 0
+    build_process(state)
+    build_rig(state)
+    state["cluster"].apply_provisioner(
+        Provisioner(name="default", spec=ProvisionerSpec())
+    )
+    renew_lease(state)  # take the lease before the storm starts
+
+
+def apply_with_retry(state, pod, attempts=30):
+    from karpenter_tpu.kubeapi import ApiError, TransportError
+
+    for _ in range(attempts):
+        try:
+            return state["cluster"].apply_pod(pod)
+        except (ApiError, TransportError):
+            time.sleep(0.02)
+    raise AssertionError(f"apply of {pod.name} never landed under the storm")
+
+
+def pick_victim(state):
+    victims = [
+        n
+        for n in state["cluster"].list_nodes()
+        if n.deletion_timestamp is None
+        and state["cluster"].list_pods(node_name=n.name)
+    ]
+    return sorted(victims, key=lambda n: n.name)[0] if victims else None
+
+
+def overload(state):
+    """The saturation phase: WAVE_PODS arrivals per wave against a
+    QUEUE_CAP admission window — arrival rate > drain rate by design, so
+    the overflow HAS to refuse (that's the tentpole) while interruptions
+    and the fault storm grind underneath."""
+    from tests import fixtures
+
+    applied = []
+    interrupted = 0
+    for wave in range(WAVES):
+        for i in range(WAVE_PODS):
+            pod = fixtures.pod(cpu="100m", memory="64Mi", name=f"soak{wave}-{i}")
+            apply_with_retry(state, pod)
+            applied.append(pod)
+        if wave and wave % INTERRUPT_EVERY == 0:
+            victim = pick_victim(state)
+            if victim is not None:
+                state["cloud"].inject_interruption(victim, deadline_in=600.0)
+                interrupted += 1
+        tick = 0
+        wave_ends = time.monotonic() + WAVE_SECONDS
+        while time.monotonic() < wave_ends:
+            nudge(state, tick)
+            tick += 1
+            time.sleep(0.05)
+    state["interrupted"] = interrupted
+    return applied
+
+
+def wait_recovered(state, applied):
+    """Recovery: arrivals have stopped; the refused backlog must fully
+    drain — every soak pod bound to a live node, every interruption acked —
+    inside the deadline."""
+    server = state["server"]
+    names = {p.name for p in applied}
+
+    def recovered():
+        _, payload = server.handle("GET", "/api/v1/pods")
+        by_name = {
+            p["metadata"]["name"]: p for p in payload.get("items", [])
+        }
+        if not names <= set(by_name):
+            return False
+        _, node_payload = server.handle("GET", "/api/v1/nodes")
+        live = {
+            (n.get("metadata") or {}).get("name")
+            for n in node_payload.get("items", [])
+            if not (n.get("metadata") or {}).get("deletionTimestamp")
+        }
+        return (
+            all(
+                (by_name[n].get("spec") or {}).get("nodeName") in live
+                for n in names
+            )
+            and state["cloud"].poll_interruptions() == []
+        )
+
+    wait_for(state, recovered, RECOVERY_REAL_S, "overload backlog to drain")
+
+
+def roll_slo_window(state):
+    """Age the storm's samples out of the evaluator's rolling window (300
+    fake seconds) so the re-attainment gate measures POST-recovery latency,
+    not a quieter average of the storm. Heartbeats ride along so the fast
+    clock never looks like a fleet going dark."""
+    from karpenter_tpu.utils.obs import OBS
+
+    horizon = state["clock"].now() + OBS.evaluator.WINDOW_SECONDS + 10.0
+    tick = 0
+    while state["clock"].now() < horizon:
+        state["clock"].advance(4.7)
+        nudge(state, tick * 5)  # every call a heartbeat tick
+        tick += 1
+        time.sleep(0.01)
+
+
+def assert_reattained(state):
+    """The SLO gate: a fresh wave after recovery binds inside the p99
+    pending target — the system came back, it didn't just survive."""
+    from tests import fixtures
+
+    from karpenter_tpu.utils.obs import OBS
+
+    probe = [
+        fixtures.pod(cpu="100m", memory="64Mi", name=f"probe-{i}")
+        for i in range(16)
+    ]
+    for pod in probe:
+        apply_with_retry(state, pod)
+    names = {p.name for p in probe}
+
+    def probe_bound():
+        _, payload = state["server"].handle("GET", "/api/v1/pods")
+        by_name = {p["metadata"]["name"]: p for p in payload.get("items", [])}
+        return all(
+            (by_name.get(n, {}).get("spec") or {}).get("nodeName")
+            for n in names
+        )
+
+    wait_for(state, probe_bound, 20.0, "post-recovery probe wave to bind")
+    snapshot = OBS.slo_snapshot()
+    pending = snapshot["pending"]
+    assert pending["count"] > 0, "probe wave published no pending samples"
+    assert pending["p99"] <= SLO_PENDING_P99_S, (
+        f"p99 pending not re-attained after recovery: {pending['p99']:.1f}s "
+        f"vs target {SLO_PENDING_P99_S}s"
+    )
+    return pending["p99"]
+
+
+def assert_backpressure(state):
+    from karpenter_tpu.controllers.provisioning import (
+        PROVISION_BACKPRESSURE_TOTAL,
+    )
+
+    refusals = PROVISION_BACKPRESSURE_TOTAL.get("queue-full")
+    assert refusals > 0, "overload never engaged backpressure — not saturated"
+    assert state["max_queue_depth"] <= QUEUE_CAP, (
+        f"admission cap violated: depth {state['max_queue_depth']} > "
+        f"cap {QUEUE_CAP}"
+    )
+    return refusals
+
+
+def assert_lease_survived(state):
+    from karpenter_tpu.kubeapi.client import KUBE_API_LANE_WAIT
+
+    assert state["lease_losses"] == 0, (
+        f"{state['lease_losses']} lease renewals lost under the bulk storm"
+    )
+    assert len(state["generations"]) == 1, (
+        f"lease generation moved during the soak: {state['generations']}"
+    )
+    assert state["max_renew_delay"] <= CRITICAL_DEADLINE_S, (
+        f"critical-lane renew delayed {state['max_renew_delay']:.2f}s "
+        f"(deadline {CRITICAL_DEADLINE_S}s)"
+    )
+    assert KUBE_API_LANE_WAIT.count("critical") > 0, (
+        "no critical-lane waits observed — the lane was never exercised"
+    )
+    with KUBE_API_LANE_WAIT._lock:
+        bulk_waited = KUBE_API_LANE_WAIT._sums.get(("bulk",), 0.0)
+    assert bulk_waited > 0.0, (
+        "bulk lane never throttled — the lease renewals had nothing to contend with"
+    )
+
+
+def assert_no_leaks(state, baseline_threads, baseline_rss):
+    from karpenter_tpu.utils.obs import RECORDER
+
+    threads = threading.active_count()
+    assert threads <= baseline_threads + MAX_THREAD_GROWTH, (
+        f"thread leak: {baseline_threads} -> {threads}"
+    )
+    growth = rss_mb() - baseline_rss
+    assert growth <= MAX_RSS_GROWTH_MB, f"RSS grew {growth:.0f} MiB over the soak"
+    manager = state["manager"]
+    compactions = manager.cluster_state.compaction_count
+    assert compactions <= MAX_COMPACTIONS, (
+        f"unbounded tombstone/compaction churn: {compactions} cycles"
+    )
+    backoff_entries = sum(
+        loop.err_streak_size() for loop in manager.loops.values()
+    )
+    assert backoff_entries <= MAX_BACKOFF_ENTRIES, (
+        f"reconcile backoff state grew unbounded: {backoff_entries} entries"
+    )
+    for name, loop in manager.loops.items():
+        assert loop._threads and all(t.is_alive() for t in loop._threads), (
+            f"sweep loop {name!r} has a dead worker thread at exit"
+        )
+    flight = RECORDER.snapshot()
+    assert flight["dropped"] == 0, (
+        f"flight recorder dropped {flight['dropped']} events"
+    )
+    seqs = [e["seq"] for e in flight["events"]]
+    assert seqs == list(range(1, flight["seq"] + 1)), "seq gap in the ring"
+    return threads, growth, compactions
+
+
+def main() -> int:
+    began = time.time()
+    profile = "full" if FULL else "short"
+    state = {}
+    try:
+        from karpenter_tpu.utils import faultpoints
+
+        build(state)
+        print(
+            f"soak-smoke[{profile}]: {WAVES} waves x {WAVE_PODS} pods against "
+            f"an admission cap of {QUEUE_CAP}; arming the sustained storm"
+        )
+        arm_fault_storm()
+        applied = overload(state)
+        # Leak baselines AT PEAK LOAD: the manager's pools spawn workers
+        # lazily, so build-time counts would flag the first ramp as a leak.
+        # A real leak keeps growing through recovery + the window roll; a
+        # lazy pool has already plateaued here.
+        baseline_threads = threading.active_count()
+        baseline_rss = rss_mb()
+        injected = faultpoints.total_fired()
+        assert injected >= MIN_INJECTED, (
+            f"the storm barely stormed ({injected} faults)"
+        )
+        refusals = assert_backpressure(state)
+        print(
+            f"  saturated: {len(applied)} arrivals, max queue depth "
+            f"{state['max_queue_depth']}/{QUEUE_CAP}, {refusals:.0f} refusals, "
+            f"{injected} faults injected, {state['interrupted']} interruptions"
+        )
+        faultpoints.disarm_all()  # saturation ends; quiet skies for recovery
+        wait_recovered(state, applied)
+        print(f"  recovered: backlog drained in {time.time() - began:.1f}s")
+        roll_slo_window(state)
+        p99 = assert_reattained(state)
+        assert_lease_survived(state)
+        threads, rss_growth, compactions = assert_no_leaks(
+            state, baseline_threads, baseline_rss
+        )
+        stop_process(state)
+    except AssertionError as failure:
+        print(f"soak-smoke: FAIL in {time.time() - began:.1f}s: {failure}")
+        return 1
+    print(
+        f"soak-smoke[{profile}]: OK in {time.time() - began:.1f}s "
+        f"({len(applied)} pods through a cap of {QUEUE_CAP} with "
+        f"{refusals:.0f} refusals and zero cap violations; "
+        f"{state['renewals']} lease renewals, 0 losses, max critical delay "
+        f"{state['max_renew_delay']:.2f}s; p99 pending re-attained at "
+        f"{p99:.1f}s/{SLO_PENDING_P99_S:.0f}s; threads {threads}, RSS "
+        f"+{rss_growth:.0f} MiB, {compactions} compactions, flight recorder "
+        f"gap-free)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
