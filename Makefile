@@ -5,12 +5,20 @@
 test:
 	python -m pytest tests/ -x -q
 
-# The unified AST vet suite (tools/vet/): lock-discipline, blocking-under-
-# lock, crash-safety, clock-discipline, metrics-consistency, plus the two
-# backend-ownership checks — the Python analogue of the `go vet` + race-
-# detector gate the reference's battletest fronts every change with
-# (ref Makefile:33-38). Findings print as `file:line checker message`.
-# Scan a scratch tree: python -m tools.vet path/to/file.py
+# The unified AST vet suite (tools/vet/): 13 checkers — lock-discipline,
+# blocking-under-lock (transitive, via the whole-program call graph in
+# tools/vet/callgraph.py, findings render the full call chain), lock-order
+# (deadlock cycles in the derived lock-ordering graph), fence-discipline
+# (threads reaching fenced mutations must bind the WriteFence),
+# thread-discipline (name=/daemon= on every Thread), crash-safety,
+# clock-discipline, metrics-consistency, span/metrics-use, plus the
+# backend-ownership and fetch/transport checks — the Python analogue of
+# the `go vet` + race-detector gate the reference's battletest fronts
+# every change with (ref Makefile:33-38). Findings print as
+# `file:line checker message`.
+# Scan a scratch tree:    python -m tools.vet path/to/file.py
+# Explain a finding:      python -m tools.vet --why <file:line>
+# Dump effect summaries:  python -m tools.vet --dump-graph
 vet:
 	python -m tools.vet
 

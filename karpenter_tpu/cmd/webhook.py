@@ -302,7 +302,9 @@ def main(
             server.cert_manager = cert_manager
     else:
         server = http.server.ThreadingHTTPServer((address, port), WebhookHandler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    threading.Thread(
+        target=server.serve_forever, name="webhook-serve", daemon=True
+    ).start()
     klog.named("webhook").info("webhook serving %s on :%d", scheme, port)
     if block:
         try:

@@ -17,6 +17,7 @@ from karpenter_tpu.cloudprovider import CloudProvider, NodeSpec
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.errors import PDBViolationError
 from karpenter_tpu.utils import logging as klog
+from karpenter_tpu.utils.fence import bind_thread
 from karpenter_tpu.utils.metrics import REGISTRY
 from karpenter_tpu.utils.workqueue import BackoffQueue
 
@@ -107,6 +108,10 @@ class EvictionQueue:
             self._thread = None
 
     def _pump(self, stop: threading.Event) -> None:
+        # The pump evicts through the store's fenced verbs: bind the fence
+        # so a deposed leader's pump aborts at the crashpoint gate between
+        # drains, not only at the next evict_pod's fence check.
+        bind_thread(self.cluster.fence)
         while not stop.wait(timeout=self.PUMP_INTERVAL_SECONDS):
             try:
                 self.drain_once()

@@ -428,7 +428,9 @@ class LeaderElector:
                 return False
             if self._stop.wait(timeout=jittered_s(poll_s, rng=self._rng)):
                 return False
-        self._thread = threading.Thread(target=self._renew_loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._renew_loop, name="leader-renew", daemon=True
+        )
         self._thread.start()
         return True
 
@@ -857,8 +859,12 @@ class Manager:
         # Standalone eviction pump (ref: termination/eviction.go:45-57): the
         # queue drains even when no termination reconcile is in flight.
         self.termination.evictions.start()
-        threading.Thread(target=self._batch_loop, daemon=True).start()
-        threading.Thread(target=self._requeue_loop, daemon=True).start()
+        threading.Thread(
+            target=self._batch_loop, name="provision-batcher", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._requeue_loop, name="backoff-requeue", daemon=True
+        ).start()
         # Seed existing state.
         for provisioner in self.cluster.list_provisioners():
             self.loops["provisioning"].enqueue(provisioner.name)
@@ -1067,5 +1073,7 @@ def serve_http(
     # arrives over the pod IP in a real deployment.
     handler = type("Handler", (_HTTPHandler,), {"manager": manager})
     server = http.server.ThreadingHTTPServer((address, port), handler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    threading.Thread(
+        target=server.serve_forever, name="http-serve", daemon=True
+    ).start()
     return server

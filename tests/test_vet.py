@@ -341,6 +341,164 @@ CASES = [
         """,
     ),
     (
+        # TRANSITIVE blocking (the call-graph upgrade, ISSUE 19 tentpole):
+        # the blocking call is two resolved hops away from the lock — a
+        # syntactic scan of the with-body cannot see it.
+        "blocking-under-lock",
+        """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _backoff(self):
+                time.sleep(0.5)
+
+            def _retry(self):
+                self._backoff()
+
+            def poll(self):
+                with self._lock:
+                    self._retry()
+        """,
+        """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def _backoff(self):
+                time.sleep(0.5)
+
+            def _bump(self):
+                self._count += 1
+
+            def poll(self):
+                with self._lock:
+                    self._bump()
+                self._backoff()
+        """,
+    ),
+    (
+        # Two code paths taking the same two locks in opposite orders: a
+        # textbook interleaving deadlock, invisible to any single-function
+        # scan (ISSUE 19: the lock-order checker).
+        "lock-order",
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def ab(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def ba(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """,
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def ab(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def ab_multi(self):
+                with self._a_lock, self._b_lock:
+                    pass
+
+            def reenter(self):
+                '''RLock re-acquisition through a helper is reentrant,
+                not a self-deadlock.'''
+                with self._rlock:
+                    self._again()
+
+            def _again(self):
+                with self._rlock:
+                    pass
+        """,
+    ),
+    (
+        # A thread whose reachable closure hits a fenced mutation without
+        # binding the WriteFence (ISSUE 19: the fence-discipline checker).
+        "fence-discipline",
+        """
+        import threading
+
+        class Sweeper:
+            def __init__(self, cluster):
+                self.cluster = cluster
+
+            def start(self):
+                threading.Thread(target=self._run, name="sweep", daemon=True).start()
+
+            def _run(self):
+                self._apply()
+
+            def _apply(self):
+                self.cluster.fence.check("sweep.write")
+        """,
+        """
+        import threading
+
+        from karpenter_tpu.utils.fence import bind_thread
+
+        class Sweeper:
+            def __init__(self, cluster):
+                self.cluster = cluster
+
+            def start(self):
+                threading.Thread(target=self._run, name="sweep", daemon=True).start()
+
+            def observe(self):
+                '''A mutation on a non-thread path needs no thread binding.'''
+                self.cluster.fence.check("observe.write")
+
+            def _run(self):
+                bind_thread(self.cluster.fence)
+                self._apply()
+
+            def _apply(self):
+                self.cluster.fence.check("sweep.write")
+        """,
+    ),
+    (
+        # Anonymous / implicitly-daemonized threads are attribution dead
+        # ends for the leak oracle and the flight recorder (ISSUE 19: the
+        # thread-discipline checker).
+        "thread-discipline",
+        """
+        import threading
+
+        def start(worker):
+            threading.Thread(target=worker).start()
+        """,
+        """
+        import threading
+
+        def start(worker):
+            threading.Thread(target=worker, name="worker", daemon=True).start()
+        """,
+    ),
+    (
         # Blocking collective completion under a lock WITHOUT the documented
         # spmd allowance must trip; ordinary lock-protected bookkeeping
         # around the (unlocked) blocking call must not.
@@ -568,6 +726,343 @@ def test_crash_safety_distinct_sites_key_separately(tmp_path):
     assert sorted(keys) == ["f:broad-except#0", "f:broad-except#1"]
 
 
+# --- call-graph resolution + derivation (ISSUE 19 tentpole) ------------------
+
+
+def _graph(tmp_path, source):
+    from tools.vet import callgraph
+
+    path = tmp_path / "scratch.py"
+    path.write_text(textwrap.dedent(source))
+    modules = load_modules([path])
+    return callgraph.build_graph(modules), modules[0].rel
+
+
+def _site(graph, fid, spelling):
+    return next(s for s in graph.calls[fid] if s.spelling == spelling)
+
+
+def test_callgraph_resolves_self_method(tmp_path):
+    graph, rel = _graph(
+        tmp_path,
+        """
+        class Worker:
+            def _inner(self):
+                return 1
+
+            def outer(self):
+                return self._inner()
+        """,
+    )
+    site = _site(graph, f"{rel}::Worker.outer", "self._inner")
+    assert site.targets == (f"{rel}::Worker._inner",)
+    assert not site.conservative
+
+
+def test_callgraph_resolves_attr_type_from_init(tmp_path):
+    """`self.helper = Helper()` in __init__ types the receiver of
+    `self.helper.work()`."""
+    graph, rel = _graph(
+        tmp_path,
+        """
+        class Helper:
+            def work(self):
+                return 1
+
+        class Owner:
+            def __init__(self):
+                self.helper = Helper()
+
+            def go(self):
+                return self.helper.work()
+        """,
+    )
+    site = _site(graph, f"{rel}::Owner.go", "self.helper.work")
+    assert site.targets == (f"{rel}::Helper.work",)
+    assert not site.conservative
+
+
+def test_callgraph_resolves_cross_module():
+    """A from-import call resolves to the defining module's function —
+    asserted on the production tree (scratch trees have no importable
+    second module)."""
+    from tools.vet import callgraph
+    from tools.vet.framework import production_modules
+
+    graph = callgraph.graph_for(production_modules())
+    pump = "karpenter_tpu/controllers/termination.py::EvictionQueue._pump"
+    site = _site(graph, pump, "bind_thread")
+    assert site.targets == ("karpenter_tpu/utils/fence.py::bind_thread",)
+    assert not site.conservative
+
+
+def test_callgraph_known_module_miss_is_not_conservative():
+    """A call through a RECOGNIZED module alias that does not resolve stays
+    unresolved — `json.dumps` must never union onto a production `dumps`."""
+    from tools.vet import callgraph
+    from tools.vet.framework import production_modules
+
+    graph = callgraph.graph_for(production_modules())
+    fid = "karpenter_tpu/cmd/webhook.py::admission_response"
+    site = _site(graph, fid, "json.dumps")
+    assert site.targets == ()
+    assert not site.conservative
+
+
+def test_callgraph_unresolved_receiver_uses_conservative_union(tmp_path):
+    """An untyped receiver's method call unions every same-named class
+    method (the callback-registry shape), flagged conservative."""
+    graph, rel = _graph(
+        tmp_path,
+        """
+        class A:
+            def reconcile(self):
+                return 1
+
+        class B:
+            def reconcile(self):
+                return 2
+
+        def run(item):
+            return item.reconcile()
+        """,
+    )
+    site = _site(graph, f"{rel}::run", "item.reconcile")
+    assert set(site.targets) == {f"{rel}::A.reconcile", f"{rel}::B.reconcile"}
+    assert site.conservative
+
+
+def test_callgraph_chain_renders_to_base_fact(tmp_path):
+    """The witness chain walks hop by hop to the base fact with its
+    file:line — the derivation every transitive finding renders."""
+    graph, rel = _graph(
+        tmp_path,
+        """
+        import time
+
+        class Pipeline:
+            def _io(self):
+                time.sleep(1)
+
+            def _mid(self):
+                self._io()
+
+            def top(self):
+                self._mid()
+        """,
+    )
+    chain = graph.chain(f"{rel}::Pipeline.top", "blocks")
+    assert chain[:2] == ["_mid", "_io"]
+    assert chain[2].startswith("time.sleep @ ")
+
+
+def test_transitive_blocking_finding_renders_chain(tmp_path):
+    source = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _backoff(self):
+            time.sleep(0.5)
+
+        def poll(self):
+            with self._lock:
+                self._backoff()
+    """
+    findings = _run_checker("blocking-under-lock", tmp_path, source)
+    assert len(findings) == 1
+    assert "time.sleep @ " in findings[0].message  # the base fact, clickable
+
+
+def test_lock_order_cycle_renders_both_acquisition_paths(tmp_path):
+    source = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def ba(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+    findings = _run_checker("lock-order", tmp_path, source)
+    assert [f.key for f in findings] == ["cycle:Pair._a_lock <-> Pair._b_lock"]
+    message = findings[0].message
+    assert "holds Pair._a_lock and takes Pair._b_lock" in message
+    assert "holds Pair._b_lock and takes Pair._a_lock" in message
+
+
+def test_lock_order_indirect_edge_through_call(tmp_path):
+    """The ordering graph sees acquisitions INSIDE callees: holding A and
+    calling a function that takes B is an A->B edge."""
+    source = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def _take_b(self):
+            with self._b_lock:
+                pass
+
+        def ab(self):
+            with self._a_lock:
+                self._take_b()
+
+        def ba(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+    findings = _run_checker("lock-order", tmp_path, source)
+    assert [f.key for f in findings] == ["cycle:Pair._a_lock <-> Pair._b_lock"]
+    assert "_take_b" in findings[0].message  # the indirect path is named
+
+
+def test_lock_order_waiver_drops_edge(tmp_path):
+    source = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def ba(self):
+            with self._b_lock:
+                with self._a_lock:  # vet: lock-order(shutdown-only path, ab side quiesced)
+                    pass
+    """
+    assert not _run_checker("lock-order", tmp_path, source)
+
+
+def test_lock_order_plain_lock_self_reacquire_trips(tmp_path):
+    """Re-acquiring a plain threading.Lock through a helper deadlocks the
+    thread against itself; the same shape on an RLock is reentrant (the
+    near-miss fixture covers that side)."""
+    source = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self):
+            with self._lock:
+                self._inner()
+
+        def _inner(self):
+            with self._lock:
+                pass
+    """
+    findings = _run_checker("lock-order", tmp_path, source)
+    assert [f.key for f in findings] == ["self:W._lock"]
+
+
+def test_fence_discipline_waiver_on_thread_line(tmp_path):
+    source = """
+    import threading
+
+    class Sweeper:
+        def __init__(self, cluster):
+            self.cluster = cluster
+
+        def start(self):
+            threading.Thread(target=self._run, name="s", daemon=True).start()  # vet: fence-exempt(cache-only writes)
+
+        def _run(self):
+            self.cluster.fence.check("sweep.write")
+    """
+    assert not _run_checker("fence-discipline", tmp_path, source)
+
+
+def test_fence_discipline_finding_renders_path_to_mutation(tmp_path):
+    source = """
+    import threading
+
+    class Sweeper:
+        def __init__(self, cluster):
+            self.cluster = cluster
+
+        def start(self):
+            threading.Thread(target=self._run, name="s", daemon=True).start()
+
+        def _run(self):
+            self._apply()
+
+        def _apply(self):
+            self.cluster.fence.check("sweep.write")
+    """
+    findings = _run_checker("fence-discipline", tmp_path, source)
+    assert len(findings) == 1
+    assert findings[0].key == "Sweeper.start:self._run"
+    assert "Sweeper._run -> _apply -> self.cluster.fence.check @ " in findings[0].message
+    assert "bind_thread" in findings[0].message
+
+
+def test_graph_cached_once_per_process_and_inside_wall_budget():
+    """graph_for is identity-cached on the production module list (one
+    object per process), so the fixpoint runs once however many checkers
+    ask — and a full vet pass over the cached modules stays inside the
+    tier-1 wall budget."""
+    import time
+
+    from tools.vet import callgraph
+    from tools.vet.framework import production_modules
+
+    modules = production_modules()
+    first = callgraph.graph_for(modules)
+    began = time.perf_counter()
+    again = callgraph.graph_for(modules)
+    assert again is first
+    assert time.perf_counter() - began < 0.05  # cache hit, no rebuild
+    began = time.perf_counter()
+    run_vet()
+    elapsed = time.perf_counter() - began
+    assert elapsed < 15.0, f"vet run took {elapsed:.1f}s — budget regressed"
+
+
+def test_cli_why_prints_derivation(capsys):
+    from tools.vet.framework import main as vet_main
+
+    assert vet_main(["--why", "karpenter_tpu/controllers/termination.py:89"]) == 0
+    out = capsys.readouterr().out
+    assert "EvictionQueue.drain_once" in out
+    assert "mutates:" in out and "_fence_check" in out
+
+
+def test_cli_dump_graph_is_json(capsys):
+    import json
+
+    from tools.vet.framework import main as vet_main
+
+    assert vet_main(["--dump-graph"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert set(payload) == {"functions", "lock_edges", "entries"}
+    pump = payload["functions"][
+        "karpenter_tpu/controllers/termination.py::EvictionQueue._pump"
+    ]
+    assert pump["binds_fence"] is True
+
+
 # --- framework mechanics -----------------------------------------------------
 
 
@@ -626,7 +1121,7 @@ def test_production_tree_is_vet_clean():
 
 def test_checker_names_unique():
     names = [checker.name for checker in ALL_CHECKERS]
-    assert len(names) == len(set(names)) == 10
+    assert len(names) == len(set(names)) == 13
 
 
 def test_constraints_subsystem_in_vet_scope():

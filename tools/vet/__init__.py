@@ -1,18 +1,30 @@
 """tools/vet — the unified AST vet suite (the Python analogue of
 ``go vet`` + ``-race`` that gates the reference's battletest).
 
-Seven checkers over a shared AST walk, run by ``make vet`` /
-``python -m tools.vet`` and by tier-1 via tests/test_vet.py:
+Thirteen checkers over a shared AST walk — and, for the transitive
+three, a shared whole-program call graph with effect summaries
+(tools/vet/callgraph.py) — run by ``make vet`` / ``python -m tools.vet``
+and by tier-1 via tests/test_vet.py:
 
 - ``lock-discipline``       annotated attrs only touched under their lock
-- ``blocking-under-lock``   no sleep/subprocess/socket/JAX dispatch in a lock
+- ``blocking-under-lock``   no sleep/subprocess/socket/JAX dispatch under a
+                            lock, through ANY call chain (rendered in full)
+- ``lock-order``            no cycles in the derived lock-ordering graph
+- ``fence-discipline``      every thread reaching a fenced mutation binds
+                            the WriteFence
+- ``thread-discipline``     every threading.Thread passes name= and daemon=
 - ``crash-safety``          SimulatedCrash can never be swallowed
 - ``clock-discipline``      raw time.{time,sleep,monotonic} only in utils/clock
 - ``metrics-consistency``   metric names declared once, label arity consistent
 - ``jax-platforms-ownership``   JAX_PLATFORMS spelled only in backend_health
 - ``import-time-device-touch``  no jax.devices() at module import
 
-Catalog, annotation syntax, and baseline format: docs/design/vet.md.
+CLI extras: ``python -m tools.vet --why <file:line>`` prints the full
+derivation (call chain + effect source) behind any finding;
+``--dump-graph`` emits the effect-summary table as JSON.
+
+Catalog, annotation syntax, call-graph model, and baseline format:
+docs/design/vet.md.
 """
 
 from tools.vet.framework import (  # noqa: F401 — the public surface

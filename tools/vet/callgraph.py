@@ -1,0 +1,1189 @@
+"""Whole-program call graph with effect summaries — the interprocedural
+backbone the transitive checkers (blocking-under-lock, lock-order,
+fence-discipline) share.
+
+The graph is built over the same ``Module`` list the intraprocedural
+checkers walk, in four passes:
+
+1. **Index**: every ``def`` (methods, module functions, nested closures)
+   becomes a ``FuncInfo`` keyed ``<file>::<qualname>``; classes record
+   their bases, their ``__init__``-constructed lock attributes (with the
+   ``threading.Lock`` / ``RLock`` / ``Condition`` kind, and
+   ``Condition(self._x)`` aliasing back to the wrapped lock), and the
+   inferred types of ``self.<attr>`` fields.
+2. **Resolve**: each call site resolves to candidate ``FuncInfo``s:
+   ``self.``/``cls.`` methods (through the base-class chain AND subclass
+   overrides — the static receiver type is routinely a base class),
+   ``super().m()``, ``self.<attr>.m()`` via the attr-type table,
+   imported ``module.func`` / ``from m import f``, parameter-annotation
+   receivers (``def f(cluster: Cluster)``), constructor calls, and —
+   for attribute calls whose receiver stays opaque — a *conservative*
+   union of every production method with that name, except names on
+   ``CONSERVATIVE_SKIP`` (``get``/``items``/``wait``/... collide with
+   builtin container/stdlib methods and would drag the whole tree in).
+3. **Effects to fixpoint**: three summaries propagate caller-ward over
+   the resolved edges until nothing changes —
+   ``blocks``    sleep / subprocess / socket / HTTP / JAX *dispatch*
+                 (block_until_ready, device_get, device_put — not the
+                 blunt ``jax.*`` prefix) / ``_notify`` fan-out;
+   ``acquires``  canonicalized lock identities entered via ``with``;
+   ``mutates``   fenced write verbs (a call through ``*.fence.check`` /
+                 ``self._fence_check``) and cloud create/terminate.
+   Every effect carries a **witness** — the base fact or the callee
+   edge that introduced it — so a finding renders the full chain
+   (``sweep → _flush → block_until_ready``), never a bare verdict.
+4. **Entries**: every production ``threading.Thread(target=...)`` site
+   is a thread entry point (lambda targets analyzed in place); the
+   fence-discipline checker runs reachability from these.
+
+Soundness limits (also documented in docs/design/vet.md): calls through
+values the resolver cannot type (stored callbacks, locals, ``getattr``)
+either fall back to the conservative by-name union or — for skipped
+names and unknown receivers — resolve to nothing, so an effect hidden
+behind such a call is invisible; module top-level code is not modeled
+(import time is single-threaded); lock identity for an unresolvable
+receiver (``peer._lock``) is excluded from the ordering graph.
+
+The production graph is cached alongside ``production_modules()`` —
+the fixpoint runs once per process however many checkers and tier-1
+shims ask for it (see ``graph_for``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.vet.framework import Module, dotted_name
+
+# Method names never resolved conservatively (receiver-typed resolution
+# still applies): each collides with a builtin container / stdlib method,
+# so an opaque `x.get(...)` is far likelier dict access than KubeClient.get.
+CONSERVATIVE_SKIP = frozenset(
+    {
+        "get", "set", "add", "put", "pop", "update", "items", "keys",
+        "values", "append", "extend", "insert", "remove", "discard",
+        "clear", "copy", "sort", "reverse", "index", "count", "join",
+        "split", "rsplit", "strip", "lstrip", "rstrip", "startswith",
+        "endswith", "format", "encode", "decode", "lower", "upper",
+        "replace", "read", "readline", "write", "flush", "close", "open",
+        "seek", "send", "sendall", "recv", "connect", "bind", "listen",
+        "accept", "wait", "notify", "notify_all", "acquire", "release",
+        "locked", "start", "stop", "cancel", "done", "result",
+        "exception", "match", "search", "group", "groups", "sub",
+        "setdefault", "popitem", "union", "difference", "intersection",
+        "is_set", "is_alive", "item", "items_view", "tolist", "astype",
+        "sum", "min", "max", "mean", "any", "all", "check",
+    }
+)
+
+# Base blocking facts, recognized at the call site (resolution-free):
+# the spelling itself names something that blocks.
+BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.", "urllib.request.")
+# JAX *dispatch* effects — the calls that synchronize with the device.
+# Plain `jax.*` / `jnp.*` utility calls (tree_map, shape math) are NOT
+# blocking; the old prefix match over-approximated exactly there.
+BLOCKING_ATTRS = {
+    "sleep", "urlopen", "check_output", "check_call",
+    "block_until_ready", "device_get", "device_put", "copy_to_host_async",
+}
+BLOCKING_NAMES = {"sleep", "urlopen"}
+# Watch-callback fan-out: Cluster._notify dispatches arbitrary consumer
+# callbacks, each taking its own locks — a dispatch effect for the
+# blocking-under-lock checker (see checkers/locks.py).
+DISPATCH_ATTRS = {"_notify"}
+
+LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+REENTRANT_KINDS = {"RLock", "Condition"}  # default Condition wraps an RLock
+
+LOCK_TERMINAL_RE = re.compile(r"(^|_)(lock|cv|cond|mutex)$", re.IGNORECASE)
+
+
+# --- data model --------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LockId:
+    """Canonical lock identity: the class (or module) that CONSTRUCTS the
+    lock plus the attribute name — `with self._lock:` in ApiServerCluster
+    and in Cluster are the SAME lock (Cluster.__init__ builds it).
+    ``owner_file`` disambiguates same-named classes across modules (two
+    RateLimiters exist). ``kind`` is the threading constructor name, or
+    None when the definition site was not found."""
+
+    owner_file: str
+    owner: str  # class name, or "<module>" for module-level locks
+    attr: str
+    kind: Optional[str] = field(compare=False, default=None)
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind in REENTRANT_KINDS
+
+    @property
+    def display(self) -> str:
+        if self.owner == "<module>":
+            stem = self.owner_file.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+            return f"{stem}:{self.attr}"
+        return f"{self.owner}.{self.attr}"
+
+
+@dataclass
+class Witness:
+    """Why an effect holds on a function: a base fact in its own body
+    (``kind='base'``) or inheritance from a resolved callee
+    (``kind='call'``, ``callee`` = the FuncInfo id)."""
+
+    kind: str  # 'base' | 'call'
+    line: int
+    detail: str  # base-fact spelling, or the callee spelling at the site
+    callee: Optional[str] = None
+
+
+@dataclass
+class CallSite:
+    line: int
+    spelling: str  # source spelling of the callee ('self._flush', 'mod.f')
+    targets: Tuple[str, ...]  # resolved FuncInfo ids (possibly empty)
+    held: FrozenSet[LockId]  # canonical locks lexically held at the site
+    held_raw: Tuple[str, ...]  # raw dotted spellings of held locks
+    base_block: Optional[str] = None  # blocking base fact at this site
+    conservative: bool = False  # resolved only by the by-name union
+
+
+@dataclass
+class FuncInfo:
+    module: Module
+    qual: str  # 'Class.method' / 'func' / 'Class.method.closure'
+    cls: Optional[str]  # class whose `self` is in scope (closures inherit)
+    node: ast.AST
+
+    @property
+    def fid(self) -> str:
+        return f"{self.module.rel}::{self.qual}"
+
+    @property
+    def display(self) -> str:
+        return self.qual.rsplit(".", 1)[-1] if "." in self.qual else self.qual
+
+
+@dataclass
+class ThreadEntry:
+    """One production ``threading.Thread(...)`` construction."""
+
+    module: Module
+    line: int
+    creator: Optional[str]  # fid of the constructing function
+    target_spelling: str
+    targets: Tuple[str, ...]  # resolved entry FuncInfo ids
+    has_name: bool
+    has_daemon: bool
+    def_line: Optional[int] = None  # def line of the resolved target, if any
+
+
+@dataclass
+class Effects:
+    blocks: Optional[Witness] = None
+    mutates: Optional[Witness] = None
+    acquires: Dict[LockId, Witness] = field(default_factory=dict)
+    binds_fence: bool = False  # body calls utils.fence.bind_thread
+
+
+@dataclass
+class LockEdge:
+    """Ordering edge: ``outer`` is held while ``inner`` is (transitively)
+    acquired. ``via`` names the call chain head for indirect edges."""
+
+    outer: LockId
+    inner: LockId
+    module: Module
+    line: int
+    func: str  # fid where the edge is introduced
+    via: Optional[str] = None  # callee fid whose summary supplies `inner`
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.methods: Dict[Tuple[str, str], List[str]] = {}  # (cls, name) -> fids
+        self.methods_by_name: Dict[str, List[str]] = {}
+        self.method_fids: Set[str] = set()  # every fid that is a class method
+        self.bases: Dict[str, List[str]] = {}
+        self.subclasses: Dict[str, Set[str]] = {}
+        self.class_files: Dict[str, List[str]] = {}  # cls name -> defining files
+        self.attr_types: Dict[Tuple[str, str], Set[str]] = {}
+        self.lock_defs: Dict[Tuple[str, str], str] = {}  # (cls, attr) -> kind
+        self.lock_aliases: Dict[Tuple[str, str], str] = {}  # Condition(self.x)
+        self.lock_files: Dict[Tuple[str, str], str] = {}  # (cls, attr) -> file
+        self.module_locks: Dict[Tuple[str, str], str] = {}  # (file, name) -> kind
+        self.calls: Dict[str, List[CallSite]] = {}
+        self.effects: Dict[str, Effects] = {}
+        self.entries: List[ThreadEntry] = []
+        self.lock_edges: List[LockEdge] = []
+        self.class_names: Set[str] = set()
+
+    # -- hierarchy helpers --
+
+    def mro_chain(self, cls: str) -> List[str]:
+        """cls plus transitive bases, breadth-first, names only."""
+        out, seen, queue = [], set(), [cls]
+        while queue:
+            cur = queue.pop(0)
+            if cur in seen:
+                continue
+            seen.add(cur)
+            out.append(cur)
+            queue.extend(self.bases.get(cur, ()))
+        return out
+
+    def transitive_subclasses(self, cls: str) -> Set[str]:
+        out: Set[str] = set()
+        queue = list(self.subclasses.get(cls, ()))
+        while queue:
+            cur = queue.pop()
+            if cur in out:
+                continue
+            out.add(cur)
+            queue.extend(self.subclasses.get(cur, ()))
+        return out
+
+    def resolve_method(self, cls: str, name: str, include_subs: bool = True) -> List[str]:
+        """Nearest definition up the base chain, PLUS subclass overrides
+        (virtual dispatch: the static type is often a base class)."""
+        found: List[str] = []
+        seen: Set[str] = set()
+        queue = [cls]
+        while queue:
+            cur = queue.pop(0)
+            if cur in seen:
+                continue
+            seen.add(cur)
+            fids = self.methods.get((cur, name))
+            if fids:
+                found.extend(fids)
+            else:
+                queue.extend(self.bases.get(cur, ()))
+        if include_subs:
+            for sub in self.transitive_subclasses(cls):
+                found.extend(self.methods.get((sub, name), ()))
+        return sorted(set(found))
+
+    def attr_classes(self, cls: Optional[str], attr: str) -> Set[str]:
+        """Inferred classes of ``self.<attr>`` looking up the base chain."""
+        if cls is None:
+            return set()
+        out: Set[str] = set()
+        for c in self.mro_chain(cls):
+            out |= self.attr_types.get((c, attr), set())
+        return out
+
+    def canonical_lock(self, raw: str, cls: Optional[str], file: str) -> Optional[LockId]:
+        """Map a dotted `with` spelling to its canonical identity, or None
+        when the receiver cannot be typed (excluded from ordering)."""
+        parts = raw.split(".")
+        if len(parts) == 1:
+            kind = self.module_locks.get((file, raw))
+            if kind is not None:
+                return LockId(file, "<module>", raw, kind)
+            return None
+        if parts[0] in ("self", "cls") and cls is not None:
+            attr = parts[-1]
+            receivers = [cls] if len(parts) == 2 else sorted(
+                self.attr_classes(cls, parts[1])
+            ) if len(parts) == 3 else []
+            for receiver in receivers:
+                for c in self.mro_chain(receiver):
+                    attr2 = self.lock_aliases.get((c, attr), attr)
+                    if (c, attr2) in self.lock_defs:
+                        return LockId(
+                            self.lock_files[(c, attr2)], c, attr2,
+                            self.lock_defs[(c, attr2)],
+                        )
+            if len(parts) == 2:
+                # Lock-shaped self attribute without a found constructor:
+                # keep the identity anchored to the using class.
+                return LockId(file, cls, attr, None)
+        return None
+
+    # -- witness chains --
+
+    def chain(self, fid: str, effect: str, lock: Optional[LockId] = None) -> List[str]:
+        """Render the derivation of an effect as display hops ending at
+        the base fact: ['_flush', 'block_until_ready @ models/x.py:12']."""
+        hops: List[str] = []
+        seen: Set[str] = set()
+        cur: Optional[str] = fid
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            eff = self.effects.get(cur)
+            if eff is None:
+                break
+            wit = (
+                eff.acquires.get(lock) if effect == "acquires"
+                else getattr(eff, effect, None)
+            )
+            if wit is None:
+                break
+            info = self.funcs[cur]
+            if wit.kind == "base":
+                hops.append(f"{wit.detail} @ {info.module.rel}:{wit.line}")
+                return hops
+            hops.append(self.funcs[wit.callee].display if wit.callee in self.funcs else wit.detail)
+            cur = wit.callee
+        return hops
+
+
+# --- pass 1: index -----------------------------------------------------------
+
+
+def _unwrap_annotation(node: Optional[ast.AST]) -> List[str]:
+    """Class names named by an annotation: Name, dotted Attribute (final
+    segment), 'ForwardRef' strings, Optional[...] / Union[...] members."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value.split("[")[0].split(".")[-1].strip()]
+    if isinstance(node, ast.Subscript):
+        inner = node.slice
+        if isinstance(inner, ast.Tuple):
+            out: List[str] = []
+            for elt in inner.elts:
+                out.extend(_unwrap_annotation(elt))
+            return out
+        return _unwrap_annotation(inner)
+    if isinstance(node, ast.BinOp):  # X | None
+        return _unwrap_annotation(node.left) + _unwrap_annotation(node.right)
+    return []
+
+
+def _module_dotted(rel: str) -> str:
+    """'karpenter_tpu/utils/fence.py' -> 'karpenter_tpu.utils.fence'."""
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def _imports(module: Module) -> Dict[str, Tuple[str, Optional[str]]]:
+    """local name -> (dotted module, symbol-or-None), any scope."""
+    out: Dict[str, Tuple[str, Optional[str]]] = {}
+    pkg = _module_dotted(module.rel).rsplit(".", 1)[0]
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0],
+                    None,
+                )
+                if alias.asname is None:
+                    # `import a.b.c` binds `a`, but the usable spelling is
+                    # the full dotted path — record it for prefix matching.
+                    out[alias.name] = (alias.name, None)
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = pkg.split(".")
+                base_parts = parts[: len(parts) - (node.level - 1)]
+                base = ".".join(base_parts + ([node.module] if node.module else []))
+            for alias in node.names:
+                out[alias.asname or alias.name] = (base, alias.name)
+    return out
+
+
+def _index_module(module: Module, graph: CallGraph) -> None:
+    def visit(node: ast.AST, cls: Optional[str], qual: str, class_body: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                cq = f"{qual}.{child.name}" if qual else child.name
+                graph.class_names.add(child.name)
+                graph.class_files.setdefault(child.name, []).append(module.rel)
+                bases = graph.bases.setdefault(child.name, [])
+                for base in child.bases:
+                    bname = (
+                        base.attr if isinstance(base, ast.Attribute)
+                        else getattr(base, "id", None)
+                    )
+                    if bname:
+                        bases.append(bname)
+                        graph.subclasses.setdefault(bname, set()).add(child.name)
+                visit(child, child.name, cq, True)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fq = f"{qual}.{child.name}" if qual else child.name
+                info = FuncInfo(module, fq, cls, child)
+                graph.funcs[info.fid] = info
+                if class_body and cls is not None:
+                    graph.methods.setdefault((cls, child.name), []).append(info.fid)
+                    graph.method_fids.add(info.fid)
+                graph.methods_by_name.setdefault(child.name, []).append(info.fid)
+                visit(child, cls, fq, False)
+            else:
+                visit(child, cls, qual, False if not isinstance(child, ast.ClassDef) else class_body)
+
+    visit(module.tree, None, "", False)
+
+    # Module-level locks: `_lock = threading.Lock()` at top level.
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            ctor = dotted_name(stmt.value.func) or ""
+            kind = LOCK_CTORS.get(ctor.rsplit(".", 1)[-1])
+            if kind and ctor.startswith("threading."):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        graph.module_locks[(module.rel, target.id)] = kind
+
+
+def _lock_def_from_ctor(
+    graph: CallGraph, module: Module, cls: str, attr: str, value: ast.Call
+) -> bool:
+    """Record `self.<attr> = threading.X(...)`; True when it was one."""
+    ctor = dotted_name(value.func) or ""
+    tail = ctor.rsplit(".", 1)[-1]
+    if tail not in LOCK_CTORS or not (ctor.startswith("threading.") or ctor == tail):
+        return False
+    kind = LOCK_CTORS[tail]
+    graph.lock_defs[(cls, attr)] = kind
+    graph.lock_files[(cls, attr)] = module.rel
+    # Condition(self._x) ALIASES the wrapped lock: both spellings are one
+    # runtime lock.
+    if (
+        kind == "Condition"
+        and value.args
+        and isinstance(value.args[0], ast.Attribute)
+        and isinstance(value.args[0].value, ast.Name)
+        and value.args[0].value.id == "self"
+    ):
+        graph.lock_aliases[(cls, attr)] = value.args[0].attr
+    return True
+
+
+def _infer_attr_classes(
+    graph: CallGraph,
+    params: Dict[str, List[str]],
+    value: Optional[ast.AST],
+    ann: Optional[ast.AST],
+) -> Set[str]:
+    """Class names an attribute assignment could carry: constructor call,
+    annotated-parameter pass-through, or the AnnAssign annotation."""
+    inferred: List[str] = []
+    if isinstance(value, ast.Call):
+        ctor = dotted_name(value.func) or ""
+        tail = ctor.rsplit(".", 1)[-1]
+        if tail in graph.class_names or tail[:1].isupper():
+            inferred.append(tail)
+    elif isinstance(value, ast.Name) and value.id in params:
+        inferred.extend(params[value.id])
+    inferred.extend(_unwrap_annotation(ann))
+    return {n for n in inferred if n in graph.class_names}
+
+
+def _record_attr_assign(
+    graph: CallGraph,
+    module: Module,
+    cls: str,
+    params: Dict[str, List[str]],
+    target: ast.AST,
+    value: Optional[ast.AST],
+    ann: Optional[ast.AST],
+) -> None:
+    if not (
+        isinstance(target, ast.Attribute)
+        and isinstance(target.value, ast.Name)
+        and target.value.id in ("self", "cls")
+    ):
+        return
+    attr = target.attr
+    if isinstance(value, ast.Call) and _lock_def_from_ctor(
+        graph, module, cls, attr, value
+    ):
+        return
+    known = _infer_attr_classes(graph, params, value, ann)
+    if known:
+        graph.attr_types.setdefault((cls, attr), set()).update(known)
+
+
+def _index_class_attrs(module: Module, graph: CallGraph, imports) -> None:
+    """attr_types + lock_defs from method bodies (``__init__`` mostly)."""
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for method in node.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params: Dict[str, List[str]] = {}
+            for arg in method.args.args + method.args.kwonlyargs:
+                names = _unwrap_annotation(arg.annotation)
+                if names:
+                    params[arg.arg] = names
+            for sub in ast.walk(method):
+                if isinstance(sub, ast.Assign):
+                    targets, value, ann = sub.targets, sub.value, None
+                elif isinstance(sub, ast.AnnAssign):
+                    targets, value, ann = [sub.target], sub.value, sub.annotation
+                else:
+                    continue
+                for target in targets:
+                    _record_attr_assign(
+                        graph, module, node.name, params, target, value, ann
+                    )
+
+
+# --- pass 2: resolve calls + collect base facts ------------------------------
+
+
+def _module_func(
+    dotted_mod: str,
+    name: str,
+    module_by_dotted: Dict[str, str],
+    funcs_by_module_name: Dict[Tuple[str, str], str],
+) -> List[str]:
+    target_rel = module_by_dotted.get(dotted_mod)
+    if target_rel is None:
+        return []
+    fid = funcs_by_module_name.get((target_rel, name))
+    return [fid] if fid else []
+
+
+def _resolve_bare_name(
+    func_id: str,
+    info: FuncInfo,
+    graph: CallGraph,
+    imports: Dict[str, Tuple[str, Optional[str]]],
+    funcs_by_module_name: Dict[Tuple[str, str], str],
+    module_by_dotted: Dict[str, str],
+) -> Tuple[str, ...]:
+    """Targets for a plain-Name call: own nested closure, module function,
+    from-import, class constructor (-> __init__)."""
+    rel = info.module.rel
+    nested = f"{rel}::{info.qual}.{func_id}"
+    if nested in graph.funcs:
+        return (nested,)
+    fid = funcs_by_module_name.get((rel, func_id))
+    if fid:
+        return (fid,)
+    if func_id in imports:
+        mod, sym = imports[func_id]
+        if sym is None:
+            return ()
+        targets = _module_func(mod, sym, module_by_dotted, funcs_by_module_name)
+        if targets:
+            return tuple(targets)
+        if sym in graph.class_names:
+            return tuple(graph.resolve_method(sym, "__init__", include_subs=False))
+    if func_id in graph.class_names and rel in graph.class_files.get(func_id, ()):
+        return tuple(graph.resolve_method(func_id, "__init__", include_subs=False))
+    return ()
+
+
+def _resolve_name_receiver(
+    value_id: str,
+    name: str,
+    graph: CallGraph,
+    imports: Dict[str, Tuple[str, Optional[str]]],
+    funcs_by_module_name: Dict[Tuple[str, str], str],
+    module_by_dotted: Dict[str, str],
+    local_params: Dict[str, List[str]],
+) -> Optional[Tuple[str, ...]]:
+    """Targets for `<name>.m()`: module alias, imported class, annotated
+    parameter, locally defined class. None = fall to the conservative
+    union."""
+    if value_id in imports:
+        mod, sym = imports[value_id]
+        if sym is None:
+            # Known import of a module: resolution is module-scoped —
+            # a miss (stdlib call) must NOT fall to the conservative
+            # union (`json.dumps` is not a production `dumps` method).
+            return tuple(_module_func(mod, name, module_by_dotted, funcs_by_module_name))
+        if sym in graph.class_names:
+            targets = graph.resolve_method(sym, name, include_subs=False)
+            if targets:
+                return tuple(targets)
+    if value_id in local_params:
+        found: List[str] = []
+        for receiver in local_params[value_id]:
+            if receiver in graph.class_names:
+                found.extend(graph.resolve_method(receiver, name))
+        if found:
+            return tuple(sorted(set(found)))
+    if value_id in graph.class_names:
+        targets = graph.resolve_method(value_id, name, include_subs=False)
+        if targets:
+            return tuple(targets)
+    return None
+
+
+def _resolve_dotted_module(
+    func: ast.Attribute,
+    name: str,
+    imports: Dict[str, Tuple[str, Optional[str]]],
+    funcs_by_module_name: Dict[Tuple[str, str], str],
+    module_by_dotted: Dict[str, str],
+) -> Optional[Tuple[str, ...]]:
+    """Full dotted module spelling: `pkg.sub.mod.func(...)` via
+    `import pkg.sub.mod` — longest known module prefix wins; a
+    known-module miss stays unresolved (no conservative fallback)."""
+    dotted = dotted_name(func)
+    if not dotted or "." not in dotted:
+        return None
+    head = dotted.rsplit(".", 1)[0]
+    if head in module_by_dotted:
+        return tuple(_module_func(head, name, module_by_dotted, funcs_by_module_name))
+    first, _, tail = head.partition(".")
+    if first in imports and imports[first][1] is None:
+        candidate = imports[first][0] + (f".{tail}" if tail else "")
+        if candidate in module_by_dotted:
+            return tuple(_module_func(candidate, name, module_by_dotted, funcs_by_module_name))
+    return None
+
+
+def _is_super_call(value: ast.AST) -> bool:
+    return (
+        isinstance(value, ast.Call)
+        and isinstance(value.func, ast.Name)
+        and value.func.id == "super"
+    )
+
+
+def _super_targets(graph: CallGraph, cls: str, name: str) -> Tuple[str, ...]:
+    """super().m() — bases only, no subclass widening."""
+    found: List[str] = []
+    for base in graph.bases.get(cls, ()):
+        found.extend(graph.resolve_method(base, name, include_subs=False))
+    return tuple(sorted(set(found)))
+
+
+def _attr_type_targets(
+    graph: CallGraph, cls: str, attr: str, name: str
+) -> Optional[Tuple[str, ...]]:
+    """self.attr.m() via the attr-type table. None = untyped receiver."""
+    found: List[str] = []
+    for receiver in sorted(graph.attr_classes(cls, attr)):
+        found.extend(graph.resolve_method(receiver, name))
+    if found:
+        return tuple(sorted(set(found)))
+    return None
+
+
+def _resolve_receiver(
+    call: ast.Call,
+    info: FuncInfo,
+    graph: CallGraph,
+    imports: Dict[str, Tuple[str, Optional[str]]],
+    funcs_by_module_name: Dict[Tuple[str, str], str],
+    module_by_dotted: Dict[str, str],
+    local_params: Dict[str, List[str]],
+) -> Optional[Tuple[str, ...]]:
+    """Targets for `<receiver>.m()` by receiver shape. None = fall to the
+    conservative union."""
+    func = call.func
+    name = func.attr
+    value = func.value
+
+    if _is_super_call(value) and info.cls is not None:
+        return _super_targets(graph, info.cls, name)
+
+    # self.m() / cls.m()
+    if isinstance(value, ast.Name) and value.id in ("self", "cls") and info.cls:
+        targets = graph.resolve_method(info.cls, name)
+        if targets:
+            return tuple(targets)
+        return None  # callable attribute: conservative
+
+    # self.attr.m() via the attr-type table
+    if (
+        isinstance(value, ast.Attribute)
+        and isinstance(value.value, ast.Name)
+        and value.value.id in ("self", "cls")
+        and info.cls
+    ):
+        return _attr_type_targets(graph, info.cls, value.attr, name)
+
+    if isinstance(value, ast.Name):
+        return _resolve_name_receiver(
+            value.id, name, graph, imports,
+            funcs_by_module_name, module_by_dotted, local_params,
+        )
+    return _resolve_dotted_module(
+        func, name, imports, funcs_by_module_name, module_by_dotted
+    )
+
+
+def _resolve_call(
+    call: ast.Call,
+    info: FuncInfo,
+    graph: CallGraph,
+    imports: Dict[str, Tuple[str, Optional[str]]],
+    funcs_by_module_name: Dict[Tuple[str, str], str],
+    module_by_dotted: Dict[str, str],
+    local_params: Dict[str, List[str]],
+) -> Tuple[str, Tuple[str, ...], bool]:
+    """(spelling, resolved fids, conservative?) for one call site."""
+    func = call.func
+    spelling = dotted_name(func) or (
+        f"<expr>.{func.attr}" if isinstance(func, ast.Attribute) else "<expr>"
+    )
+    if isinstance(func, ast.Name):
+        targets = _resolve_bare_name(
+            func.id, info, graph, imports, funcs_by_module_name, module_by_dotted
+        )
+        return spelling, targets, False
+    if not isinstance(func, ast.Attribute):
+        return spelling, (), False
+    resolved = _resolve_receiver(
+        call, info, graph, imports,
+        funcs_by_module_name, module_by_dotted, local_params,
+    )
+    if resolved is not None:
+        return spelling, resolved, False
+
+    # Conservative union by method name — sound for the repo's callback
+    # registries (`self.reconcile` resolves to every controller reconcile),
+    # suppressed for builtin-colliding names.
+    name = func.attr
+    if name in CONSERVATIVE_SKIP or name.startswith("__"):
+        return spelling, (), False
+    conservative = {
+        fid
+        for fid in graph.methods_by_name.get(name, ())
+        if fid in graph.method_fids  # methods only, not module funcs/closures
+    }
+    return spelling, tuple(sorted(conservative)), True
+
+
+def _base_block_fact(call: ast.Call) -> Optional[str]:
+    dotted = dotted_name(call.func)
+    if dotted:
+        for prefix in BLOCKING_PREFIXES:
+            if dotted.startswith(prefix):
+                return dotted
+        if dotted in BLOCKING_NAMES:
+            return dotted
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr in BLOCKING_ATTRS or attr in DISPATCH_ATTRS:
+            return dotted or f"<expr>.{attr}"
+    return None
+
+
+def _base_mutate_fact(call: ast.Call, rel: str) -> Optional[str]:
+    """Fenced write verbs: the repo's PR-13 invariant spells every store /
+    cloud mutation with a fence check first — the check IS the marker.
+    The fence implementation itself is excluded (its internal
+    `fence.check` calls are the mechanism, not a mutation)."""
+    if rel.endswith("utils/fence.py"):
+        return None
+    dotted = dotted_name(call.func)
+    if dotted is None:
+        return None
+    if dotted.endswith("fence.check") or dotted.split(".")[-1] == "_fence_check":
+        return dotted
+    parts = dotted.split(".")
+    if len(parts) >= 2 and parts[-2] == "cloud" and parts[-1] in (
+        "create", "delete", "terminate"
+    ):
+        return dotted
+    return None
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    dotted = dotted_name(call.func)
+    return dotted in ("threading.Thread", "Thread")
+
+
+class _BodyWalker:
+    """Walk one function body tracking lexically-held locks; collect call
+    sites, base facts, direct lock-nesting edges, and thread entries.
+    Nested ``def``s are separate functions (closure edges connect them);
+    lambdas are inlined EXCEPT as Thread targets (deferred execution)."""
+
+    def __init__(self, info, graph, imports, funcs_by_module_name, module_by_dotted):
+        self.info = info
+        self.graph = graph
+        self.imports = imports
+        self.fmn = funcs_by_module_name
+        self.mbd = module_by_dotted
+        self.sites: List[CallSite] = []
+        self.base_blocks: List[Tuple[int, str, FrozenSet[LockId], Tuple[str, ...]]] = []
+        self.base_mutates: List[Tuple[int, str]] = []
+        self.base_acquires: List[Tuple[int, str, LockId]] = []
+        self.binds_fence = False
+        self.nested_defs: List[Tuple[ast.AST, FrozenSet[LockId], Tuple[str, ...], int]] = []
+        self.thread_target_names: Set[str] = set()
+        node = info.node
+        self.local_params: Dict[str, List[str]] = {}
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in node.args.args + node.args.kwonlyargs:
+                names = [
+                    n for n in _unwrap_annotation(arg.annotation)
+                    if n in graph.class_names
+                ]
+                if names:
+                    self.local_params[arg.arg] = names
+
+    def run(self) -> None:
+        node = self.info.node
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            self._visit(stmt, (), ())
+
+    def _locks_in_with(self, node) -> List[Tuple[str, Optional[LockId]]]:
+        out = []
+        for item in node.items:
+            expr = item.context_expr
+            terminal = (
+                expr.attr if isinstance(expr, ast.Attribute)
+                else getattr(expr, "id", None)
+            )
+            if terminal and LOCK_TERMINAL_RE.search(terminal):
+                raw = dotted_name(expr)
+                if raw:
+                    out.append(
+                        (raw, self.graph.canonical_lock(raw, self.info.cls, self.info.module.rel))
+                    )
+        return out
+
+    def _visit(self, node: ast.AST, held: Tuple[LockId, ...], held_raw: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.nested_defs.append((node, frozenset(held), held_raw, node.lineno))
+            return  # separate FuncInfo; closure edge added by the builder
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = self._locks_in_with(node)
+            new_held = list(held)
+            new_raw = list(held_raw)
+            for raw, lock in acquired:
+                if lock is not None:
+                    self.base_acquires.append((node.lineno, raw, lock))
+                    for outer in new_held:
+                        # outer == lock is a self re-acquisition edge — the
+                        # lock-order checker flags it for non-reentrant kinds.
+                        self.graph.lock_edges.append(
+                            LockEdge(outer, lock, self.info.module, node.lineno, self.info.fid)
+                        )
+                    new_held.append(lock)
+                new_raw.append(raw)
+            for item in node.items:
+                self._visit(item, held, held_raw)
+            for stmt in node.body:
+                self._visit(stmt, tuple(new_held), tuple(new_raw))
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node, held, held_raw)
+            if _is_thread_ctor(node):
+                return  # args run on the NEW thread, not under `held`
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, held, held_raw)
+            return
+        if isinstance(node, ast.Lambda):
+            self._visit(node.body, held, held_raw)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, held_raw)
+
+    def _visit_call(self, call: ast.Call, held, held_raw) -> None:
+        info, graph = self.info, self.graph
+        if _is_thread_ctor(call):
+            self._record_thread(call)
+            return  # target runs on the NEW thread: never under `held`
+        dotted = dotted_name(call.func)
+        if dotted and dotted.split(".")[-1] == "bind_thread":
+            self.binds_fence = True
+        spelling, targets, conservative = _resolve_call(
+            call, info, graph, self.imports, self.fmn, self.mbd, self.local_params
+        )
+        block = _base_block_fact(call)
+        if block is not None:
+            self.base_blocks.append((call.lineno, block, frozenset(held), held_raw))
+        mutate = _base_mutate_fact(call, info.module.rel)
+        if mutate is not None:
+            self.base_mutates.append((call.lineno, mutate))
+        self.sites.append(
+            CallSite(
+                call.lineno, spelling, targets, frozenset(held), held_raw,
+                base_block=block, conservative=conservative,
+            )
+        )
+
+    def _analyze_lambda_target(self, call: ast.Call, target: ast.Lambda) -> str:
+        """Analyze a Thread lambda target in place as a synthetic function
+        — its calls ARE the entry's reachable closure."""
+        info, graph = self.info, self.graph
+        sub = _BodyWalker(
+            FuncInfo(info.module, info.qual + ".<lambda>", info.cls, target),
+            graph, self.imports, self.fmn, self.mbd,
+        )
+        sub._visit(target.body, (), ())
+        lam_fid = f"{info.module.rel}::{info.qual}.<lambda>@{call.lineno}"
+        graph.funcs[lam_fid] = FuncInfo(
+            info.module, f"{info.qual}.<lambda>@{call.lineno}", info.cls, target
+        )
+        graph.calls[lam_fid] = sub.sites
+        eff = Effects(binds_fence=sub.binds_fence)
+        for line, fact, _, _ in sub.base_blocks:
+            eff.blocks = eff.blocks or Witness("base", line, fact)
+        for line, fact in sub.base_mutates:
+            eff.mutates = eff.mutates or Witness("base", line, fact)
+        graph.effects[lam_fid] = eff
+        return lam_fid
+
+    def _resolve_thread_target(self, target: ast.AST) -> List[str]:
+        """Entry fids for a non-lambda Thread target: self.X methods
+        (subclass overrides included), nested closures, module functions."""
+        info, graph = self.info, self.graph
+        if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+                and target.value.id in ("self", "cls") and info.cls:
+            return graph.resolve_method(info.cls, target.attr)
+        if isinstance(target, ast.Name):
+            self.thread_target_names.add(target.id)
+            nested_fid = f"{info.module.rel}::{info.qual}.{target.id}"
+            if nested_fid in graph.funcs:
+                return [nested_fid]
+            fid = self.fmn.get((info.module.rel, target.id))
+            return [fid] if fid else []
+        return []
+
+    def _record_thread(self, call: ast.Call) -> None:
+        target = next((kw.value for kw in call.keywords if kw.arg == "target"), None)
+        has_name = any(kw.arg == "name" for kw in call.keywords)
+        has_daemon = any(kw.arg == "daemon" for kw in call.keywords)
+        info, graph = self.info, self.graph
+        spelling = "<none>"
+        fids: List[str] = []
+        def_line: Optional[int] = None
+        if isinstance(target, ast.Lambda):
+            spelling = "<lambda>"
+            fids = [self._analyze_lambda_target(call, target)]
+        elif target is not None:
+            spelling = dotted_name(target) or "<expr>"
+            fids = self._resolve_thread_target(target)
+            if fids:
+                first = graph.funcs.get(fids[0])
+                if first is not None and hasattr(first.node, "lineno"):
+                    def_line = first.node.lineno
+        graph.entries.append(
+            ThreadEntry(
+                info.module, call.lineno, info.fid, spelling,
+                tuple(fids), has_name, has_daemon, def_line,
+            )
+        )
+
+
+# --- builder -----------------------------------------------------------------
+
+
+def _collect_walkers(
+    modules: Sequence[Module],
+    graph: CallGraph,
+    module_by_dotted: Dict[str, str],
+    imports_by_module: Dict[str, Dict[str, str]],
+    funcs_by_module_name: Dict[Tuple[str, str], str],
+) -> Dict[str, "_BodyWalker"]:
+    """Walk every function body: per-function call sites + base effect facts."""
+    walkers: Dict[str, _BodyWalker] = {}
+    for fid in sorted(graph.funcs):
+        info = graph.funcs[fid]
+        if not isinstance(info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        walker = _BodyWalker(
+            info, graph, imports_by_module[info.module.rel],
+            funcs_by_module_name, module_by_dotted,
+        )
+        walker.run()
+        walkers[fid] = walker
+        graph.calls[fid] = walker.sites
+        eff = Effects(binds_fence=walker.binds_fence)
+        for line, fact, _, _ in walker.base_blocks:
+            if eff.blocks is None:
+                eff.blocks = Witness("base", line, fact)
+        for line, fact in walker.base_mutates:
+            if eff.mutates is None:
+                eff.mutates = Witness("base", line, fact)
+        graph.effects[fid] = eff
+    return walkers
+
+
+def _add_closure_edges(graph: CallGraph, walkers: Dict[str, "_BodyWalker"]) -> None:
+    """Closure edges: a nested def's effects belong to its parent (it runs
+    when the parent — or a callback the parent registered — invokes it),
+    EXCEPT nested defs only ever used as Thread targets: those run on
+    their own thread and are modeled as entries instead."""
+    for fid, walker in walkers.items():
+        info = graph.funcs[fid]
+        for node, held, held_raw, line in walker.nested_defs:
+            nested_fid = f"{info.module.rel}::{info.qual}.{node.name}"
+            if nested_fid not in graph.funcs:
+                continue
+            if node.name in walker.thread_target_names:
+                continue
+            graph.calls[fid].append(
+                CallSite(line, f"{node.name} (closure)", (nested_fid,), held, held_raw)
+            )
+
+
+def _add_acquire_facts(graph: CallGraph, walkers: Dict[str, "_BodyWalker"]) -> None:
+    """Acquire base facts: direct `with` acquisitions recorded per function
+    (the walker respects nested-def boundaries — a closure's acquisitions
+    reach the parent through its closure edge, not double-counted here)."""
+    for fid, walker in walkers.items():
+        eff = graph.effects[fid]
+        for line, raw, lock in walker.base_acquires:
+            if lock not in eff.acquires:
+                eff.acquires[lock] = Witness("base", line, raw)
+
+
+def _add_indirect_lock_edges(graph: CallGraph) -> None:
+    """Indirect lock edges: a call under lock H to a callee whose summary
+    acquires M != H. Recorded after the fixpoint so `acquires` is final."""
+    for fid, sites in graph.calls.items():
+        for site in sites:
+            if not site.held:
+                continue
+            for target in site.targets:
+                teff = graph.effects.get(target)
+                if teff is None:
+                    continue
+                for lock in teff.acquires:
+                    for outer in site.held:
+                        if outer == lock and site.conservative:
+                            # A by-name union easily invents "calls itself
+                            # under its own lock"; self-deadlock edges need
+                            # a resolved path to be actionable.
+                            continue
+                        graph.lock_edges.append(
+                            LockEdge(
+                                outer, lock, graph.funcs[fid].module,
+                                site.line, fid, via=target,
+                            )
+                        )
+
+
+def build_graph(modules: Sequence[Module]) -> CallGraph:
+    graph = CallGraph()
+    module_by_dotted: Dict[str, str] = {}
+    for module in modules:
+        module_by_dotted[_module_dotted(module.rel)] = module.rel
+        _index_module(module, graph)
+    imports_by_module = {m.rel: _imports(m) for m in modules}
+    for module in modules:
+        _index_class_attrs(module, graph, imports_by_module[module.rel])
+
+    funcs_by_module_name: Dict[Tuple[str, str], str] = {}
+    for fid, info in graph.funcs.items():
+        if "." not in info.qual:  # module-level function
+            funcs_by_module_name[(info.module.rel, info.qual)] = fid
+
+    walkers = _collect_walkers(
+        modules, graph, module_by_dotted, imports_by_module, funcs_by_module_name
+    )
+    _add_closure_edges(graph, walkers)
+    _add_acquire_facts(graph, walkers)
+    _fixpoint(graph)
+    _add_indirect_lock_edges(graph)
+    return graph
+
+
+def _propagate(eff: Effects, ceff: Effects, site: CallSite, fid: str) -> bool:
+    """Merge a callee's summary into one caller through one site; True if
+    the caller's summary grew (it must be re-queued)."""
+    changed = False
+    if eff.blocks is not None and ceff.blocks is None:
+        ceff.blocks = Witness("call", site.line, site.spelling, fid)
+        changed = True
+    if eff.mutates is not None and ceff.mutates is None:
+        ceff.mutates = Witness("call", site.line, site.spelling, fid)
+        changed = True
+    for lock in eff.acquires:
+        if lock not in ceff.acquires:
+            ceff.acquires[lock] = Witness("call", site.line, site.spelling, fid)
+            changed = True
+    return changed
+
+
+def _fixpoint(graph: CallGraph) -> None:
+    """Propagate blocks / mutates / acquires caller-ward to fixpoint."""
+    callers: Dict[str, List[Tuple[str, CallSite]]] = {}
+    for fid, sites in graph.calls.items():
+        for site in sites:
+            for target in site.targets:
+                callers.setdefault(target, []).append((fid, site))
+    work = list(graph.effects)
+    in_work = set(work)
+    while work:
+        fid = work.pop(0)
+        in_work.discard(fid)
+        eff = graph.effects.get(fid)
+        if eff is None:
+            continue
+        for caller_fid, site in callers.get(fid, ()):
+            ceff = graph.effects.get(caller_fid)
+            if ceff is None:
+                continue
+            if _propagate(eff, ceff, site, fid) and caller_fid not in in_work:
+                work.append(caller_fid)
+                in_work.add(caller_fid)
+
+
+# --- cache + serialization ---------------------------------------------------
+
+_cached: Optional[Tuple[Sequence[Module], CallGraph]] = None
+
+
+def graph_for(modules: Sequence[Module]) -> CallGraph:
+    """Build (or reuse) the graph for a module list. The production list
+    is one object per process (framework.production_modules caches it),
+    so the fixpoint runs once however many checkers ask."""
+    global _cached
+    if _cached is not None and _cached[0] is modules:
+        return _cached[1]
+    graph = build_graph(modules)
+    _cached = (modules, graph)
+    return graph
+
+
+def dump_graph(graph: CallGraph) -> dict:
+    """JSON-friendly summary table for offline diffing (--dump-graph)."""
+    funcs = {}
+    for fid in sorted(graph.funcs):
+        eff = graph.effects.get(fid)
+        if eff is None:
+            continue
+        entry: dict = {}
+        if eff.blocks is not None:
+            entry["blocks"] = " -> ".join(graph.chain(fid, "blocks"))
+        if eff.mutates is not None:
+            entry["mutates"] = " -> ".join(graph.chain(fid, "mutates"))
+        if eff.acquires:
+            entry["acquires"] = sorted(l.display for l in eff.acquires)
+        if eff.binds_fence:
+            entry["binds_fence"] = True
+        calls = sorted(
+            {t for site in graph.calls.get(fid, ()) for t in site.targets}
+        )
+        if calls:
+            entry["calls"] = calls
+        if entry:
+            funcs[fid] = entry
+    edges = sorted(
+        {
+            (e.outer.display, e.inner.display, f"{e.module.rel}:{e.line}")
+            for e in graph.lock_edges
+        }
+    )
+    return {
+        "functions": funcs,
+        "lock_edges": [
+            {"outer": o, "inner": i, "site": s} for o, i, s in edges
+        ],
+        "entries": [
+            {
+                "site": f"{e.module.rel}:{e.line}",
+                "target": e.target_spelling,
+                "resolved": list(e.targets),
+            }
+            for e in graph.entries
+        ],
+    }

@@ -5,15 +5,21 @@ from tools.vet.checkers import (
     backend,
     clocks,
     crash,
+    fencecheck,
     fetch,
+    lockorder,
     locks,
     metricsuse,
     spanuse,
+    threads,
     transport,
 )
 
 ALL_CHECKERS = (
     *locks.CHECKERS,
+    *lockorder.CHECKERS,
+    *fencecheck.CHECKERS,
+    *threads.CHECKERS,
     *crash.CHECKERS,
     *clocks.CHECKERS,
     *metricsuse.CHECKERS,

@@ -1,0 +1,122 @@
+"""fence-discipline: every thread that can reach a fenced mutation must
+bind the WriteFence — PR 13's invariant ("EVERY mutating verb passes the
+WriteFence"), mechanized.
+
+The fence has two halves: the store-side check (every mutating verb
+calls ``fence.check`` / ``_fence_check`` before writing) and the
+thread-side binding (``bind_thread(fence)`` in the thread main, which
+arms the cooperative crashpoint abort so a deposed leader's sweep stops
+*between* verbs, not just at the next write). The store-side half is
+self-evident in the verb bodies; the thread-side half was enforced by
+review memory. This checker closes it:
+
+- entry points are every production ``threading.Thread(target=...)``
+  construction (the call graph resolves the target — methods, nested
+  closures, lambdas analyzed in place; ReconcileLoop sweep registration
+  is covered because ``_run`` reaches every controller ``reconcile``
+  through the conservative by-name resolution);
+- an entry whose reachable closure contains a ``mutates``-effect
+  function but NO ``bind_thread`` call is a finding, rendered with the
+  chain from the entry to the nearest fenced mutation.
+
+Waiver: ``# vet: fence-exempt(<reason>)`` on the ``threading.Thread``
+construction line or on the target's ``def`` line. The canonical
+resident: the kubeapi watch pumps, which write through the BASE
+``Cluster`` verbs into the informer cache only (``_fence_is_store`` is
+False there) and must keep syncing on a deposed leader.
+
+Unresolvable targets (``server.serve_forever``) contribute no
+reachable closure and vacuously pass — a documented soundness limit.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.vet.callgraph import graph_for
+from tools.vet.framework import Checker, Finding, Module
+
+NAME = "fence-discipline"
+
+WAIVER_RE = re.compile(r"#\s*vet:\s*fence-exempt\(([^)]+)\)")
+
+
+def _waived(graph, entry) -> bool:
+    """Waiver on the Thread construction line or the target's def line."""
+    if WAIVER_RE.search(entry.module.line_text(entry.line)):
+        return True
+    if entry.def_line is not None:
+        target_info = graph.funcs.get(entry.targets[0])
+        if target_info is not None and WAIVER_RE.search(
+            target_info.module.line_text(entry.def_line)
+        ):
+            return True
+    return False
+
+
+def _reach(graph, entry) -> Tuple[bool, Optional[str], Dict[str, str]]:
+    """BFS the entry's reachable closure, tracking parents for chain
+    rendering. Returns (binds_fence, nearest mutator fid, parent map)."""
+    seen: Set[str] = set()
+    parent: Dict[str, str] = {}
+    queue = list(entry.targets)
+    mutator: Optional[str] = None
+    while queue:
+        fid = queue.pop(0)
+        if fid in seen:
+            continue
+        seen.add(fid)
+        eff = graph.effects.get(fid)
+        if eff is None:
+            continue
+        if eff.binds_fence:
+            return True, mutator, parent
+        if mutator is None and eff.mutates is not None:
+            mutator = fid  # BFS order: fewest hops from the entry
+        for site in graph.calls.get(fid, ()):
+            for target in site.targets:
+                if target not in seen and target not in parent:
+                    parent[target] = fid
+                    queue.append(target)
+    return False, mutator, parent
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    graph = graph_for(modules)
+    findings: List[Finding] = []
+    for entry in graph.entries:
+        if not entry.targets or _waived(graph, entry):
+            continue
+        binds, mutator, parent = _reach(graph, entry)
+        if binds or mutator is None:
+            continue
+
+        hops = [mutator]
+        while hops[-1] in parent:
+            hops.append(parent[hops[-1]])
+        path = " -> ".join(
+            graph.funcs[fid].qual for fid in reversed(hops) if fid in graph.funcs
+        )
+        tail = " -> ".join(graph.chain(mutator, "mutates"))
+        creator = graph.funcs.get(entry.creator)
+        creator_qual = creator.qual if creator else "<module>"
+        findings.append(
+            Finding(
+                checker=NAME,
+                file=entry.module.rel,
+                line=entry.line,
+                key=f"{creator_qual}:{entry.target_spelling}",
+                message=(
+                    f"thread target {entry.target_spelling} reaches a fenced "
+                    f"mutation ({path} -> {tail}) but never calls "
+                    f"bind_thread(<fence>) — a deposed leader's thread keeps "
+                    f"mutating between fence checks; bind the fence in the "
+                    f"thread main or waive with '# vet: fence-exempt(<reason>)'"
+                ),
+            )
+        )
+    return sorted(findings, key=lambda f: (f.file, f.line))
+
+
+CHECKERS = (Checker(NAME, _check),)

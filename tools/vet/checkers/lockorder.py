@@ -1,0 +1,201 @@
+"""lock-order: deadlock detection over the derived lock-ordering graph.
+
+Every place the program acquires lock M while holding lock L — a
+lexically nested ``with``, or a call under L to a function whose
+transitive summary acquires M — contributes a directed edge L -> M.
+A cycle in that graph is two code paths that can interleave into a
+deadlock; each finding renders EVERY edge of the cycle with its
+acquisition path (file:line, and the call chain for indirect edges),
+because a deadlock report you cannot act on from the message alone is
+noise.
+
+Self-edges (re-acquiring the lock you hold) are suppressed for
+reentrant kinds — ``threading.RLock`` and ``threading.Condition``
+(whose default internal lock is an RLock) — and flagged for plain
+``threading.Lock``, where the second acquire wedges the thread against
+itself.
+
+Identity is canonical (see callgraph.LockId): ``self._lock`` in a
+subclass method is the lock the defining base class constructs, and
+``threading.Condition(self._x)`` aliases to the wrapped lock, so a
+cv-vs-lock nesting on one runtime lock is not a false cycle. Locks on
+receivers the resolver cannot type (``peer._lock``) never enter the
+graph — a documented soundness limit, not a silent drop (they still
+count for blocking-under-lock, which is lexical).
+
+Waiver: ``# vet: lock-order(<reason>)`` on the acquisition or call
+line of an edge removes that edge from the graph — the reason is the
+documentation for why the ordering is safe (e.g. one side provably
+single-threaded).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+from tools.vet.callgraph import LockEdge, LockId, graph_for
+from tools.vet.framework import Checker, Finding, Module
+
+NAME = "lock-order"
+
+WAIVER_RE = re.compile(r"#\s*vet:\s*lock-order\(([^)]+)\)")
+
+
+def _edge_path(edge: LockEdge, graph) -> str:
+    """Human-readable acquisition path for one edge."""
+    where = f"{edge.module.rel}:{edge.line}"
+    func = graph.funcs[edge.func].qual
+    if edge.via is None:
+        return (
+            f"{func} holds {edge.outer.display} and takes "
+            f"{edge.inner.display} at {where}"
+        )
+    chain = graph.chain(edge.via, "acquires", lock=edge.inner)
+    via_qual = graph.funcs[edge.via].qual if edge.via in graph.funcs else edge.via
+    rendered = " -> ".join([via_qual] + chain[:-1] + [f"with {edge.inner.display}"])
+    return (
+        f"{func} holds {edge.outer.display} and calls {rendered} at {where}"
+    )
+
+
+_EdgeMap = Dict[Tuple[LockId, LockId], LockEdge]
+
+
+def _collect_edges(graph) -> Tuple[_EdgeMap, _EdgeMap]:
+    """One representative edge per (outer, inner) pair, waived edges
+    dropped; self-edges (outer == inner) bucketed separately."""
+    edges: _EdgeMap = {}
+    self_edges: _EdgeMap = {}
+    for edge in graph.lock_edges:
+        if WAIVER_RE.search(edge.module.line_text(edge.line)):
+            continue
+        pair = (edge.outer, edge.inner)
+        bucket = self_edges if edge.outer == edge.inner else edges
+        if pair not in bucket:
+            bucket[pair] = edge
+    return edges, self_edges
+
+
+def _self_edge_findings(graph, self_edges: _EdgeMap) -> List[Finding]:
+    """Self re-acquisition of a non-reentrant lock: deadlock against
+    yourself, no cycle search needed."""
+    findings: List[Finding] = []
+    for (lock, _), edge in sorted(
+        self_edges.items(), key=lambda kv: (kv[1].module.rel, kv[1].line)
+    ):
+        if lock.reentrant or lock.kind is None:
+            continue
+        findings.append(
+            Finding(
+                checker=NAME,
+                file=edge.module.rel,
+                line=edge.line,
+                key=f"self:{lock.display}",
+                message=(
+                    f"{lock.display} is a plain threading.Lock re-acquired "
+                    f"while already held — {_edge_path(edge, graph)}; the "
+                    f"second acquire deadlocks the thread (make it an RLock "
+                    f"or split the critical section)"
+                ),
+            )
+        )
+    return findings
+
+
+def _sccs(adj: Dict[LockId, List[LockId]]) -> List[List[LockId]]:
+    """Multi-node strongly connected components, via iterative Tarjan."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on_stack: Dict[LockId, bool] = {}
+    stack: List[LockId] = []
+    sccs: List[List[LockId]] = []
+    counter = [0]
+
+    def strongconnect(v: LockId) -> None:
+        # Iterative Tarjan: (node, child-iterator) frames.
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack[v] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for child in it:
+                if child not in index:
+                    index[child] = low[child] = counter[0]
+                    counter[0] += 1
+                    stack.append(child)
+                    on_stack[child] = True
+                    work.append((child, iter(adj.get(child, ()))))
+                    advanced = True
+                    break
+                if on_stack.get(child):
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(scc)
+
+    for v in sorted(adj, key=lambda l: l.display):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _cycle_findings(graph, edges: _EdgeMap) -> List[Finding]:
+    """Cycle detection (Tarjan SCC) over the distinct-lock edges."""
+    adj: Dict[LockId, List[LockId]] = {}
+    for outer, inner in edges:
+        adj.setdefault(outer, []).append(inner)
+        adj.setdefault(inner, [])
+    findings: List[Finding] = []
+    for scc in _sccs(adj):
+        members = set(scc)
+        cyc_edges = sorted(
+            (e for (o, i), e in edges.items() if o in members and i in members),
+            key=lambda e: (e.module.rel, e.line),
+        )
+        if not cyc_edges:
+            continue
+        names = " <-> ".join(sorted(l.display for l in members))
+        paths = " ; ".join(_edge_path(e, graph) for e in cyc_edges)
+        first = cyc_edges[0]
+        findings.append(
+            Finding(
+                checker=NAME,
+                file=first.module.rel,
+                line=first.line,
+                key=f"cycle:{names}",
+                message=(
+                    f"lock-order cycle {names} — potential deadlock: "
+                    f"{paths}. Fix the ordering, or waive ONE edge's line "
+                    f"with '# vet: lock-order(<reason>)'"
+                ),
+            )
+        )
+    return findings
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    graph = graph_for(modules)
+    edges, self_edges = _collect_edges(graph)
+    findings = _self_edge_findings(graph, self_edges)
+    findings.extend(_cycle_findings(graph, edges))
+    return sorted(findings, key=lambda f: (f.file, f.line))
+
+
+CHECKERS = (Checker(NAME, _check),)

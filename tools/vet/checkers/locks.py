@@ -13,13 +13,20 @@ lock-discipline
 
 blocking-under-lock
     No ``with <lock>:`` body may call sleep, subprocess, socket/HTTP, JAX
-    dispatch, or watch-callback fan-out (``*._notify``): a convoy on a
-    hot-path lock is this runtime's analogue of holding a mutex across
-    cgo, and callback dispatch under the store lock additionally invites
-    lock-order inversions against consumer locks. Lock expressions are
-    recognized by their terminal name (``_lock``, ``_rv_lock``, ``_cv``,
-    ...); ``cv.wait`` is exempt — releasing the lock is what a condition
-    variable is for.
+    dispatch, or watch-callback fan-out (``*._notify``) — directly OR
+    through any chain of production calls: a convoy on a hot-path lock is
+    this runtime's analogue of holding a mutex across cgo, and callback
+    dispatch under the store lock additionally invites lock-order
+    inversions against consumer locks. The transitive half rides the
+    whole-program call graph (tools/vet/callgraph.py): a call under a
+    lock to a function whose *effect summary* says it blocks is flagged
+    with the full chain (``sweep -> _flush -> block_until_ready``).
+    Base facts live in callgraph.py; the blunt ``jax.*`` prefix match is
+    gone — only the dispatch effects (block_until_ready / device_get /
+    device_put) block, so ``jax.tree_util`` under a lock is no longer a
+    latent false positive. Lock expressions are recognized by their
+    terminal name (``_lock``, ``_rv_lock``, ``_cv``, ...); ``cv.wait``
+    is exempt — releasing the lock is what a condition variable is for.
 """
 
 from __future__ import annotations
@@ -46,25 +53,10 @@ WAIVER_RE = re.compile(r"#\s*vet:\s*unguarded\(([^)]+)\)")
 
 LOCK_TERMINAL_RE = re.compile(r"(^|_)(lock|cv|cond|mutex)$", re.IGNORECASE)
 
-BLOCKING_PREFIXES = (
-    "subprocess.",
-    "socket.",
-    "requests.",
-    "urllib.request.",
-    "jax.",
-    "jnp.",
-)
-BLOCKING_ATTRS = {"sleep", "urlopen", "block_until_ready", "check_output", "check_call"}
-BLOCKING_NAMES = {"sleep", "urlopen"}
-# Watch-callback dispatch: Cluster._notify fans out to arbitrary consumer
-# callbacks (reconcile enqueues, the incremental-encode sync), each taking
-# its own locks — firing it under the store lock convoys every verb behind
-# the slowest consumer and invites lock-order inversions. The store's
-# notify-outside-the-lock invariant is pinned HERE rather than by
-# convention. (cv.notify/notify_all are NOT in this set — waking a
-# condition's waiters under its lock is what conditions are for; the
-# `_notify_locked` helpers keep that spelling.)
-DISPATCH_ATTRS = {"_notify"}
+# Blocking base facts (sleep/subprocess/HTTP/JAX dispatch) and the
+# `_notify` watch-callback dispatch effect moved to tools/vet/callgraph.py
+# — the call graph recognizes them at every call site and propagates them
+# through effect summaries; this module consumes the summaries.
 
 # file or file::qualname prefix -> justification (shared by both checkers).
 ALLOWED: dict = {
@@ -74,6 +66,36 @@ ALLOWED: dict = {
     # solves is the accepted cost; the lock covering the blocking call is
     # the mechanism, not an accident.
     "karpenter_tpu/parallel/spmd.py::SpmdDispatcher.lead_dispatch": "collective order requires lock across device completion",
+    # Single-flight cache fills: the lock deliberately covers the AWS
+    # describe/create so concurrent cold readers WAIT for one fill instead
+    # of issuing N identical cloud calls (the reference's setup caches
+    # behave the same way). These paths run at provisioning setup cadence,
+    # not per-sweep — a convoy here is one redundant-API-call prevented.
+    "karpenter_tpu/cloudprovider/ec2/instancetypes.py::InstanceTypeProvider._get_infos": "single-flight cache fill across the EC2 describe",
+    "karpenter_tpu/cloudprovider/ec2/instancetypes.py::InstanceTypeProvider._get_offerings": "single-flight cache fill across the EC2 describe",
+    "karpenter_tpu/cloudprovider/ec2/launchtemplates.py::AmiProvider._resolve": "single-flight cache fill across the SSM lookup",
+    "karpenter_tpu/cloudprovider/ec2/launchtemplates.py::LaunchTemplateProvider._ensure": "single-flight describe-or-create; two concurrent ensures would race duplicate CreateLaunchTemplate calls",
+    "karpenter_tpu/cloudprovider/ec2/network.py::SubnetProvider.get": "single-flight cache fill across the EC2 describe",
+    "karpenter_tpu/cloudprovider/ec2/network.py::SecurityGroupProvider.get": "single-flight cache fill across the EC2 describe",
+    # Documented at the site: ONE displacement in flight at a time — the
+    # server-truth PDB gate reads a fresh LIST under _disruption_lock, and
+    # two concurrent drains passing on the same healthy count would jointly
+    # overspend the budget. The lock covering the server round-trip is the
+    # budget-serialization mechanism itself.
+    "karpenter_tpu/kubeapi/cluster.py::ApiServerCluster.reschedule_pod": "PDB budget serialization requires lock across the server-truth LIST",
+    # Documented at the site: 410-recovery holds _rv_lock across the ghost
+    # sweep (including the _remove_local notify) so no watch replay can
+    # interleave between the tombstone and the delete — a suppressed-replay
+    # hole would resurrect deleted objects in the informer cache.
+    "karpenter_tpu/kubeapi/cluster.py::ApiServerCluster._relist": "resync atomicity: tombstone + remove must not interleave with watch apply",
+    # Boot-time calibration: the break-even probe dispatches trivial solves
+    # to the device under the module lock so exactly one process-wide
+    # calibration runs; callers are the warmup path, never a sweep.
+    "karpenter_tpu/models/solver.py::calibrate_break_even": "single-flight boot calibration; probe dispatch is the measured quantity",
+    # Single-flight native build: concurrent load() callers must wait for
+    # the one `make` run — returning early would hand back a half-built
+    # (or stale) shared object.
+    "karpenter_tpu/ops/native.py::load": "single-flight native build under the load lock",
 }
 
 
@@ -228,16 +250,14 @@ class _LockScan:
 ANNOTATION_RE = re.compile(r"#\s*vet:\s*(.+)$")
 VALID_FORM_RE = re.compile(
     r"^(guarded-by\(self\.\w+\)|holds\(self\.\w+\)|unguarded\([^)]+\)"
-    r"|host-array\([^)]+\))"
+    r"|host-array\([^)]+\)|lock-order\([^)]+\)|fence-exempt\([^)]+\))"
 )
 
 
-def _annotation_findings(module: Module, consumed_guard_lines: Set[int]):
-    """A `# vet:` comment that the checkers cannot or will not read is a
-    finding — silently-unenforced annotations are the worst failure mode
-    an enforcement tool can have (typo'd syntax, a guarded-by that landed
-    on the wrong line of a reformatted assignment, a holds() off the def
-    line)."""
+def _placement_lines(module: Module):
+    """Line sets that decide where each annotation form may legally sit:
+    (def lines, np.asarray call lines, `with` lines, call lines,
+    threading.Thread construction lines)."""
     def_lines = {
         node.lineno
         for node in ast.walk(module.tree)
@@ -252,11 +272,44 @@ def _annotation_findings(module: Module, consumed_guard_lines: Set[int]):
         if isinstance(node, ast.Call)
         and dotted_name(node.func) in ("np.asarray", "numpy.asarray")
     }
+    # lock-order(...) waivers remove an ordering edge: they must sit on an
+    # acquisition (`with`) line or a call line — anywhere else they drop no
+    # edge. fence-exempt(...) must sit on a Thread construction or def line.
+    with_lines = {
+        node.lineno
+        for node in ast.walk(module.tree)
+        if isinstance(node, (ast.With, ast.AsyncWith))
+    }
+    call_lines = {
+        node.lineno
+        for node in ast.walk(module.tree)
+        if isinstance(node, ast.Call)
+    }
+    thread_lines = {
+        node.lineno
+        for node in ast.walk(module.tree)
+        if isinstance(node, ast.Call)
+        and dotted_name(node.func) in ("threading.Thread", "Thread")
+    }
+    return def_lines, asarray_lines, with_lines, call_lines, thread_lines
+
+
+def _annotation_findings(module: Module, consumed_guard_lines: Set[int]):
+    """A `# vet:` comment that the checkers cannot or will not read is a
+    finding — silently-unenforced annotations are the worst failure mode
+    an enforcement tool can have (typo'd syntax, a guarded-by that landed
+    on the wrong line of a reformatted assignment, a holds() off the def
+    line)."""
+    def_lines, asarray_lines, with_lines, call_lines, thread_lines = (
+        _placement_lines(module)
+    )
+
     def diagnose(body: str, lineno: int):
         if not VALID_FORM_RE.match(body):
             return (
                 f"unrecognized vet annotation {body!r} "
-                f"(guarded-by/holds/unguarded/host-array)"
+                f"(guarded-by/holds/unguarded/host-array/lock-order/"
+                f"fence-exempt)"
             )
         if body.startswith("guarded-by") and lineno not in consumed_guard_lines:
             return (
@@ -269,6 +322,16 @@ def _annotation_findings(module: Module, consumed_guard_lines: Set[int]):
             return (
                 "host-array() waiver must sit on the np.asarray call line "
                 "it covers"
+            )
+        if body.startswith("lock-order") and lineno not in (with_lines | call_lines):
+            return (
+                "lock-order() waiver must sit on the `with` acquisition or "
+                "call line of the ordering edge it removes"
+            )
+        if body.startswith("fence-exempt") and lineno not in (thread_lines | def_lines):
+            return (
+                "fence-exempt() waiver must sit on the threading.Thread "
+                "construction line or the thread target's `def` line"
             )
         return None
 
@@ -315,57 +378,79 @@ def _check_lock_discipline(modules: List[Module]) -> List[Finding]:
 # --- blocking-under-lock -----------------------------------------------------
 
 
-def _blocking_callee(call: ast.Call):
-    """The offending callee spelling, or None if this call may block-free."""
-    dotted = dotted_name(call.func)
-    if dotted:
-        for prefix in BLOCKING_PREFIXES:
-            if dotted.startswith(prefix):
-                return dotted
-        if dotted in BLOCKING_NAMES:
-            return dotted
-    if isinstance(call.func, ast.Attribute) and call.func.attr in (
-        BLOCKING_ATTRS | DISPATCH_ATTRS
-    ):
-        return dotted or f"<expr>.{call.func.attr}"
-    return None
+def _check_blocking(modules: List[Module]) -> List[Finding]:
+    """Direct base facts AND transitive effect summaries, both rendered
+    from the call graph's per-site lock context (held_raw: ANY lock-shaped
+    `with` counts, canonicalizable or not). A transitive finding renders
+    the chain down to the base fact so the report is actionable without
+    re-deriving it by hand."""
+    from tools.vet.callgraph import graph_for
 
-
-def _scan_with_body(module: Module, node: ast.AST, qual: str, findings: List[Finding]) -> None:
-    """Flag blocking calls lexically under an acquired lock (nested defs
-    included: a closure built under a lock usually runs under it — waive
-    deliberate deferred execution case-by-case if one ever appears)."""
-    stack = list(node.body)
-    while stack:
-        child = stack.pop()
-        if isinstance(child, ast.Call):
-            callee = _blocking_callee(child)
-            if callee is not None and not scope_allows(ALLOWED, module.rel, qual):
+    graph = graph_for(modules)
+    findings: List[Finding] = []
+    seen_keys = set()
+    for fid in sorted(graph.calls):
+        info = graph.funcs[fid]
+        qual = info.qual
+        if scope_allows(ALLOWED, info.module.rel, qual):
+            continue
+        for site in graph.calls[fid]:
+            if not site.held_raw:
+                continue
+            if site.base_block is not None:
+                key = f"{qual or '<module>'}:{site.base_block}"
+                if (info.module.rel, key) in seen_keys:
+                    continue
+                seen_keys.add((info.module.rel, key))
                 findings.append(
                     Finding(
                         checker=BLOCK_NAME,
-                        file=module.rel,
-                        line=child.lineno,
-                        key=f"{qual or '<module>'}:{callee}",
+                        file=info.module.rel,
+                        line=site.line,
+                        key=key,
                         message=(
-                            f"{callee}() inside a `with <lock>:` body — "
-                            f"blocking under a lock convoys every other "
-                            f"holder; move it outside the critical section"
+                            f"{site.base_block}() inside a `with <lock>:` "
+                            f"body — blocking under a lock convoys every "
+                            f"other holder; move it outside the critical "
+                            f"section"
                         ),
                     )
                 )
-        stack.extend(ast.iter_child_nodes(child))
-
-
-def _check_blocking(modules: List[Module]) -> List[Finding]:
-    findings: List[Finding] = []
-    for module in modules:
-        for node, qual in walk_with_qualname(module.tree):
-            if isinstance(node, (ast.With, ast.AsyncWith)) and _locks_acquired(node):
-                _scan_with_body(module, node, qual, findings)
-    # A call under nested locks is reached from every enclosing With; one
-    # finding per site is enough.
-    return sorted(set(findings), key=lambda f: (f.file, f.line))
+                continue
+            blocking_target = next(
+                (
+                    t for t in site.targets
+                    if graph.effects.get(t) is not None
+                    and graph.effects[t].blocks is not None
+                ),
+                None,
+            )
+            if blocking_target is None:
+                continue
+            chain = graph.chain(blocking_target, "blocks")
+            terminal = chain[-1].split(" @ ")[0] if chain else "?"
+            key = f"{qual or '<module>'}:{site.spelling}->{terminal}"
+            if (info.module.rel, key) in seen_keys:
+                continue
+            seen_keys.add((info.module.rel, key))
+            target_qual = graph.funcs[blocking_target].qual
+            rendered = " -> ".join([site.spelling, target_qual] + chain)
+            findings.append(
+                Finding(
+                    checker=BLOCK_NAME,
+                    file=info.module.rel,
+                    line=site.line,
+                    key=key,
+                    message=(
+                        f"call chain {rendered} blocks inside a "
+                        f"`with <lock>:` body — blocking under a lock "
+                        f"convoys every other holder; move the call outside "
+                        f"the critical section or allowlist it with the "
+                        f"documented reason"
+                    ),
+                )
+            )
+    return sorted(findings, key=lambda f: (f.file, f.line))
 
 
 CHECKERS = (

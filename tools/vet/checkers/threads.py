@@ -1,0 +1,70 @@
+"""thread-discipline: every production ``threading.Thread(...)`` must
+pass explicit ``name=`` and ``daemon=``.
+
+The soak's thread-leak oracle diffs ``threading.enumerate()`` snapshots
+and the flight recorder stamps events with the current thread name — an
+anonymous ``Thread-7`` in either is an attribution dead end mid-storm.
+The daemon flag must be a stated decision for the same reason shutdown
+convergence is asserted everywhere: an implicit non-daemon thread is a
+process that cannot exit; an implicitly-inherited daemon flag is a
+thread silently killed mid-write at interpreter teardown. Both
+keywords, every site, no default inheritance.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.vet.framework import (
+    Checker,
+    Finding,
+    Module,
+    dotted_name,
+    walk_with_qualname,
+)
+
+NAME = "thread-discipline"
+
+THREAD_CTORS = ("threading.Thread", "Thread")
+
+
+def _check(modules: List[Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in modules:
+        for node, qual in walk_with_qualname(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in THREAD_CTORS:
+                continue
+            missing = [
+                kw for kw in ("name", "daemon")
+                if not any(k.arg == kw for k in node.keywords)
+            ]
+            if not missing:
+                continue
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"), None
+            )
+            target_spelling = (
+                dotted_name(target) or "<lambda>"
+                if target is not None else "<none>"
+            )
+            findings.append(
+                Finding(
+                    checker=NAME,
+                    file=module.rel,
+                    line=node.lineno,
+                    key=f"{qual or '<module>'}:{target_spelling}",
+                    message=(
+                        f"threading.Thread(target={target_spelling}) without "
+                        f"explicit {' and '.join(missing)}= — the thread-leak "
+                        f"oracle and flight recorder attribute threads by "
+                        f"name, and the daemon flag must be a stated decision"
+                    ),
+                )
+            )
+    return sorted(findings, key=lambda f: (f.file, f.line))
+
+
+CHECKERS = (Checker(NAME, _check),)

@@ -239,8 +239,113 @@ def checker_findings(name: str, paths: Optional[Sequence[Path]] = None) -> List[
     )
 
 
+def _print_raw_findings(modules, rel: str, line: int) -> None:
+    # Raw findings, no baseline: --why must explain suppressed ones too.
+    hits = [
+        f for f in run_checkers(modules) if f.file == rel and f.line == line
+    ]
+    for finding in hits:
+        print(finding.render())
+    if not hits:
+        print(f"no finding at {rel}:{line}; derivation for the enclosing scope:")
+
+
+def _print_effects(graph, info) -> None:
+    eff = graph.effects.get(info.fid)
+    if eff is None or not (
+        eff.blocks or eff.mutates or eff.acquires or eff.binds_fence
+    ):
+        print("    no effects")
+        return
+    if eff.blocks is not None:
+        print(f"    blocks:  {' -> '.join(graph.chain(info.fid, 'blocks'))}")
+    if eff.mutates is not None:
+        print(f"    mutates: {' -> '.join(graph.chain(info.fid, 'mutates'))}")
+    for lock in sorted(eff.acquires, key=lambda l: l.display):
+        chain = " -> ".join(graph.chain(info.fid, "acquires", lock))
+        print(f"    acquires {lock.display}: {chain}")
+    if eff.binds_fence:
+        print("    binds WriteFence")
+
+
+def _print_call_sites(graph, info, line: int) -> None:
+    for site in graph.calls.get(info.fid, ()):
+        if site.line != line:
+            continue
+        resolved = ", ".join(site.targets) if site.targets else "<unresolved>"
+        flavor = " (conservative)" if site.conservative else ""
+        print(f"    call {site.spelling} -> {resolved}{flavor}")
+        if site.held:
+            held = ", ".join(sorted(l.display for l in site.held))
+            print(f"      under lock(s): {held}")
+
+
+def _why(spec: str) -> int:
+    """--why <file:line>: print every raw finding at that location plus the
+    call-graph derivation (effect summaries with full witness chains) for
+    the innermost enclosing function — the audit trail behind a finding."""
+    from tools.vet import callgraph
+
+    file_part, _, line_part = spec.rpartition(":")
+    if not file_part or not line_part.isdigit():
+        print(f"ERROR: --why wants <file:line>, got {spec!r}")
+        return 2
+    line = int(line_part)
+    try:
+        rel = Path(file_part).resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        rel = Path(file_part).as_posix()
+    modules = production_modules()
+    if not any(m.rel == rel for m in modules):
+        print(f"ERROR: {rel} is not in the production scope")
+        return 2
+    _print_raw_findings(modules, rel, line)
+    graph = callgraph.graph_for(modules)
+    enclosing = [
+        info
+        for info in graph.funcs.values()
+        if info.module.rel == rel
+        and info.node.lineno <= line <= (info.node.end_lineno or info.node.lineno)
+    ]
+    if not enclosing:
+        print(f"  {rel}:{line} is at module level (no enclosing function)")
+        return 0
+    # Innermost first; usually one, but decorators/closures can nest.
+    enclosing.sort(key=lambda i: i.node.lineno, reverse=True)
+    info = enclosing[0]
+    print(f"  function {info.qual} ({rel}:{info.node.lineno})")
+    _print_effects(graph, info)
+    _print_call_sites(graph, info, line)
+    return 0
+
+
+def _dump_graph_cmd(argv: List[str]) -> int:
+    from tools.vet import callgraph
+
+    extra = [Path(p) for p in argv]
+    missing = [p for p in extra if not p.exists()]
+    if missing:
+        print(f"ERROR: no such path: {', '.join(map(str, missing))}")
+        return 2
+    modules = load_modules(extra) if extra else production_modules()
+    graph = callgraph.graph_for(modules)
+    print(json.dumps(callgraph.dump_graph(graph), indent=2, sort_keys=True))
+    return 0
+
+
 def main(argv: Sequence[str]) -> int:
     from tools.vet.checkers import ALL_CHECKERS
+
+    argv = list(argv)
+    if "--dump-graph" in argv:
+        argv.remove("--dump-graph")
+        return _dump_graph_cmd(argv)
+    if "--why" in argv:
+        i = argv.index("--why")
+        if i + 1 >= len(argv):
+            print("ERROR: --why wants <file:line>")
+            return 2
+        return _why(argv[i + 1])
 
     paths = [Path(p) for p in argv] or None
     if paths:
@@ -253,6 +358,15 @@ def main(argv: Sequence[str]) -> int:
     stale: List[Tuple[str, str]] = []
     if paths is None:
         findings, stale = apply_baseline(findings, load_baseline())
+    return _report(findings, stale, len(ALL_CHECKERS), len(modules))
+
+
+def _report(
+    findings: List[Finding],
+    stale: List[Tuple[str, str]],
+    n_checkers: int,
+    n_modules: int,
+) -> int:
     for finding in findings:
         print(finding.render())
     for checker, entry in stale:
@@ -260,5 +374,5 @@ def main(argv: Sequence[str]) -> int:
     if findings or stale:
         print(f"\nFAIL: vet found {len(findings)} violation(s), {len(stale)} stale baseline entr(ies)")
         return 1
-    print(f"OK: {len(ALL_CHECKERS)} checkers clean over {len(modules)} files")
+    print(f"OK: {n_checkers} checkers clean over {n_modules} files")
     return 0
