@@ -1,6 +1,6 @@
 # Ref: the reference's Makefile test/battletest/build targets.
 
-.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke ha-smoke lifecycle-smoke soak-smoke smoke proto native bench clean
+.PHONY: test vet battletest degraded-smoke crash-smoke interruption-smoke consolidation-smoke drift-smoke fetch-smoke encode-smoke chaos-smoke multichip-smoke constraints-smoke obs-smoke market-smoke ha-smoke lifecycle-smoke soak-smoke smoke proto native bench clean
 
 test:
 	python -m pytest tests/ -x -q
@@ -74,6 +74,16 @@ interruption-smoke:
 # unbounded wait fails fast instead of wedging a driver run.
 consolidation-smoke:
 	timeout -k 10 120 python tools/consolidation_smoke.py
+
+# The drift rolling-replacement wave (tools/drift_smoke.py): spec flip under
+# live churn on the apiserver backend through the chaos fault storm, a
+# mid-wave reprice and provider-drift injection, controllers killed at
+# rotating drift crashpoints and rebuilt mid-wave; asserts post-flip
+# convergence to the new spec hash with concurrent voluntary disruptions
+# never exceeding the budget at any observed instant, exactly-once binds,
+# zero PDB violations (server-side oracle), zero leaks, pending SLO held.
+drift-smoke:
+	timeout -k 10 180 python tools/drift_smoke.py
 
 # The device-fetch budget guard (tools/fetch_smoke.py): shape math asserting
 # the compacted plan payload at 50k pods / 400 types stays <= 4 KB, plus a
@@ -199,6 +209,7 @@ smoke:
 	$(MAKE) degraded-smoke || rc=1; \
 	$(MAKE) interruption-smoke || rc=1; \
 	$(MAKE) consolidation-smoke || rc=1; \
+	$(MAKE) drift-smoke || rc=1; \
 	$(MAKE) fetch-smoke || rc=1; \
 	$(MAKE) encode-smoke || rc=1; \
 	$(MAKE) chaos-smoke || rc=1; \

@@ -112,6 +112,10 @@ class ProvisionerSpec:
     ttl_seconds_after_empty: Optional[float] = None
     ttl_seconds_until_expired: Optional[float] = None
     limits: Optional[Limits] = None
+    # Selection priority among provisioners that both match a pod: higher
+    # weight wins, name breaks ties (real-Karpenter `.spec.weight`). Excluded
+    # from the drift hash — re-weighting must not roll a fleet.
+    weight: int = 0
 
 
 @dataclass

@@ -80,6 +80,8 @@ def provisioner_to_dict(provisioner: Provisioner) -> Dict[str, Any]:
         out["spec"]["ttlSecondsUntilExpired"] = spec.ttl_seconds_until_expired
     if spec.limits is not None:
         out["spec"]["limits"] = {"resources": dict(spec.limits.resources)}
+    if spec.weight:
+        out["spec"]["weight"] = spec.weight
     return out
 
 
@@ -101,6 +103,7 @@ def provisioner_from_dict(data: Dict[str, Any]) -> Provisioner:
         limits=Limits(resources=dict(limits_data.get("resources", {})))
         if limits_data
         else None,
+        weight=int(spec_data.get("weight", 0)),
     )
     provisioner = Provisioner(name=metadata.get("name", ""), spec=spec)
     if metadata.get("uid"):

@@ -58,6 +58,14 @@ def default_provisioner(provisioner: Provisioner) -> None:
         DEFAULT_HOOK(provisioner)
 
 
+def _validate_weight(weight, errors: List[str]) -> None:
+    # Weight: real Karpenter bounds .spec.weight to [0, 100] (0 = unset).
+    if not isinstance(weight, int) or isinstance(weight, bool):
+        errors.append(f"weight must be an integer, got {weight!r}")
+    elif not 0 <= weight <= 100:
+        errors.append(f"weight must be in [0, 100], got {weight}")
+
+
 def validate_provisioner(provisioner: Provisioner) -> None:
     """Raise ValidationError listing every problem found."""
     errors: List[str] = []
@@ -71,6 +79,8 @@ def validate_provisioner(provisioner: Provisioner) -> None:
     ):
         if ttl is not None and ttl < 0:
             errors.append(f"{ttl_name} must be non-negative, got {ttl}")
+
+    _validate_weight(spec.weight, errors)
 
     # Labels: restricted domains may not be set directly (ref: validation.go
     # restricted-label check); values must be legal.

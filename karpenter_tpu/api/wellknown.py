@@ -70,6 +70,17 @@ RESCHEDULE_EPOCH_ANNOTATION = "karpenter.sh/reschedule-epoch"
 # resumes the drain from (controllers/consolidation.py). Doubles as the
 # in-flight marker that caps concurrent voluntary disruption.
 CONSOLIDATION_ACTION_ANNOTATION = "karpenter.sh/consolidation-action"
+# The canonical hash of the owning Provisioner's constraint envelope, stamped
+# at node registration (controllers/provisioning.py) and back-filled on
+# legacy/adopted nodes by the node reconciler — never treated as drift while
+# missing. The drift sweep compares it against the CURRENT spec hash
+# (karpenter_tpu/drift/).
+PROVISIONER_HASH_ANNOTATION = "karpenter.sh/provisioner-hash"
+# Drift intent (the drift KIND: "spec" | "provider" | "expired"), stamped onto
+# the victim Node BEFORE any pod is displaced — the durable record a restarted
+# controller resumes the rolling replacement from (controllers/drift.py).
+# Doubles as the in-flight marker the shared disruption ledger counts.
+DRIFT_ACTION_ANNOTATION = "karpenter.sh/drift-action"
 
 # --- Resource names --------------------------------------------------------
 RESOURCE_CPU = "cpu"

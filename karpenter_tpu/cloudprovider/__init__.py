@@ -269,6 +269,17 @@ class CloudProvider(abc.ABC):
         the book's per-pool discount; ICE-closed pools drop their spot
         offerings). Default: no-op — static catalogs stay static."""
 
+    def instance_drifted(self, node: NodeSpec) -> Optional[str]:
+        """Provider-side drift verdict for one live node: a short human
+        reason string when the cloud says the instance no longer matches
+        what the provisioner would launch today (launch-template/AMI
+        generation moved, offering no longer advertised), else None. The
+        drift sweep treats any non-None return as drift kind "provider".
+        Must be read-only and cheap enough to call per node per sweep.
+        Providers without drift detection return None (the drift controller
+        is then spec-hash-only for them)."""
+        return None
+
     @abc.abstractmethod
     def get_instance_types(self, constraints: Optional[Constraints] = None) -> List[InstanceType]:
         ...

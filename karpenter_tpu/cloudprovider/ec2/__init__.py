@@ -20,6 +20,7 @@ import datetime
 import json
 import threading
 
+from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.provisioner import Constraints, Provisioner
 from karpenter_tpu.cloudprovider import (
     DEFAULT_INTERRUPTION_DEADLINE_SECONDS,
@@ -28,13 +29,17 @@ from karpenter_tpu.cloudprovider import (
     INTERRUPTION_STOPPING,
     CloudInstance,
     CloudProvider,
+    CloudProviderError,
     InstanceType,
     InterruptionEvent,
     NodeSpec,
 )
 from karpenter_tpu.cloudprovider.ec2.api import Ec2Api
 from karpenter_tpu.cloudprovider.ec2.fake import FakeEc2
-from karpenter_tpu.cloudprovider.ec2.instances import InstanceProvider
+from karpenter_tpu.cloudprovider.ec2.instances import (
+    InstanceProvider,
+    parse_instance_id,
+)
 from karpenter_tpu.cloudprovider.ec2.instancetypes import InstanceTypeProvider
 from karpenter_tpu.cloudprovider.ec2.launchtemplates import (
     AmiProvider,
@@ -134,6 +139,10 @@ class Ec2CloudProvider(CloudProvider):
         self._market_seq = 0  # vet: guarded-by(self._market_lock)
         self._market_cursors: dict = {}  # vet: guarded-by(self._market_lock)
         self._market_history: List = []  # vet: guarded-by(self._market_lock)
+        # The controller's folded PriceBook (attach_market), read by the
+        # sustained-ICE drift check. Plain slot (GIL-atomic swap, read-only
+        # use): attach happens once at Manager boot.
+        self._market_book = None
 
     # --- CloudProvider interface ------------------------------------------
 
@@ -255,8 +264,89 @@ class Ec2CloudProvider(CloudProvider):
 
     def attach_market(self, book) -> None:
         """Advertised spot offering prices track the controller's folded
-        market (instancetypes applies the book's discounts at get)."""
+        market (instancetypes applies the book's discounts at get); the
+        book is also retained for the sustained-ICE drift verdict."""
         self.instance_types.attach_market(book)
+        self._market_book = book
+
+    # Sustained-ICE drift window, in FEED time: a spot pool must stay
+    # ICE-closed this long before its nodes count as provider-drifted —
+    # far past the 45s blackout TTL, so ordinary capacity wobble (the ICE
+    # open/close churn every storm produces) never rolls a fleet.
+    DRIFT_ICE_SUSTAINED_S = 600.0
+
+    def instance_drifted(self, node: NodeSpec) -> Optional[str]:
+        """Provider-side drift verdicts, cheapest check first:
+        (1) the node's instance type dropped out of the RAW catalog (the
+        undiscounted DescribeInstanceTypes view — the blackout/market-
+        filtered catalog would flip on every transient ICE);
+        (2) its spot pool has been ICE-closed past DRIFT_ICE_SUSTAINED_S of
+        feed time in the folded PriceBook;
+        (3) the live instance's AMI no longer matches what a launch today
+        would resolve — one DescribeInstances over the shared retry
+        envelope, compared against the AmiProvider's current resolution
+        (content-hashed launch-template names make AMI divergence the same
+        fact as template-version divergence).
+        Read-only; an API failure returns None (no verdict — drift is
+        voluntary, so the conservative answer is "not drifted")."""
+        try:
+            infos = self.instance_types._get_infos()
+        except Exception:  # noqa: BLE001 — coded API errors only
+            return None
+        if node.instance_type and node.instance_type not in infos:
+            return f"instance type {node.instance_type} no longer advertised"
+        verdict = self._ice_closed_verdict(node)
+        if verdict is not None:
+            return verdict
+        return self._ami_drift_verdict(node)
+
+    def _ami_drift_verdict(self, node: NodeSpec) -> Optional[str]:
+        if not node.provider_id:
+            return None
+        try:
+            instance_id = parse_instance_id(node.provider_id)
+            described = self.instances._describe_with_retry([instance_id])
+        except CloudProviderError:
+            return None
+        live = [i for i in described if i.instance_id == instance_id]
+        if not live or not live[0].image_id:
+            return None  # gone/unknown: the GC's problem, not drift's
+        catalog_type = next(
+            (
+                t
+                for t in self.get_instance_types()
+                if t.name == node.instance_type
+            ),
+            None,
+        )
+        if catalog_type is None:
+            return None  # no offerings right now: transient, not drift
+        try:
+            current_amis = self.amis.get([catalog_type])
+        except Exception:  # noqa: BLE001 — SSM faults are not a verdict
+            return None
+        if live[0].image_id not in current_amis:
+            return (
+                f"ami {live[0].image_id} superseded by "
+                f"{'/'.join(sorted(current_amis))}"
+            )
+        return None
+
+    def _ice_closed_verdict(self, node: NodeSpec) -> Optional[str]:
+        book = self._market_book
+        if book is None or node.capacity_type != wellknown.CAPACITY_TYPE_SPOT:
+            return None
+        closed_at = book.closed_since((node.instance_type, node.zone))
+        newest = book.last_tick_at()
+        if closed_at is None or newest is None:
+            return None
+        closed_for = newest - closed_at
+        if closed_for < self.DRIFT_ICE_SUSTAINED_S:
+            return None
+        return (
+            f"spot pool ({node.instance_type}, {node.zone}) ICE-closed "
+            f"for {closed_for:.0f}s"
+        )
 
     # Retained-tick budget: past this the oldest half of the history
     # collapses to its newest tick per pool (exactly the snapshot a

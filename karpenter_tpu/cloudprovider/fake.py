@@ -197,6 +197,10 @@ class FakeCloudProvider(CloudProvider):
         self._interruptions: Dict[str, InterruptionEvent] = {}  # vet: guarded-by(self._lock)
         self._event_ids = itertools.count(1)
         self.acked_interruptions: List[str] = []
+        # Injectable provider-side drift set: provider_id -> reason, served
+        # by instance_drifted until cleared — drift storms are scriptable
+        # the same way interruption storms are.
+        self._drifted: Dict[str, str] = {}  # vet: guarded-by(self._lock)
         # Live market wiring (karpenter_tpu/market): the feed generates the
         # tick stream poll_market_events serves; the attached PriceBook (the
         # controller's fold of that stream) reprices ADVERTISED spot
@@ -325,6 +329,22 @@ class FakeCloudProvider(CloudProvider):
         with self._lock:
             if self._interruptions.pop(event.event_id, None) is not None:
                 self.acked_interruptions.append(event.event_id)
+
+    # --- drift feed ---------------------------------------------------------
+
+    def inject_drift(self, node: NodeSpec, reason: str = "template-moved") -> None:
+        """Test hook: mark `node`'s instance as provider-drifted. The drift
+        sweep sees it on its next pass via instance_drifted."""
+        with self._lock:
+            self._drifted[node.provider_id] = reason
+
+    def clear_drift(self, node: NodeSpec) -> None:
+        with self._lock:
+            self._drifted.pop(node.provider_id, None)
+
+    def instance_drifted(self, node: NodeSpec) -> Optional[str]:
+        with self._lock:
+            return self._drifted.get(node.provider_id)
 
     def _offering_available(self, name: str, offering: Offering) -> bool:
         key = (name, offering.zone, offering.capacity_type)

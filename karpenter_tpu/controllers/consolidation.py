@@ -142,6 +142,7 @@ class ConsolidationController:
         max_disruption: int = DEFAULT_MAX_DISRUPTION,
         cooldown_seconds: float = DEFAULT_COOLDOWN_SECONDS,
         cluster_state=None,
+        ledger: Optional[eligibility.DisruptionLedger] = None,
     ):
         self.cluster = cluster
         self.cloud = cloud
@@ -149,6 +150,14 @@ class ConsolidationController:
         self.termination = termination
         self.max_disruption = max_disruption
         self.cooldown_seconds = cooldown_seconds
+        # The shared voluntary-disruption budget. The Manager passes one
+        # ledger spanning every voluntary actor; directly-constructed
+        # controllers (tests) get a private ledger whose consolidation cap
+        # is max_disruption — the pre-ledger budget semantics.
+        self.ledger = ledger or eligibility.DisruptionLedger(
+            cluster,
+            reason_caps={eligibility.REASON_CONSOLIDATION: max_disruption},
+        )
         # Incremental encoder (models/cluster_state.DeviceClusterState):
         # nomination and receiver scoring read its O(delta)-maintained
         # per-node pod index and used vectors instead of re-listing every
@@ -171,14 +180,12 @@ class ConsolidationController:
         # Resume in-flight drains first (a restarted controller finds the
         # durable action annotation; the per-pod plan is recomputable but
         # not stored — resumed displacements route through the provisioner).
-        in_flight = 0
         for node in self._claimed_nodes():
-            in_flight += 1
             if node.deletion_timestamp is None:
                 self._drain(node, assignment=None)
         if self._reclamation_cooldown():
             return SWEEP_SECONDS
-        budget = self.max_disruption - in_flight
+        budget = self.ledger.headroom(eligibility.REASON_CONSOLIDATION)
         if budget <= 0:
             return SWEEP_SECONDS
         candidates = self._nominate()
@@ -295,8 +302,8 @@ class ConsolidationController:
             return None
         if node.unschedulable:
             return None  # cordoned (by an operator or an in-flight drain)
-        if wellknown.CONSOLIDATION_ACTION_ANNOTATION in node.annotations:
-            return None  # already in flight
+        if eligibility.claim_reason(node) is not None:
+            return None  # in flight already (ours, drift's, or emptiness's)
         if not eligibility.voluntary_disruption_allowed(node):
             return None
         if eligibility.emptiness_owns(provisioner, node):
@@ -405,6 +412,7 @@ class ConsolidationController:
             and node.deletion_timestamp is None
             and wellknown.INTERRUPTION_KIND_ANNOTATION not in node.annotations
             and wellknown.CONSOLIDATION_ACTION_ANNOTATION not in node.annotations
+            and wellknown.DRIFT_ACTION_ANNOTATION not in node.annotations
             and wellknown.EMPTINESS_TIMESTAMP_ANNOTATION not in node.annotations
         )
 
@@ -580,7 +588,11 @@ class ConsolidationController:
 
     def _begin(self, action: Action) -> None:
         node = self.cluster.try_get_node(action.node_name)
-        if node is None or not eligibility.voluntary_disruption_allowed(node):
+        if (
+            node is None
+            or not eligibility.voluntary_disruption_allowed(node)
+            or eligibility.claim_reason(node) is not None
+        ):
             return  # the cluster moved under the solve: drop the action
         # Durable intent FIRST: a controller that dies past this point
         # resumes the drain from the annotation.

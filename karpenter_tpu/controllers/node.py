@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from karpenter_tpu import drift as driftlib
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.taints import Taint
 from karpenter_tpu.cloudprovider import NodeSpec
@@ -16,6 +17,8 @@ from karpenter_tpu.controllers import eligibility
 from karpenter_tpu.controllers.cluster import Cluster
 
 LIVENESS_TIMEOUT_SECONDS = 15 * 60  # ref: node/liveness.go:31
+# How soon a budget-starved expiration/emptiness retries its claim.
+BUDGET_REQUEUE_SECONDS = 10.0
 
 
 def _min_requeue(*results: Optional[float]) -> Optional[float]:
@@ -84,24 +87,80 @@ class Liveness:
         return self.timeout - age
 
 
+class HashStamp:
+    """Back-fill the provisioner-hash annotation on nodes that predate drift
+    detection (legacy/adopted capacity). A missing hash is NEVER drift: the
+    node is stamped with the CURRENT stored-spec hash and participates in
+    spec-hash drift from the next spec change onward — adopting a fleet must
+    not instantly nominate all of it for replacement."""
+
+    def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
+        if wellknown.PROVISIONER_HASH_ANNOTATION not in node.annotations:
+            node.annotations[wellknown.PROVISIONER_HASH_ANNOTATION] = (
+                driftlib.spec_hash(provisioner)
+            )
+            cluster.update_node(node)
+        return None
+
+
 class Expiration:
-    """Delete nodes older than ttlSecondsUntilExpired — the node-upgrade /
-    chaos mechanism (ref: node/expiration.go:37-52)."""
+    """Expire nodes older than ttlSecondsUntilExpired — the node-upgrade /
+    chaos mechanism (ref: node/expiration.go:37-52), rewired through the
+    drift machinery: an expired node is just drift of kind "expired". The
+    claim is the durable drift-action annotation, budgeted through the
+    shared DisruptionLedger, so N simultaneously-expired nodes roll
+    budget-at-a-time instead of the whole fleet deleting at once. Deletion
+    still happens right here (the finalizer drain takes over), so expiration
+    works even where the drift controller isn't running; when it IS running,
+    its sweep sees the same annotation and never double-claims."""
+
+    def __init__(self, ledger: Optional[eligibility.DisruptionLedger] = None):
+        self.ledger = ledger
 
     def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
         ttl = provisioner.spec.ttl_seconds_until_expired
         if ttl is None:
             return None
         age = cluster.clock.now() - node.created_at
-        if age >= ttl:
-            cluster.delete_node(node.name)
-            return None
-        return ttl - age
+        if age < ttl:
+            return ttl - age
+        if wellknown.DRIFT_ACTION_ANNOTATION in node.annotations:
+            return None  # already claimed (by us earlier, or the drift sweep)
+        if wellknown.INTERRUPTION_KIND_ANNOTATION in node.annotations:
+            return None  # the reclamation drain owns it; it's dying anyway
+        if eligibility.claim_reason(node) is not None:
+            return BUDGET_REQUEUE_SECONDS  # another voluntary actor owns it
+        ledger = self.ledger or eligibility.DisruptionLedger(cluster)
+        if ledger.headroom(eligibility.REASON_DRIFT) <= 0:
+            return BUDGET_REQUEUE_SECONDS  # budget spent: roll on a later pass
+        node.annotations[wellknown.DRIFT_ACTION_ANNOTATION] = (
+            driftlib.DRIFT_KIND_EXPIRED
+        )
+        cluster.update_node(node)
+        # Lazy import: controllers.drift pulls in provisioning/termination,
+        # which this leaf module must not import at module load.
+        from karpenter_tpu.controllers.drift import DRIFT_REPLACEMENTS_TOTAL
+        from karpenter_tpu.utils.obs import RECORDER
+
+        RECORDER.record(
+            "drift",
+            node=node.name,
+            drift_kind=driftlib.DRIFT_KIND_EXPIRED,
+            reason=f"node age {age:.0f}s >= ttlSecondsUntilExpired {ttl}s",
+        )
+        DRIFT_REPLACEMENTS_TOTAL.inc(driftlib.DRIFT_KIND_EXPIRED, "executed")
+        cluster.delete_node(node.name)
+        return None
 
 
 class Emptiness:
     """Stamp/clear the emptiness timestamp; delete past ttlSecondsAfterEmpty
-    (ref: node/emptiness.go:38-99)."""
+    (ref: node/emptiness.go:38-99). The delete consults the shared
+    DisruptionLedger: a stamped-and-waiting empty node costs nothing, but
+    actually deleting one is a voluntary disruption like any other."""
+
+    def __init__(self, ledger: Optional[eligibility.DisruptionLedger] = None):
+        self.ledger = ledger
 
     def reconcile(self, cluster: Cluster, provisioner, node: NodeSpec) -> Optional[float]:
         ttl = provisioner.spec.ttl_seconds_after_empty
@@ -128,6 +187,9 @@ class Emptiness:
             return ttl
         elapsed = now - float(stamp)
         if elapsed >= ttl:
+            ledger = self.ledger or eligibility.DisruptionLedger(cluster)
+            if ledger.headroom(eligibility.REASON_EMPTINESS) <= 0:
+                return BUDGET_REQUEUE_SECONDS  # budget spent: retry shortly
             cluster.delete_node(node.name)
             return None
         return ttl - elapsed
@@ -151,13 +213,19 @@ class NodeController:
     karpenter-labeled nodes, skip deleting ones, run sub-reconcilers, requeue
     at the soonest requested time."""
 
-    def __init__(self, cluster: Cluster, liveness_timeout: float = LIVENESS_TIMEOUT_SECONDS):
+    def __init__(
+        self,
+        cluster: Cluster,
+        liveness_timeout: float = LIVENESS_TIMEOUT_SECONDS,
+        ledger: Optional[eligibility.DisruptionLedger] = None,
+    ):
         self.cluster = cluster
         self.reconcilers = [
             Readiness(),
             Liveness(timeout=liveness_timeout),
-            Expiration(),
-            Emptiness(),
+            HashStamp(),
+            Expiration(ledger=ledger),
+            Emptiness(ledger=ledger),
             Finalizer(),
         ]
 

@@ -16,6 +16,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from karpenter_tpu import drift as driftlib
 from karpenter_tpu.api import wellknown
 from karpenter_tpu.api.pods import PodSpec
 from karpenter_tpu.api.provisioner import PodIncompatibleError, Provisioner
@@ -711,6 +712,15 @@ class ProvisionerWorker:
             return True
         return getattr(error, "status", None) == 404
 
+    def _drift_hash(self) -> str:
+        """Drift identity for freshly-registered nodes: hash the STORED spec,
+        never this worker's fleet-merged EFFECTIVE copy — effective
+        requirements shift with the live catalog (ICE blackouts, new zones),
+        and stamping them would make every market wobble look like
+        provisioner drift."""
+        stored = self.cluster.try_get_provisioner(self.provisioner.name)
+        return driftlib.spec_hash(stored if stored is not None else self.provisioner)
+
     def _register_and_bind(
         self, node: NodeSpec, pods: Sequence[PodSpec], extra_labels=None
     ):
@@ -724,6 +734,9 @@ class ProvisionerWorker:
             node.labels.setdefault(key, value)
         for key, value in self.provisioner.spec.constraints.labels.items():
             node.labels.setdefault(key, value)
+        node.annotations.setdefault(
+            wellknown.PROVISIONER_HASH_ANNOTATION, self._drift_hash()
+        )
         node.taints = list(self.provisioner.spec.constraints.taints) + [
             Taint(key=wellknown.NOT_READY_TAINT_KEY, effect="NoSchedule")
         ]

@@ -209,12 +209,17 @@ class SelectionController:
                 )
 
     def _select_and_enqueue(self, pod: PodSpec) -> str:
-        """First matching provisioner in alphabetical order wins
-        (ref: selectProvisioner:80-102). Outcomes: "accepted" (a worker
-        holds the pod — batch window or overflow), "refused" (the matching
-        worker's admission queue is at --provision-queue-max-pods; the pod
-        stays on the requeue ladder and ages there), "no-match"."""
-        for provisioner in self.cluster.list_provisioners():
+        """Highest-weight matching provisioner wins; alphabetical order
+        breaks ties (ref: selectProvisioner:80-102, plus real Karpenter's
+        `.spec.weight` preference). Outcomes: "accepted" (a worker holds the
+        pod — batch window or overflow), "refused" (the matching worker's
+        admission queue is at --provision-queue-max-pods; the pod stays on
+        the requeue ladder and ages there), "no-match"."""
+        ranked = sorted(
+            self.cluster.list_provisioners(),
+            key=lambda p: (-p.spec.weight, p.name),
+        )
+        for provisioner in ranked:
             if provisioner.deletion_timestamp is not None:
                 continue
             worker = self.provisioning.worker(provisioner.name)

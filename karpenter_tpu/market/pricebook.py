@@ -92,6 +92,11 @@ class PriceBook:
         self._depth: Dict[Pool, float] = {}  # vet: guarded-by(self._lock)
         self._anchor: Dict[Pool, float] = {}  # vet: guarded-by(self._lock)
         self._closed: Set[Pool] = set()  # vet: guarded-by(self._lock)
+        # pool -> feed time (tick.at) the CURRENT closure began. Stamped from
+        # the tick, not the wall clock, so a restart's replay reconstructs
+        # the identical closure age — the drift sweep's sustained-ICE window
+        # (closed_since) stays deterministic across crashes.
+        self._closed_at: Dict[Pool, float] = {}  # vet: guarded-by(self._lock)
         self._trend: Dict[Pool, float] = {}  # vet: guarded-by(self._lock)
         self._risk_q: Dict[Pool, float] = {}  # vet: guarded-by(self._lock)
         # pool -> (decayed count, clock stamp of last decay)
@@ -118,8 +123,13 @@ class PriceBook:
     def _apply_ice_locked(self, tick: MarketTick) -> Reprice:
         if tick.kind == TICK_ICE_CLOSE:
             self._closed.add(tick.pool)
+            # setdefault: a repeated close while already closed must not
+            # reset the closure age (the sustained-ICE drift window would
+            # never elapse under a re-asserting feed).
+            self._closed_at.setdefault(tick.pool, tick.at)
         else:
             self._closed.discard(tick.pool)
+            self._closed_at.pop(tick.pool, None)
         self._generation += 1
         discount = self._discount.get(tick.pool, tick.discount)
         return Reprice(
@@ -284,6 +294,20 @@ class PriceBook:
     def is_closed(self, pool: Pool) -> bool:
         with self._lock:
             return tuple(pool) in self._closed
+
+    def closed_since(self, pool: Pool) -> Optional[float]:
+        """Feed time (tick.at) the pool's CURRENT ICE closure began; None if
+        open. The drift sweep compares this against the feed's latest tick
+        time to decide "ICE-closed past a sustained window" — transient
+        blackouts (ordinary 45s ICE TTL churn) must not drift a fleet."""
+        with self._lock:
+            return self._closed_at.get(tuple(pool))
+
+    def last_tick_at(self) -> Optional[float]:
+        """Feed time of the newest applied tick (None until the first) — the
+        clock domain closed_since lives in."""
+        with self._lock:
+            return self._last_tick_at
 
     def pools(self):
         with self._lock:

@@ -586,8 +586,26 @@ class Manager:
         )
         self.selection = SelectionController(cluster, self.provisioning)
         self.termination = TerminationController(cluster, cloud)
+        # ONE voluntary-disruption ledger spans every voluntary actor —
+        # consolidation, drift/expiration, and emptiness deletes all draw on
+        # the same --disruption-budget, with per-reason caps nested inside.
+        from karpenter_tpu.controllers.eligibility import DisruptionLedger
+        from karpenter_tpu.controllers import eligibility as _eligibility
+
+        self.disruption_ledger = DisruptionLedger(
+            cluster,
+            budget=options.disruption_budget,
+            reason_caps={
+                _eligibility.REASON_CONSOLIDATION: (
+                    options.consolidation_max_disruption
+                ),
+                _eligibility.REASON_DRIFT: options.drift_max_disruption,
+            },
+        )
         self.node = NodeController(
-            cluster, liveness_timeout=options.node_liveness_timeout
+            cluster,
+            liveness_timeout=options.node_liveness_timeout,
+            ledger=self.disruption_ledger,
         )
         self.counter = CounterController(cluster)
         self.metrics = MetricsController(cluster)
@@ -627,6 +645,21 @@ class Manager:
             max_disruption=options.consolidation_max_disruption,
             cooldown_seconds=options.consolidation_cooldown,
             cluster_state=self.cluster_state,
+            ledger=self.disruption_ledger,
+        )
+        # Drift sweep: spec-hash + provider-side + expiration detection with
+        # budgeted rolling replacement (docs/design/drift.md). Constructed
+        # after consolidation so the two share the ledger and the same
+        # provisioning/termination plumbing.
+        from karpenter_tpu.controllers.drift import DriftController
+
+        self.drift = DriftController(
+            cluster,
+            cloud,
+            self.provisioning,
+            self.termination,
+            ledger=self.disruption_ledger,
+            enabled=options.drift_enabled,
         )
         # The book (built above, before the controllers that feed it) folds
         # the provider's tick stream; set_active_book makes it the book the
@@ -733,6 +766,12 @@ class Manager:
             "consolidation": ReconcileLoop(
                 "consolidation", self.consolidation.reconcile, concurrency=1
             ),
+            # Drift sweep: compare live nodes against the current spec hash
+            # and the provider's launch-template generation; roll drifted
+            # capacity through the budgeted replacement path.
+            "drift": ReconcileLoop(
+                "drift", self.drift.reconcile, concurrency=1
+            ),
             # Market sweep: poll the provider's price/ICE feed, fold ticks
             # into the PriceBook, requeue cost decisions on debounced
             # reprices — the dynamic analogue of the 5-minute drift requeue.
@@ -838,6 +877,9 @@ class Manager:
         for provisioner in self.cluster.list_provisioners():
             self.loops["provisioning"].enqueue(provisioner.name)
         self.loops["consolidation"].enqueue("sweep")
+        # A reprice can flip a spot pool's sustained-ICE drift verdict, so
+        # the drift sweep is pulled forward with the other cost decisions.
+        self.loops["drift"].enqueue("sweep")
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -878,6 +920,7 @@ class Manager:
         self.loops["interruption"].enqueue("sweep")
         self.loops["health"].enqueue("sweep")
         self.loops["consolidation"].enqueue("sweep")
+        self.loops["drift"].enqueue("sweep")
         self.loops["market"].enqueue("sweep")
         self._kick_warmup()
         if self.warm.is_set() and not self._stop.is_set():

@@ -117,6 +117,20 @@ HEALTH_SITES = (
     "health.mid-displace",
 )
 
+# Drift rolling-replacement commit points (docs/design/drift.md):
+# - ``drift.after-mark``     drift kind annotation stamped on the victim,
+#   nothing displaced yet — a restart resumes the replacement from the
+#   durable annotation without re-detecting.
+# - ``drift.mid-replace``    fires per displaced pod (arm with at=N) — a
+#   kill here leaves some pods rebound-pending and some still on the
+#   drifted node; the restart must finish without double-displacing.
+# - ``drift.before-delete``  drain done, node deletion not yet issued.
+DRIFT_SITES = (
+    "drift.after-mark",
+    "drift.mid-replace",
+    "drift.before-delete",
+)
+
 
 class SimulatedCrash(BaseException):
     """The controller process 'died' at a named site. BaseException so no

@@ -77,6 +77,19 @@ class Options:
     # Seconds of quiet after any interruption/termination activity before
     # consolidation acts again — the voluntary path yields to reclamation.
     consolidation_cooldown: float = 60.0
+    # Fleet-wide voluntary-disruption budget (controllers/eligibility.py
+    # DisruptionLedger): at most this many voluntary disruptions —
+    # consolidation + drift/expiration + emptiness deletes together — may
+    # be in flight at once; 0 disables ALL voluntary disruption. Per-reason
+    # caps (consolidation-max-disruption, drift-max-disruption) nest inside.
+    disruption_budget: int = 10
+    # Whether the drift sweep runs at all (spec-hash, provider-side, and
+    # expiration detection; controllers/drift.py).
+    drift_enabled: bool = True
+    # Per-sweep cap on NEW drift/expiration victims (the drift reason's
+    # slice of the shared budget); 0 pauses drift replacement while leaving
+    # detection (drift_nodes gauge) running.
+    drift_max_disruption: int = 2
     # Pod-latency SLO targets (utils/obs.py SloEvaluator): rolling-window
     # p99 ceilings for end-to-end pending time and time-to-first-launch.
     # Exceeding a target counts slo_breaches_total{slo} and triggers a
@@ -193,10 +206,22 @@ class Options:
             ("slo-ttfl", self.slo_ttfl),
             ("consolidation-max-disruption", self.consolidation_max_disruption),
             ("consolidation-cooldown", self.consolidation_cooldown),
+            ("disruption-budget", self.disruption_budget),
+            ("drift-max-disruption", self.drift_max_disruption),
             ("reprice-debounce", self.reprice_debounce),
         ):
             if value < 0:
                 errors.append(f"{flag} must be >= 0 (0 disables), got {value}")
+        for flag, cap in (
+            ("consolidation-max-disruption", self.consolidation_max_disruption),
+            ("drift-max-disruption", self.drift_max_disruption),
+        ):
+            if cap > self.disruption_budget:
+                errors.append(
+                    f"{flag} must be <= disruption-budget "
+                    f"({self.disruption_budget}) — a per-reason cap above the "
+                    f"global budget can never be spent, got {cap}"
+                )
         if self.reprice_threshold <= 0:
             errors.append(
                 f"reprice-threshold must be > 0, got {self.reprice_threshold}"
@@ -316,6 +341,18 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         default=float(_env("CONSOLIDATION_COOLDOWN", "60")),
     )
     parser.add_argument(
+        "--disruption-budget", type=int,
+        default=int(_env("DISRUPTION_BUDGET", "10")),
+    )
+    parser.add_argument(
+        "--no-drift", action="store_true",
+        default=_env("DRIFT_ENABLED", "true").lower() == "false",
+    )
+    parser.add_argument(
+        "--drift-max-disruption", type=int,
+        default=int(_env("DRIFT_MAX_DISRUPTION", "2")),
+    )
+    parser.add_argument(
         "--encode-compaction-threshold", type=float,
         default=float(_env("ENCODE_COMPACTION_THRESHOLD", "0.5")),
     )
@@ -377,6 +414,9 @@ def parse(argv: Optional[List[str]] = None) -> Options:
         interruption_escalate_fraction=args.interruption_escalate_fraction,
         consolidation_max_disruption=args.consolidation_max_disruption,
         consolidation_cooldown=args.consolidation_cooldown,
+        disruption_budget=args.disruption_budget,
+        drift_enabled=not args.no_drift,
+        drift_max_disruption=args.drift_max_disruption,
         encode_compaction_threshold=args.encode_compaction_threshold,
         slo_pending_p99=args.slo_pending_p99,
         slo_ttfl=args.slo_ttfl,

@@ -15,6 +15,8 @@ from karpenter_tpu.api.provisioner import Provisioner
 from karpenter_tpu.controllers.cluster import Cluster
 from karpenter_tpu.controllers.consolidation import ConsolidationController
 from karpenter_tpu.controllers.counter import CounterController
+from karpenter_tpu.controllers.drift import DriftController
+from karpenter_tpu.controllers.eligibility import DisruptionLedger
 from karpenter_tpu.controllers.health import HealthController
 from karpenter_tpu.controllers.instancegc import InstanceGcController
 from karpenter_tpu.controllers.interruption import InterruptionController
@@ -90,7 +92,9 @@ class Harness:
         self.provisioning = ProvisioningController(self.cluster, self.cloud, solver)
         self.selection = SelectionController(self.cluster, self.provisioning)
         self.termination = TerminationController(self.cluster, self.cloud)
-        self.node = NodeController(self.cluster)
+        # One shared voluntary-disruption ledger, exactly like the Manager's.
+        self.ledger = DisruptionLedger(self.cluster)
+        self.node = NodeController(self.cluster, ledger=self.ledger)
         self.counter = CounterController(self.cluster)
         self.metrics = MetricsController(self.cluster)
         self.instancegc = InstanceGcController(self.cluster, self.cloud)
@@ -102,6 +106,13 @@ class Harness:
         )
         self.health = HealthController(
             self.cluster, self.cloud, self.provisioning, self.termination
+        )
+        self.drift = DriftController(
+            self.cluster,
+            self.cloud,
+            self.provisioning,
+            self.termination,
+            ledger=self.ledger,
         )
 
     def apply_provisioner(self, provisioner: Provisioner) -> Provisioner:

@@ -16,6 +16,7 @@ from tests import harness as harness_mod
 from tests import test_chaos as chaos
 from tests import test_consolidation as consolidation
 from tests import test_crash_consistency as crash
+from tests import test_drift as drift
 from tests import test_health as health
 from tests import test_interruption as interruption
 from tests import test_market_feed as market_feed
@@ -204,6 +205,29 @@ class TestNodeControllerStalenessOnApiserver(health.TestNodeControllerStaleness)
     """The stale-object satellite's real shape: between sub-reconciler
     patches the informer cache has moved — the re-read must pick up the
     merged object, not the pre-write snapshot."""
+
+
+class TestHashStampingOnApiserver(drift.TestHashStamping):
+    pass
+
+
+class TestDriftReplacementOnApiserver(drift.TestDriftReplacement):
+    """The rolling replacement path over real apiserver merge-patches: the
+    durable claim, the cordon, and the annotation removal on cancel all go
+    through the write-through store."""
+
+
+class TestDisruptionLedgerOnApiserver(drift.TestDisruptionLedger):
+    pass
+
+
+class TestExpirationBudgetOnApiserver(drift.TestExpirationBudget):
+    """ISSUE satellite: N simultaneously-expired nodes roll no more than
+    budget-at-a-time on BOTH backends."""
+
+
+class TestDriftCrashMatrixOnApiserver(drift.TestDriftCrashMatrix):
+    pass
 
 
 class TestLeaseCasUnderChaos:

@@ -302,4 +302,4 @@ class TestCrashpointFacility:
             crashpoints.ENCODE_SITES
         ) | set(crashpoints.MARKET_SITES) | set(crashpoints.LEADER_SITES) | set(
             crashpoints.HEALTH_SITES
-        )
+        ) | set(crashpoints.DRIFT_SITES)

@@ -1,0 +1,639 @@
+"""Drift battletest: spec-hash drift, provider-side drift, and expiration —
+all rolled through the budgeted voluntary replacement path — plus the hash
+stability properties the whole subsystem rests on, the shared
+DisruptionLedger, provisioner weight selection, and the drift crash matrix.
+
+`make drift-smoke` wraps the live churn + spec-flip chaos harness
+(tools/drift_smoke.py) around the same subsystem; this module is the
+deterministic matrix. test_backend_parity re-runs the classes against the
+fake apiserver.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from karpenter_tpu import drift as driftlib
+from karpenter_tpu.api import wellknown
+from karpenter_tpu.api.provisioner import Constraints, Provisioner, ProvisionerSpec
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.serialization import provisioner_from_dict, provisioner_to_dict
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.api.validation import ValidationError, validate_provisioner
+from karpenter_tpu.controllers import eligibility
+from karpenter_tpu.controllers.drift import DriftController
+from karpenter_tpu.controllers.eligibility import DisruptionLedger
+from karpenter_tpu.controllers.instancegc import (
+    LAUNCH_GRACE_SECONDS,
+    InstanceGcController,
+)
+from karpenter_tpu.controllers.node import NodeController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.selection import SelectionController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.utils import crashpoints
+from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+from tests import fixtures
+from tests.harness import Harness
+from tests.test_interruption import BindRecorder
+
+HASH_ANNOTATION = wellknown.PROVISIONER_HASH_ANNOTATION
+ACTION_ANNOTATION = wellknown.DRIFT_ACTION_ANNOTATION
+
+
+# --- harness helpers ---------------------------------------------------------
+
+
+def drift_harness(pods, **spec_kwargs):
+    """Default-catalog harness: provisioner + pods provisioned, every node
+    marked ready (drift only disrupts joined nodes)."""
+    h = Harness()
+    recorder = BindRecorder(h.cluster)
+    h.apply_provisioner(
+        Provisioner(name="default", spec=ProvisionerSpec(**spec_kwargs))
+    )
+    h.provision(*pods)
+    ready_all(h)
+    return h, recorder
+
+
+def ready_all(h: Harness) -> None:
+    for node in h.cluster.list_nodes():
+        if not node.ready:
+            node.ready = True
+            node.status_reported_at = h.clock.now()
+            h.cluster.update_node(node)
+        if node.deletion_timestamp is None:
+            h.node.reconcile(node.name)
+
+
+def flip_spec(h: Harness, name: str = "default") -> str:
+    """Change the provisioner's constraint envelope (a new label) — the
+    rolling-upgrade trigger — and return the NEW spec hash."""
+    provisioner = h.cluster.try_get_provisioner(name)
+    provisioner.spec.constraints.labels["generation"] = "v2"
+    h.apply_provisioner(provisioner)
+    return driftlib.spec_hash(h.cluster.try_get_provisioner(name))
+
+
+def converge(h: Harness, rounds: int = 8) -> None:
+    """Drive drift sweeps + provisioning + terminations to a fixpoint."""
+    for _ in range(rounds):
+        h.drift.reconcile()
+        for worker in list(h.provisioning.workers.values()):
+            worker.provision()
+        ready_all(h)
+        h.reconcile_terminations(rounds=3)
+
+
+def restart(h: Harness, ledger: DisruptionLedger = None) -> None:
+    """A controller-process restart over the surviving cluster + cloud
+    state, plus the boot re-list routing pending pods through selection."""
+    h.provisioning = ProvisioningController(h.cluster, h.cloud, None)
+    h.selection = SelectionController(h.cluster, h.provisioning)
+    h.termination = TerminationController(h.cluster, h.cloud)
+    h.instancegc = InstanceGcController(h.cluster, h.cloud)
+    h.ledger = ledger or DisruptionLedger(h.cluster)
+    h.node = NodeController(h.cluster, ledger=h.ledger)
+    h.drift = DriftController(
+        h.cluster, h.cloud, h.provisioning, h.termination, ledger=h.ledger
+    )
+    for provisioner in h.cluster.list_provisioners():
+        h.provisioning.reconcile(provisioner.name)
+    for pod in h.cluster.list_pods():
+        if pod.is_provisionable():
+            h.selection.reconcile(pod.namespace, pod.name)
+
+
+def assert_no_leaks(h: Harness) -> None:
+    h.clock.advance(LAUNCH_GRACE_SECONDS + 1)
+    h.instancegc.reconcile()
+    h.instancegc.reconcile()
+    node_ids = {n.provider_id for n in h.cluster.list_nodes()}
+    leaked = set(h.cloud.instances) - node_ids
+    assert not leaked, f"instances with no Node after GC grace: {sorted(leaked)}"
+
+
+def claims(h: Harness):
+    return [
+        n for n in h.cluster.list_nodes() if ACTION_ANNOTATION in n.annotations
+    ]
+
+
+# --- hash stability ----------------------------------------------------------
+
+
+def _spec(labels=None, taints=None, requirements=None, provider=None, **kwargs):
+    return ProvisionerSpec(
+        constraints=Constraints(
+            labels=dict(labels or {}),
+            taints=list(taints or []),
+            requirements=Requirements(requirements or []),
+            provider=provider,
+        ),
+        **kwargs,
+    )
+
+
+class TestSpecHashStability:
+    """The canonical-form properties the whole subsystem rests on: a hash
+    that wobbled under key order or default expansion would roll fleets for
+    no reason."""
+
+    def test_label_insertion_order_irrelevant(self):
+        a = _spec(labels={"team": "ml", "tier": "prod"})
+        b = _spec(labels={"tier": "prod", "team": "ml"})
+        assert driftlib.spec_hash(a) == driftlib.spec_hash(b)
+
+    def test_taint_order_irrelevant(self):
+        t1 = Taint(key="a", value="1")
+        t2 = Taint(key="b", value="2", effect="NoExecute")
+        assert driftlib.spec_hash(_spec(taints=[t1, t2])) == driftlib.spec_hash(
+            _spec(taints=[t2, t1])
+        )
+
+    def test_requirement_order_and_value_order_irrelevant(self):
+        r1 = Requirement.in_(wellknown.ZONE_LABEL, ["us-east-1a", "us-east-1b"])
+        r2 = Requirement.in_(wellknown.ARCH_LABEL, ["amd64"])
+        r1_shuffled = Requirement.in_(
+            wellknown.ZONE_LABEL, ["us-east-1b", "us-east-1a"]
+        )
+        assert driftlib.spec_hash(
+            _spec(requirements=[r1, r2])
+        ) == driftlib.spec_hash(_spec(requirements=[r2, r1_shuffled]))
+
+    def test_default_equivalent_specs_hash_identically(self):
+        assert driftlib.spec_hash(ProvisionerSpec()) == driftlib.spec_hash(
+            ProvisionerSpec(
+                constraints=Constraints(
+                    labels={}, taints=[], requirements=Requirements(), provider=None
+                ),
+                ttl_seconds_after_empty=None,
+                ttl_seconds_until_expired=None,
+                limits=None,
+                weight=0,
+            )
+        )
+
+    def test_lifecycle_knobs_excluded(self):
+        """TTLs and weight are operational knobs, not the constraint
+        envelope: flipping them must not nominate a fleet for replacement."""
+        base = driftlib.spec_hash(ProvisionerSpec())
+        assert driftlib.spec_hash(ProvisionerSpec(ttl_seconds_after_empty=30)) == base
+        assert (
+            driftlib.spec_hash(ProvisionerSpec(ttl_seconds_until_expired=3600))
+            == base
+        )
+        assert driftlib.spec_hash(ProvisionerSpec(weight=50)) == base
+
+    def test_envelope_changes_change_the_hash(self):
+        base = driftlib.spec_hash(ProvisionerSpec())
+        assert driftlib.spec_hash(_spec(labels={"k": "v"})) != base
+        assert driftlib.spec_hash(_spec(taints=[Taint(key="t")])) != base
+        assert (
+            driftlib.spec_hash(
+                _spec(requirements=[Requirement.in_(wellknown.ZONE_LABEL, ["z"])])
+            )
+            != base
+        )
+        assert driftlib.spec_hash(_spec(provider={"ami": "custom"})) != base
+
+    def test_accepts_provisioner_or_spec(self):
+        spec = _spec(labels={"k": "v"})
+        assert driftlib.spec_hash(spec) == driftlib.spec_hash(
+            Provisioner(name="p", spec=spec)
+        )
+
+    def test_hash_survives_serialization_round_trip(self):
+        provisioner = Provisioner(
+            name="p",
+            spec=_spec(
+                labels={"team": "ml"},
+                taints=[Taint(key="dedicated", value="ml")],
+                requirements=[Requirement.in_(wellknown.ZONE_LABEL, ["z1", "z2"])],
+                weight=7,
+            ),
+        )
+        revived = provisioner_from_dict(provisioner_to_dict(provisioner))
+        assert driftlib.spec_hash(revived) == driftlib.spec_hash(provisioner)
+        assert revived.spec.weight == 7
+
+    def test_hash_is_not_python_hash(self):
+        """The stamp must be process-stable (PYTHONHASHSEED-independent):
+        a fixed-width lowercase hex string, never a salted int."""
+        value = driftlib.spec_hash(ProvisionerSpec())
+        assert isinstance(value, str)
+        assert len(value) == driftlib.HASH_LENGTH
+        assert int(value, 16) >= 0
+
+
+# --- hash stamping -----------------------------------------------------------
+
+
+class TestHashStamping:
+    def test_new_nodes_stamped_at_registration(self):
+        h, _ = drift_harness(fixtures.pods(2, cpu="12"))
+        expected = driftlib.spec_hash(h.cluster.try_get_provisioner("default"))
+        for node in h.cluster.list_nodes():
+            assert node.annotations.get(HASH_ANNOTATION) == expected
+
+    def test_legacy_node_backfilled_not_drifted(self):
+        """A node with no hash (pre-drift or adopted) is stamped with the
+        CURRENT hash by the node reconciler — and the drift sweep must not
+        nominate it in the same breath."""
+        h, _ = drift_harness(fixtures.pods(1, cpu="12"))
+        node = h.cluster.list_nodes()[0]
+        h.cluster.remove_node_annotation(node, HASH_ANNOTATION)
+        h.drift.reconcile()
+        live = h.cluster.get_node(node.name)
+        assert ACTION_ANNOTATION not in live.annotations
+        assert live.deletion_timestamp is None
+        assert live.annotations[HASH_ANNOTATION] == driftlib.spec_hash(
+            h.cluster.try_get_provisioner("default")
+        )
+
+    def test_node_reconciler_backfills_too(self):
+        h, _ = drift_harness(fixtures.pods(1, cpu="12"))
+        node = h.cluster.list_nodes()[0]
+        h.cluster.remove_node_annotation(node, HASH_ANNOTATION)
+        h.node.reconcile(node.name)
+        assert HASH_ANNOTATION in h.cluster.get_node(node.name).annotations
+
+
+# --- detection + rolling replacement ----------------------------------------
+
+
+class TestDriftReplacement:
+    def test_spec_flip_rolls_the_node(self):
+        pods = fixtures.pods(2, cpu="6")
+        h, recorder = drift_harness(pods)
+        victim = h.expect_scheduled(pods[0])
+        new_hash = flip_spec(h)
+        converge(h)
+        assert h.cluster.try_get_node(victim.name) is None, "victim survived"
+        for pod in pods:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert live.node_name is not None, f"{pod.name} lost in the roll"
+            node = h.cluster.get_node(live.node_name)
+            assert node.annotations[HASH_ANNOTATION] == new_hash
+            assert len(recorder.bound[pod.uid]) <= 2, recorder.bound[pod.uid]
+        assert not claims(h)
+        assert_no_leaks(h)
+
+    def test_unchanged_spec_never_drifts(self):
+        pods = fixtures.pods(2, cpu="6")
+        h, _ = drift_harness(pods)
+        before = {n.name for n in h.cluster.list_nodes()}
+        for _ in range(3):
+            h.drift.reconcile()
+        assert {n.name for n in h.cluster.list_nodes()} == before
+        assert not claims(h)
+
+    def test_provider_drift_rolls_the_node(self):
+        pods = fixtures.pods(1, cpu="12")
+        h, _ = drift_harness(pods)
+        victim = h.expect_scheduled(pods[0])
+        h.cloud.inject_drift(victim, reason="launch template moved")
+        converge(h)
+        assert h.cluster.try_get_node(victim.name) is None
+        live = h.cluster.get_pod(pods[0].namespace, pods[0].name)
+        assert live.node_name is not None
+        assert_no_leaks(h)
+
+    def test_drift_disabled_detects_nothing(self):
+        pods = fixtures.pods(1, cpu="12")
+        h, _ = drift_harness(pods)
+        h.drift.enabled = False
+        flip_spec(h)
+        h.drift.reconcile()
+        assert not claims(h)
+        assert all(
+            n.deletion_timestamp is None for n in h.cluster.list_nodes()
+        )
+
+    def test_do_not_evict_cancels_the_replacement(self):
+        pods = fixtures.pods(1, cpu="12")
+        h, _ = drift_harness(pods)
+        victim = h.expect_scheduled(pods[0])
+        live = h.cluster.get_pod(pods[0].namespace, pods[0].name)
+        live.annotations[wellknown.DO_NOT_EVICT_ANNOTATION] = "true"
+        h.cluster.apply_pod(live)
+        flip_spec(h)
+        h.drift.reconcile()
+        node = h.cluster.get_node(victim.name)
+        assert ACTION_ANNOTATION not in node.annotations, "claim not cancelled"
+        assert node.deletion_timestamp is None
+        assert not node.unschedulable, "cancel must undo the cordon"
+
+    def test_interruption_claimed_node_left_alone(self):
+        pods = fixtures.pods(1, cpu="12")
+        h, _ = drift_harness(pods)
+        victim = h.expect_scheduled(pods[0])
+        node = h.cluster.get_node(victim.name)
+        node.annotations[wellknown.INTERRUPTION_KIND_ANNOTATION] = "spot-interruption"
+        h.cluster.update_node(node)
+        flip_spec(h)
+        h.drift.reconcile()
+        assert ACTION_ANNOTATION not in h.cluster.get_node(victim.name).annotations
+
+    def test_rolling_respects_budget_at_every_instant(self):
+        """Flip the spec under a 5-node fleet with drift capped at 2: no
+        sweep may ever have more than 2 voluntary disruptions in flight,
+        and the fleet still converges to the new hash."""
+        pods = fixtures.pods(5, cpu="12")
+        h, _ = drift_harness(pods)
+        assert len(h.cluster.list_nodes()) == 5
+        ledger = DisruptionLedger(
+            h.cluster, budget=2, reason_caps={eligibility.REASON_DRIFT: 2}
+        )
+        h.drift.ledger = ledger
+        new_hash = flip_spec(h)
+        seen_in_flight = []
+        for _ in range(12):
+            h.drift.reconcile()
+            seen_in_flight.append(len(claims(h)))
+            assert sum(ledger.in_flight().values()) <= 2
+            for worker in list(h.provisioning.workers.values()):
+                worker.provision()
+            ready_all(h)
+            h.reconcile_terminations(rounds=3)
+        assert max(seen_in_flight) <= 2
+        assert max(seen_in_flight) > 0, "budget never used"
+        for node in h.cluster.list_nodes():
+            assert node.annotations[HASH_ANNOTATION] == new_hash
+        for pod in pods:
+            assert h.cluster.get_pod(pod.namespace, pod.name).node_name
+        assert_no_leaks(h)
+
+
+# --- the shared ledger -------------------------------------------------------
+
+
+class TestDisruptionLedger:
+    def test_reasons_share_one_budget(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        h.provision(*fixtures.pods(3, cpu="12"))
+        nodes = h.cluster.list_nodes()
+        ledger = DisruptionLedger(h.cluster, budget=2)
+        assert ledger.headroom(eligibility.REASON_DRIFT) == 2
+        nodes[0].annotations[wellknown.CONSOLIDATION_ACTION_ANNOTATION] = "delete"
+        h.cluster.update_node(nodes[0])
+        assert ledger.headroom(eligibility.REASON_DRIFT) == 1
+        nodes[1].annotations[ACTION_ANNOTATION] = "spec"
+        h.cluster.update_node(nodes[1])
+        assert ledger.headroom(eligibility.REASON_DRIFT) == 0
+        assert ledger.headroom(eligibility.REASON_CONSOLIDATION) == 0
+
+    def test_waiting_empty_nodes_cost_nothing(self):
+        """An emptiness STAMP is scheduled intent, not an in-flight
+        disruption: an idle cluster full of stamped-but-waiting empty nodes
+        must not starve drift/consolidation of the shared budget."""
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+        h.provision(*fixtures.pods(2, cpu="12"))
+        ledger = DisruptionLedger(h.cluster, budget=2)
+        for node in h.cluster.list_nodes():
+            node.annotations[wellknown.EMPTINESS_TIMESTAMP_ANNOTATION] = "0"
+            h.cluster.update_node(node)
+        assert ledger.headroom(eligibility.REASON_DRIFT) == 2
+        # Deletion begins on one: NOW it counts.
+        h.cluster.delete_node(h.cluster.list_nodes()[0].name)
+        assert ledger.headroom(eligibility.REASON_DRIFT) == 1
+
+    def test_per_reason_cap_nests_inside_global(self):
+        h = Harness()
+        ledger = DisruptionLedger(
+            h.cluster, budget=10, reason_caps={eligibility.REASON_DRIFT: 2}
+        )
+        assert ledger.headroom(eligibility.REASON_DRIFT) == 2
+        assert ledger.headroom(eligibility.REASON_CONSOLIDATION) == 10
+        assert ledger.headroom(eligibility.REASON_EMPTINESS) == 10
+
+
+# --- expiration through the drift machinery ---------------------------------
+
+
+class TestExpirationBudget:
+    def test_mass_expiry_rolls_budget_at_a_time(self):
+        """Satellite regression: N simultaneously-expired nodes are
+        replaced at most budget-at-a-time, not all at once — the
+        fleet-upgrade-by-TTL scenario that motivated rewiring expiration
+        through the shared ledger."""
+        pods = fixtures.pods(5, cpu="12")
+        h, _ = drift_harness(pods, ttl_seconds_until_expired=300)
+        assert len(h.cluster.list_nodes()) == 5
+        ledger = DisruptionLedger(h.cluster, budget=2)
+        h.node = NodeController(h.cluster, ledger=ledger)
+        h.clock.advance(301)
+        rounds = 0
+        while any(
+            n.deletion_timestamp is None for n in h.cluster.list_nodes()
+        ) or h.cluster.list_nodes():
+            h.reconcile_nodes()
+            deleting = [
+                n
+                for n in h.cluster.list_nodes()
+                if n.deletion_timestamp is not None
+            ]
+            assert len(deleting) <= 2, (
+                f"budget overrun: {len(deleting)} nodes deleting at once"
+            )
+            assert sum(ledger.in_flight().values()) <= 2
+            h.reconcile_terminations()
+            rounds += 1
+            assert rounds < 20, "mass expiry failed to converge"
+        assert h.cluster.list_nodes() == []
+
+    def test_expired_claim_is_durable_drift_kind(self):
+        h = Harness()
+        h.apply_provisioner(
+            Provisioner(
+                name="default",
+                spec=ProvisionerSpec(ttl_seconds_until_expired=300),
+            )
+        )
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        node.ready = True
+        node.status_reported_at = h.clock.now()
+        h.cluster.update_node(node)
+        h.clock.advance(301)
+        h.node.reconcile(node.name)
+        live = h.cluster.try_get_node(node.name)
+        assert live is None or (
+            live.deletion_timestamp is not None
+            and live.annotations.get(ACTION_ANNOTATION)
+            == driftlib.DRIFT_KIND_EXPIRED
+        )
+
+    def test_drift_sweep_detects_expiry_without_double_claim(self):
+        pods = fixtures.pods(1, cpu="12")
+        h, _ = drift_harness(pods, ttl_seconds_until_expired=300)
+        victim = h.expect_scheduled(pods[0])
+        h.clock.advance(301)
+        h.drift.reconcile()  # the sweep claims it first
+        node = h.cluster.try_get_node(victim.name)
+        assert node is None or ACTION_ANNOTATION in node.annotations
+        # The node reconciler must now leave it alone (no second claim, no
+        # headroom consumed twice).
+        if node is not None and node.deletion_timestamp is None:
+            h.node.reconcile(victim.name)
+        converge(h)
+        assert h.cluster.try_get_node(victim.name) is None
+        assert_no_leaks(h)
+
+
+# --- crash matrix ------------------------------------------------------------
+
+DRIFT_MATRIX = [(site, 1) for site in crashpoints.DRIFT_SITES] + [
+    ("drift.mid-replace", 2)
+]
+
+
+class TestDriftCrashMatrix:
+    """The controller killed at every drift commit point, restarted over the
+    surviving state, and the roll still converges — every pod bound exactly
+    once to a live node, victim gone, zero leaked instances, every claim
+    cleared."""
+
+    @pytest.mark.parametrize(
+        "site,at", DRIFT_MATRIX, ids=[f"{s}@{a}" for s, a in DRIFT_MATRIX]
+    )
+    def test_kill_restart_converges(self, site, at):
+        pods = fixtures.pods(2, cpu="6")  # both on one 16-cpu node
+        h, recorder = drift_harness(pods)
+        victim = h.expect_scheduled(pods[0])
+        new_hash = flip_spec(h)
+        crashpoints.arm(site, at=at)
+        with pytest.raises(SimulatedCrash) as crash:
+            h.drift.reconcile()
+        assert crash.value.site == site
+        restart(h)
+        converge(h)
+        assert h.cluster.try_get_node(victim.name) is None, "victim survived"
+        for pod in pods:
+            live = h.cluster.get_pod(pod.namespace, pod.name)
+            assert live.node_name is not None, f"{pod.name} lost in the crash"
+            node = h.cluster.try_get_node(live.node_name)
+            assert node is not None and node.deletion_timestamp is None
+            assert node.annotations[HASH_ANNOTATION] == new_hash
+            assert len(recorder.bound[pod.uid]) <= 2, recorder.bound[pod.uid]
+        assert not claims(h), "a drift claim survived convergence"
+        assert_no_leaks(h)
+
+
+# --- provisioner weight ------------------------------------------------------
+
+
+class TestProvisionerWeight:
+    def test_highest_weight_wins_selection(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="light", spec=ProvisionerSpec()))
+        h.apply_provisioner(
+            Provisioner(name="heavy", spec=ProvisionerSpec(weight=10))
+        )
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.labels[wellknown.PROVISIONER_NAME_LABEL] == "heavy"
+
+    def test_equal_weight_breaks_ties_alphabetically(self):
+        h = Harness()
+        h.apply_provisioner(Provisioner(name="bravo", spec=ProvisionerSpec()))
+        h.apply_provisioner(Provisioner(name="alpha", spec=ProvisionerSpec()))
+        pod = fixtures.pod()
+        h.provision(pod)
+        node = h.expect_scheduled(pod)
+        assert node.labels[wellknown.PROVISIONER_NAME_LABEL] == "alpha"
+
+    def test_weight_validated(self):
+        for bad in (-1, 101, 1.5, True):
+            with pytest.raises(ValidationError):
+                validate_provisioner(
+                    Provisioner(name="p", spec=ProvisionerSpec(weight=bad))
+                )
+        validate_provisioner(
+            Provisioner(name="p", spec=ProvisionerSpec(weight=100))
+        )
+
+    def test_weight_serialization_round_trip(self):
+        provisioner = Provisioner(name="p", spec=ProvisionerSpec(weight=42))
+        out = provisioner_to_dict(provisioner)
+        assert out["spec"]["weight"] == 42
+        assert provisioner_from_dict(out).spec.weight == 42
+        # Default weight is omitted from the wire form entirely.
+        assert "weight" not in provisioner_to_dict(
+            Provisioner(name="p", spec=ProvisionerSpec())
+        )["spec"]
+
+
+# --- observability + flags ---------------------------------------------------
+
+
+class TestDriftObservability:
+    def test_metrics_registered_with_vet_checker(self):
+        from tools.vet.checkers import metricsuse
+        from tools.vet.framework import production_modules
+
+        by_name, by_var = metricsuse._collect_declarations(production_modules())
+        for name in (
+            "drift_nodes",
+            "drift_replacements_total",
+            "disruption_budget_in_use",
+        ):
+            assert len(set(by_name[name])) == 1, f"{name} declared twice"
+        assert by_var["DRIFT_NODES"] == [("gauge", 1)]
+        assert by_var["DRIFT_REPLACEMENTS_TOTAL"] == [("counter", 2)]
+        assert by_var["DISRUPTION_BUDGET_IN_USE"] == [("gauge", 0)]
+
+    def test_drift_event_flight_recorded(self):
+        from karpenter_tpu.utils.obs import RECORDER
+
+        pods = fixtures.pods(1, cpu="12")
+        h, _ = drift_harness(pods)
+        flip_spec(h)
+        h.drift.reconcile()
+        events = [
+            e
+            for e in RECORDER.snapshot()["events"]
+            if e.get("kind") == "drift"
+        ]
+        assert events, "drift decision left no flight-recorder event"
+        assert events[-1]["drift_kind"] == driftlib.DRIFT_KIND_SPEC
+
+
+class TestDriftFlags:
+    def test_flags_parse(self):
+        from karpenter_tpu.utils.options import parse
+
+        options = parse(
+            [
+                "--cluster-name", "t",
+                "--disruption-budget", "5",
+                "--drift-max-disruption", "3",
+            ]
+        )
+        assert options.disruption_budget == 5
+        assert options.drift_max_disruption == 3
+        assert options.drift_enabled is True
+        assert parse(["--cluster-name", "t", "--no-drift"]).drift_enabled is False
+
+    def test_flags_validated(self):
+        from karpenter_tpu.utils.options import OptionsError, parse
+
+        with pytest.raises(OptionsError):
+            parse(["--cluster-name", "t", "--disruption-budget", "-1"])
+        with pytest.raises(OptionsError):
+            parse(["--cluster-name", "t", "--drift-max-disruption", "-1"])
+        with pytest.raises(OptionsError):
+            # A per-reason cap above the global budget can never be spent.
+            parse(
+                [
+                    "--cluster-name", "t",
+                    "--disruption-budget", "2",
+                    "--drift-max-disruption", "5",
+                ]
+            )

@@ -95,6 +95,7 @@ class TestDashboards:
         # truncated or removed metric slip through.
         # Touch the histogram/gauge modules so registration runs.
         import karpenter_tpu.controllers.provisioning  # noqa: F401
+        import karpenter_tpu.controllers.drift  # noqa: F401 — drift + budget gauges
         import karpenter_tpu.controllers.metrics  # noqa: F401
         import karpenter_tpu.kubeapi.client  # noqa: F401 — lane-wait histogram
         import karpenter_tpu.runtime  # noqa: F401 — reconcile-loop metrics

@@ -1,0 +1,612 @@
+"""Rolling-replacement chaos harness for the drift subsystem.
+
+The deterministic matrix lives in tests/test_drift.py; this tool is the
+storm the ISSUE capstone demands, run against the apiserver backend through
+ChaosTransport so every controller write rides a faulting API. The
+Provisioner's constraint envelope is flipped while churn traffic keeps
+arriving and leaving, a mid-wave reprice folds through the attached
+PriceBook (the event that pulls the drift sweep forward in production), a
+provider-side drift verdict is injected on a freshly-launched node, and the
+"controller process" is killed at rotating drift crashpoints and rebuilt
+over the surviving apiserver + cloud state. At the end:
+
+- every surviving node carries the CURRENT spec hash (post-flip
+  convergence) and no live node is provider-drifted;
+- concurrent voluntary disruptions never exceeded --disruption-budget at
+  any observed instant (server-side oracle on the node event stream);
+- every steady/canary pod was bound EXACTLY once per incarnation — at most
+  two distinct nodes across the whole storm (initial + one replacement);
+- ZERO PDB violations (server-side oracle, immune to chaos-torn streams);
+- ZERO leaked instances after the instancegc grace;
+- the pod-pending p99 SLO held with zero breach episodes, and the flight
+  recorder holds a gap-free record including the drift decisions.
+
+`make drift-smoke` wraps this in a hard timeout. Fake clock throughout —
+the only wall time spent is the armed latency faults' tiny delays.
+"""
+
+import queue
+import sys
+import threading
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+STEADY = 6  # one 12-cpu pod per default-instance-type node
+GUARDED = 3  # steady pods behind the PDB
+MIN_AVAILABLE = 2
+BUDGET = 2  # --disruption-budget for the storm
+FLIP_BEAT = 3
+CANARY_BEAT = 8
+REPRICE_BEAT = 10
+CHURN_EVERY = 2  # a 2-cpu arrival every other beat...
+CHURN_LIFETIME = 4  # ...that leaves this many beats later
+CHURN_END = 20
+MAX_BEATS = 60
+# SLO gate (fake seconds): the wave advances ~1 fake second per beat; a
+# displaced pod pending longer than this is a scheduling regression.
+SLO_PENDING_P99_S = 60.0
+
+
+def build():
+    from karpenter_tpu.api.provisioner import Provisioner, ProvisionerSpec
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.eligibility import DisruptionLedger
+    from karpenter_tpu.kubeapi import ApiServerCluster, KubeClient, RetryPolicy
+    from karpenter_tpu.kubeapi.chaos import ChaosTransport
+    from karpenter_tpu.market.pricebook import PriceBook
+    from karpenter_tpu.utils.clock import FakeClock
+    from karpenter_tpu.utils.obs import OBS, RECORDER
+    from tests.fake_apiserver import DirectTransport, FakeApiServer
+
+    clock = FakeClock()
+    server = FakeApiServer(clock=clock)
+    client = KubeClient(
+        ChaosTransport(DirectTransport(server), clock=clock),
+        qps=1e6,
+        burst=10**6,
+        clock=clock,
+        retry=RetryPolicy(max_attempts=6, backoff_base_s=0.01, backoff_cap_s=0.1),
+    )
+    cluster = ApiServerCluster(client, clock=clock).start()
+    cloud = FakeCloudProvider(clock=clock)
+    book = PriceBook(clock=clock)
+    cloud.attach_market(book)
+    OBS.configure(clock=clock, slo_pending_p99=SLO_PENDING_P99_S)
+    RECORDER.configure(clock=clock)
+    OBS.attach(cluster)
+    state = {
+        "clock": clock,
+        "server": server,
+        "cluster": cluster,
+        "cloud": cloud,
+        "book": book,
+        "ledger_factory": lambda: DisruptionLedger(cluster, budget=BUDGET),
+    }
+    restart(state)
+    cluster.apply_provisioner(Provisioner(name="default", spec=ProvisionerSpec()))
+    state["provisioning"].reconcile("default")
+    return state
+
+
+def restart(state) -> None:
+    """Fresh controllers over the surviving apiserver + cloud — what a
+    supervisor restart observes (the informer cache is the one piece of
+    'process' state that persists here; the drift crash matrix in
+    tests/test_backend_parity.py covers the same rebuild shape)."""
+    from karpenter_tpu.controllers.drift import DriftController
+    from karpenter_tpu.controllers.instancegc import InstanceGcController
+    from karpenter_tpu.controllers.node import NodeController
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.selection import SelectionController
+    from karpenter_tpu.controllers.termination import TerminationController
+
+    cluster, cloud = state["cluster"], state["cloud"]
+    ledger = state["ledger_factory"]()
+    state["provisioning"] = ProvisioningController(cluster, cloud, None)
+    state["selection"] = SelectionController(cluster, state["provisioning"])
+    state["termination"] = TerminationController(cluster, cloud)
+    state["node"] = NodeController(cluster, ledger=ledger)
+    state["instancegc"] = InstanceGcController(cluster, cloud)
+    state["drift"] = DriftController(
+        cluster,
+        cloud,
+        state["provisioning"],
+        state["termination"],
+        ledger=ledger,
+    )
+    guard = _api_guard()
+    for provisioner in cluster.list_provisioners():
+        try:
+            state["provisioning"].reconcile(provisioner.name)
+        except guard:
+            pass
+    for pod in cluster.list_pods():
+        if pod.is_provisionable():
+            try:
+                state["selection"].reconcile(pod.namespace, pod.name)
+            except guard:
+                pass
+
+
+def _api_guard():
+    from karpenter_tpu.kubeapi import ApiError, TransportError
+
+    return (ApiError, TransportError)
+
+
+def step(state) -> None:
+    """One control-plane beat under the fault storm: drift sweep, provision,
+    kubelet heartbeats, node lifecycle, terminations. API faults that escape
+    the client's retry envelope roll to the next beat — exactly what the
+    Manager's requeue-on-error loops do. SimulatedCrash (a BaseException)
+    always propagates to the storm driver."""
+    guard = _api_guard()
+    try:
+        state["drift"].reconcile()
+    except guard:
+        pass
+    for worker in list(state["provisioning"].workers.values()):
+        try:
+            worker.provision()
+        except guard:
+            pass
+    for node in list(state["cluster"].list_nodes()):
+        if not node.ready:
+            node.ready = True
+            node.status_reported_at = state["clock"].now()
+            try:
+                state["cluster"].update_node(node)
+            except guard:
+                node.ready = False  # storm ate the heartbeat; next beat
+        try:
+            state["node"].reconcile(node.name)
+        except guard:
+            pass
+        try:
+            state["termination"].reconcile(node.name)
+        except guard:
+            pass
+    try:
+        state["termination"].evictions.drain_once()
+    except guard:
+        pass
+
+
+def arm_fault_storm():
+    """Seeded request-level fault storm: resets, committed-then-lost
+    timeouts, 5xx, 409 conflicts, 429 throttles and a little latency on
+    every API verb. Seeded so the storm replays."""
+    from karpenter_tpu.utils import faultpoints
+
+    faultpoints.seed(2026)
+    for site in faultpoints.REQUEST_SITES:
+        faultpoints.arm(site, "latency", rate=0.03, delay_s=0.01)
+        faultpoints.arm(site, "reset", rate=0.03)
+        faultpoints.arm(site, "timeout", rate=0.02)
+        faultpoints.arm(site, "server-error", rate=0.02)
+        faultpoints.arm(site, "throttle", rate=0.02, retry_after_s=0.02)
+    faultpoints.arm("api.request.put", "conflict", rate=0.03)
+    faultpoints.arm("watch.event", "duplicate", rate=0.05)
+
+
+class PdbOracle:
+    """Every pod event on the SERVER must leave the guarded group at or
+    above minAvailable — evaluated on the server's own store, immune to the
+    chaos-mangled client streams."""
+
+    def __init__(self, server, match_labels, min_available):
+        self.server = server
+        self.match = dict(match_labels)
+        self.min = min_available
+        self.violations = []
+        self.q = server.subscribe("pods")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _healthy(self) -> int:
+        _, payload = self.server.handle("GET", "/api/v1/pods")
+        return sum(
+            1
+            for p in payload.get("items", [])
+            if not (p.get("metadata") or {}).get("deletionTimestamp")
+            and (p.get("spec") or {}).get("nodeName")
+            and all(
+                ((p.get("metadata") or {}).get("labels") or {}).get(k) == v
+                for k, v in self.match.items()
+            )
+        )
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            healthy = self._healthy()
+            if healthy < self.min:
+                self.violations.append(healthy)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.server.unsubscribe("pods", self.q)
+
+
+class BudgetOracle:
+    """Concurrent voluntary disruptions must never exceed the budget at any
+    observed instant: every node event on the server re-counts in-flight
+    claims (drift/consolidation annotations, plus deleting empty nodes) from
+    the server's own truth."""
+
+    def __init__(self, server):
+        from karpenter_tpu.api import wellknown
+
+        self.server = server
+        self.wk = wellknown
+        self.max_in_flight = 0
+        self.q = server.subscribe("nodes")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _in_flight(self) -> int:
+        _, payload = self.server.handle("GET", "/api/v1/nodes")
+        count = 0
+        for item in payload.get("items", []):
+            meta = item.get("metadata") or {}
+            annotations = meta.get("annotations") or {}
+            if (
+                self.wk.DRIFT_ACTION_ANNOTATION in annotations
+                or self.wk.CONSOLIDATION_ACTION_ANNOTATION in annotations
+                or (
+                    self.wk.EMPTINESS_TIMESTAMP_ANNOTATION in annotations
+                    and meta.get("deletionTimestamp")
+                )
+            ):
+                count += 1
+        return count
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self.max_in_flight = max(self.max_in_flight, self._in_flight())
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.server.unsubscribe("nodes", self.q)
+
+
+class BindOracle:
+    """Exactly-once binds: per pod uid, the set of distinct nodes it was
+    ever bound to — an asserted pod may see its birth node plus at most ONE
+    replacement across the whole storm (re-read from the server on every
+    pod event, so no transient bind is missed)."""
+
+    def __init__(self, server):
+        self.server = server
+        self.nodes_by_uid = {}
+        self.q = server.subscribe("pods")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _scan(self):
+        _, payload = self.server.handle("GET", "/api/v1/pods")
+        for p in payload.get("items", []):
+            uid = (p.get("metadata") or {}).get("uid")
+            node = (p.get("spec") or {}).get("nodeName")
+            if uid and node:
+                self.nodes_by_uid.setdefault(uid, set()).add(node)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self._scan()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.server.unsubscribe("pods", self.q)
+
+
+def load(state):
+    """Pre-storm steady state: STEADY full-node pods (GUARDED of them behind
+    the PDB) on ready capacity, all stamped with the pre-flip hash."""
+    from tests import fixtures
+
+    pods = fixtures.pods(STEADY, cpu="12")
+    for pod in pods[:GUARDED]:
+        pod.labels["app"] = "guarded"
+    state["cluster"].apply_pdb("guarded", {"app": "guarded"}, MIN_AVAILABLE)
+    for pod in pods:
+        state["cluster"].apply_pod(pod)
+        state["selection"].reconcile(pod.namespace, pod.name)
+    for worker in state["provisioning"].workers.values():
+        worker.provision()
+    for node in state["cluster"].list_nodes():
+        node.ready = True
+        node.status_reported_at = state["clock"].now()
+        state["cluster"].update_node(node)
+        state["node"].reconcile(node.name)
+    for pod in pods:
+        live = state["cluster"].get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} never scheduled"
+    return pods
+
+
+def flip_spec(state) -> str:
+    """The rolling-upgrade trigger: a new constraint label on the stored
+    spec. Returns the NEW hash every node must converge to."""
+    from karpenter_tpu import drift as driftlib
+
+    provisioner = state["cluster"].try_get_provisioner("default")
+    provisioner.spec.constraints.labels["fleet-generation"] = "v2"
+    state["cluster"].apply_provisioner(provisioner)
+    state["provisioning"].reconcile("default")
+    return driftlib.spec_hash(state["cluster"].try_get_provisioner("default"))
+
+
+def reprice(state) -> None:
+    """Mid-wave reprice: a price tick folds through the attached book (spot
+    offerings re-advertise) and the drift sweep is pulled forward — the
+    runtime wires exactly this off the market loop's Reprice event."""
+    from karpenter_tpu.market.feed import TICK_PRICE, MarketTick
+
+    state["book"].apply(
+        MarketTick(
+            seq=1,
+            kind=TICK_PRICE,
+            instance_type="default-instance-type",
+            zone="test-zone-1",
+            discount=0.35,
+            depth=1.0,
+            at=state["clock"].now(),
+        )
+    )
+    state["drift"].reconcile()
+
+
+def converged(state, want_hash) -> bool:
+    from karpenter_tpu.api import wellknown
+    from karpenter_tpu.controllers import eligibility
+
+    nodes = state["cluster"].list_nodes()
+    if not nodes:
+        return False
+    for node in nodes:
+        if node.annotations.get(wellknown.PROVISIONER_HASH_ANNOTATION) != want_hash:
+            return False
+        if eligibility.claim_reason(node) is not None:
+            return False
+        if state["cloud"].instance_drifted(node) is not None:
+            return False
+    for pod in state["cluster"].list_pods():
+        if pod.deletion_timestamp is None and pod.node_name is None:
+            return False
+    return True
+
+
+def churn_traffic(state, beat, churn) -> None:
+    """Live arrival/departure traffic riding the wave: a small pod lands
+    every other beat and leaves a few beats later."""
+    from tests import fixtures
+
+    guard = _api_guard()
+    if beat % CHURN_EVERY == 0 and beat < CHURN_END:
+        arrival = fixtures.pod(name=f"churn-{beat}", cpu="2")
+        churn.append((arrival, beat + CHURN_LIFETIME))
+        try:
+            state["cluster"].apply_pod(arrival)
+            state["selection"].reconcile(arrival.namespace, arrival.name)
+        except guard:
+            pass
+    for pod, expiry in list(churn):
+        if beat >= expiry:
+            churn.remove((pod, expiry))
+            try:
+                state["cluster"].delete_pod(pod.namespace, pod.name)
+            except guard:
+                pass
+
+
+def inject_provider_drift(state, canary, beat) -> None:
+    """The canary bound to a fresh post-flip node; provider-side drift lands
+    on exactly that node, so the canary's second (and last) bind proves the
+    provider kind rolls too."""
+    live = state["cluster"].get_pod(canary.namespace, canary.name)
+    if live is None or live.node_name is None:
+        return
+    node = state["cluster"].try_get_node(live.node_name)
+    if node is not None:
+        state["cloud"].inject_drift(node, reason="template-moved")
+        print(f"  beat {beat}: provider drift injected on {node.name}")
+
+
+def kill_step(state, beat) -> int:
+    """One beat with a rotating drift crashpoint armed; a SimulatedCrash is
+    the controller dying mid-replacement — rebuild over the survivors.
+    Returns how many crashes fired (0 or 1)."""
+    from karpenter_tpu.utils import crashpoints
+    from karpenter_tpu.utils.crashpoints import SimulatedCrash
+
+    site = crashpoints.DRIFT_SITES[(beat // 3) % len(crashpoints.DRIFT_SITES)]
+    crashpoints.arm(site)
+    try:
+        step(state)
+    except SimulatedCrash as crash:
+        print(f"  beat {beat}: killed at {crash.site}; restarting")
+        crashpoints.disarm_all()
+        restart(state)
+        return 1
+    finally:
+        crashpoints.disarm_all()
+    return 0
+
+
+def storm(state, steady):
+    """The wave: churn arrivals/departures every beat, the spec flip, the
+    canary + provider-drift injection, the mid-wave reprice, and rotating
+    drift-crashpoint kills — until every node carries the new hash."""
+    from tests import fixtures
+
+    new_hash = None
+    canary = None
+    crashes = 0
+    churn = []  # (pod, expiry_beat)
+    for beat in range(MAX_BEATS):
+        churn_traffic(state, beat, churn)
+        if beat == FLIP_BEAT:
+            new_hash = flip_spec(state)
+            print(f"  beat {beat}: spec flipped; fleet must converge to {new_hash}")
+        if beat == CANARY_BEAT:
+            canary = fixtures.pod(name="canary", cpu="12")
+            state["cluster"].apply_pod(canary)
+            state["selection"].reconcile(canary.namespace, canary.name)
+        if beat == REPRICE_BEAT:
+            inject_provider_drift(state, canary, beat)
+            reprice(state)
+        if new_hash is not None and beat % 3 == 2:
+            crashes += kill_step(state, beat)
+        step(state)
+        state["clock"].advance(1.0)
+        if new_hash is not None and beat > REPRICE_BEAT and converged(state, new_hash):
+            break
+    assert new_hash is not None
+    assert converged(state, new_hash), (
+        "fleet never converged to the new spec hash"
+    )
+    return new_hash, canary, crashes, beat
+
+
+def verify(state, steady, canary, oracle_binds) -> None:
+    from karpenter_tpu.controllers.drift import DRIFT_REPLACEMENTS_TOTAL
+    from karpenter_tpu.controllers.instancegc import LAUNCH_GRACE_SECONDS
+
+    cluster = state["cluster"]
+    asserted = list(steady) + [canary]
+    for pod in asserted:
+        live = cluster.get_pod(pod.namespace, pod.name)
+        assert live.node_name is not None, f"{pod.name} lost in the storm"
+        node = cluster.try_get_node(live.node_name)
+        assert node is not None and node.deletion_timestamp is None, (
+            f"{pod.name} bound to a dead node"
+        )
+        nodes_seen = oracle_binds.nodes_by_uid.get(pod.uid, set())
+        assert len(nodes_seen) <= 2, (
+            f"{pod.name} bound to {len(nodes_seen)} distinct nodes "
+            f"({sorted(nodes_seen)}) — not exactly-once replacement"
+        )
+    executed = sum(
+        DRIFT_REPLACEMENTS_TOTAL.get(kind, "executed")
+        for kind in ("spec", "provider", "expired")
+    )
+    assert executed >= STEADY, (
+        f"only {executed} drift replacements executed; the flip alone "
+        f"required {STEADY}"
+    )
+    state["clock"].advance(LAUNCH_GRACE_SECONDS + 1)
+    state["instancegc"].reconcile()
+    state["instancegc"].reconcile()
+    leaked = set(state["cloud"].instances) - {
+        n.provider_id for n in cluster.list_nodes()
+    }
+    assert not leaked, f"leaked instances after GC grace: {sorted(leaked)}"
+    return executed
+
+
+def assert_slo_pipeline() -> float:
+    from karpenter_tpu.utils.obs import OBS, POD_PENDING_SECONDS, RECORDER
+
+    snapshot = OBS.slo_snapshot()
+    assert POD_PENDING_SECONDS.count() > 0, "no end-to-end pending samples"
+    p99 = snapshot["pending"]["p99"]
+    assert OBS.evaluator.breaches == {}, (
+        f"SLO breached under the drift wave: {OBS.evaluator.breaches} "
+        f"(pending p99 {p99:.1f}s vs target {SLO_PENDING_P99_S}s)"
+    )
+    flight = RECORDER.snapshot()
+    assert flight["dropped"] == 0, (
+        f"flight recorder dropped {flight['dropped']} events"
+    )
+    seqs = [e["seq"] for e in flight["events"]]
+    assert seqs == list(range(1, flight["seq"] + 1)), "seq gap in the ring"
+    assert RECORDER.count("drift") > 0, "drift decisions never flight-recorded"
+    return p99
+
+
+def main() -> int:
+    from karpenter_tpu.utils import faultpoints
+
+    began = time.time()
+    state = None
+    oracles = []
+    try:
+        state = build()
+        steady = load(state)
+        oracles = [
+            PdbOracle(state["server"], {"app": "guarded"}, MIN_AVAILABLE),
+            BudgetOracle(state["server"]),
+            BindOracle(state["server"]),
+        ]
+        pdb_oracle, budget_oracle, bind_oracle = oracles
+        bind_oracle._scan()  # seed the birth binds before any event races
+        arm_fault_storm()
+        print(
+            f"drift-smoke: {STEADY} pods on "
+            f"{len(state['cluster'].list_nodes())} nodes; storming "
+            f"(budget {BUDGET})"
+        )
+        new_hash, canary, crashes, beats = storm(state, steady)
+        injected = faultpoints.total_fired()  # disarm_all clears the tally
+        faultpoints.disarm_all()
+        assert injected > 0, "the fault storm never fired"
+        for _ in range(4):  # settle: drain queues with the storm off
+            step(state)
+            state["clock"].advance(1.0)
+        executed = verify(state, steady, canary, bind_oracle)
+        pending_p99 = assert_slo_pipeline()
+        for oracle in oracles:
+            oracle.stop()
+        assert pdb_oracle.violations == [], (
+            f"PDB violations during the wave: {pdb_oracle.violations}"
+        )
+        assert budget_oracle.max_in_flight <= BUDGET, (
+            f"{budget_oracle.max_in_flight} concurrent voluntary disruptions "
+            f"observed; budget is {BUDGET}"
+        )
+    except AssertionError as failure:
+        print(f"drift-smoke: FAIL in {time.time() - began:.1f}s: {failure}")
+        return 1
+    finally:
+        faultpoints.disarm_all()
+        for oracle in oracles:
+            try:
+                oracle.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if state is not None:
+            state["cluster"].close()
+    print(
+        f"drift-smoke: OK in {time.time() - began:.1f}s "
+        f"(converged to {new_hash} in {beats + 1} beats; {executed} "
+        f"replacements, {crashes} mid-wave crash+restarts, "
+        f"max {budget_oracle.max_in_flight}/{BUDGET} concurrent disruptions, "
+        f"{injected} API faults injected, 0 PDB violations, 0 leaks; "
+        f"pending p99 {pending_p99:.1f}s inside the {SLO_PENDING_P99_S:.0f}s "
+        "SLO, flight recorder gap-free)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
